@@ -1,0 +1,8 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Tasks/actors/objects core under a JAX/XLA compute path. See SURVEY.md for
+the blueprint; API mirrors the reference (LydiaXwQ/ray) where it makes sense
+and diverges where TPU hardware demands it.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,111 @@
+"""Runtime configuration flags.
+
+TPU-native analog of the reference's ``RAY_CONFIG`` macro table
+(ref: src/ray/common/ray_config_def.h): a single typed flag registry,
+overridable via ``RAYT_<NAME>`` environment variables, serialized to every
+spawned process so the whole cluster sees one consistent view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RAYT_"
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- RPC / control plane ----
+    rpc_connect_timeout_s: float = 10.0
+    rpc_request_timeout_s: float = 60.0
+    rpc_retry_delay_s: float = 0.1
+    rpc_max_retries: int = 5
+    # Fault-injection: probability of dropping an RPC before send / before
+    # reply delivery (analog of RAY_testing_rpc_failure, ref:
+    # src/ray/rpc/rpc_chaos.h:23). 0 disables.
+    testing_rpc_failure_prob: float = 0.0
+    # Deterministic chaos seed (0 = nondeterministic).
+    testing_chaos_seed: int = 0
+
+    # ---- GCS / head ----
+    gcs_health_check_period_s: float = 1.0
+    gcs_health_check_timeout_s: float = 5.0
+    gcs_health_check_failure_threshold: int = 5
+    # ---- scheduler ----
+    lease_timeout_s: float = 30.0
+    worker_startup_timeout_s: float = 60.0
+    # Number of pre-forked idle workers kept per node.
+    idle_worker_pool_size: int = 1
+    idle_worker_ttl_s: float = 300.0
+    # Top-k candidate nodes considered by the hybrid scheduling policy
+    # (analog of ref raylet/scheduling/policy/hybrid_scheduling_policy.h:85).
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_spread_threshold: float = 0.5
+
+    # ---- object store ----
+    # Objects <= this many bytes are returned inline in RPC replies /
+    # stored in the owner's in-process memory store.
+    max_direct_call_object_size: int = 100 * 1024
+    # Shared-memory store capacity (bytes). 0 = auto (30% of system RAM).
+    object_store_memory: int = 0
+    # Seconds a get() waits between liveness re-checks of the owner.
+    get_poll_interval_s: float = 0.2
+
+    # ---- tasks / actors ----
+    default_max_retries: int = 3
+    default_actor_max_restarts: int = 0
+    actor_death_cache_size: int = 1024
+
+    # ---- logging ----
+    log_level: str = "INFO"
+    log_dir: str = ""
+
+    # ---- train / collective ----
+    rendezvous_timeout_s: float = 120.0
+    collective_barrier_timeout_s: float = 120.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls(**json.loads(s))
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def load_config() -> Config:
+    """Build the config, applying RAYT_* env overrides.
+
+    If RAYT_CONFIG_JSON is set (how parent processes hand the full table to
+    children, analog of ref _raylet.pyx `_config`), it is the base.
+    """
+    blob = os.environ.get(_ENV_PREFIX + "CONFIG_JSON")
+    cfg = Config.from_json(blob) if blob else Config()
+    for f in dataclasses.fields(Config):
+        env = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if env is not None:
+            setattr(cfg, f.name, _coerce(env, f.type if isinstance(f.type, type) else type(getattr(cfg, f.name))))
+    return cfg
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = load_config()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg

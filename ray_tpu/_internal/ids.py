@@ -1,0 +1,143 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes, workers.
+
+Mirrors the semantics of the reference's ID scheme (ref: src/ray/common/id.h
+and src/ray/design_docs/id_specification.md) with a simplified, uniform
+layout: every ID is raw bytes with a typed wrapper. ObjectIDs embed the
+TaskID that produced them plus a return-index, so ownership and lineage can
+be derived from the ID itself.
+
+Layout (bytes):
+  JobID    = 4 random bytes
+  ActorID  = 8 random bytes  + JobID            (12)
+  TaskID   = 8 random bytes  + ActorID-or-zeros (20)
+  ObjectID = TaskID + 4-byte big-endian index   (24)
+  NodeID   = 16 random bytes
+  WorkerID = 16 random bytes
+  PlacementGroupID = 12 random bytes
+"""
+
+from __future__ import annotations
+
+import os
+
+JOB_ID_LEN = 4
+ACTOR_ID_LEN = 12
+TASK_ID_LEN = 20
+OBJECT_ID_LEN = 24
+NODE_ID_LEN = 16
+WORKER_ID_LEN = 16
+PLACEMENT_GROUP_ID_LEN = 12
+
+
+class BaseID:
+    LEN = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, b: bytes):
+        if not isinstance(b, bytes) or len(b) != self.LEN:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.LEN} bytes, got {b!r}")
+        self._bytes = b
+
+    @classmethod
+    def random(cls) -> "BaseID":
+        return cls(os.urandom(cls.LEN))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.LEN)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.LEN
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    @classmethod
+    def from_hex(cls, h: str) -> "BaseID":
+        return cls(bytes.fromhex(h))
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._bytes.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    LEN = JOB_ID_LEN
+
+
+class NodeID(BaseID):
+    LEN = NODE_ID_LEN
+
+
+class WorkerID(BaseID):
+    LEN = WORKER_ID_LEN
+
+
+class PlacementGroupID(BaseID):
+    LEN = PLACEMENT_GROUP_ID_LEN
+
+
+class ActorID(BaseID):
+    LEN = ACTOR_ID_LEN
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(8) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[8:])
+
+
+class TaskID(BaseID):
+    LEN = TASK_ID_LEN
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID) -> "TaskID":
+        return cls(os.urandom(8) + b"\x00" * 8 + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(os.urandom(8) + actor_id.binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[8:])
+
+    def has_actor(self) -> bool:
+        return self._bytes[8:16] != b"\x00" * 8
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[16:])
+
+
+class ObjectID(BaseID):
+    LEN = OBJECT_ID_LEN
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + index.to_bytes(4, "big"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Puts use the high bit of the index to avoid colliding with returns.
+        return cls(task_id.binary() + (0x80000000 | put_index).to_bytes(4, "big"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_LEN])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_LEN:], "big")
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()

@@ -1,0 +1,317 @@
+"""RPC substrate: length-prefixed msgpack frames over asyncio streams.
+
+TPU-native analog of the reference's L0 (ref: src/ray/rpc/ — gRPC services,
+retryable clients, and rpc_chaos fault injection). Design decisions:
+
+* One protocol for everything: ``[u32 length][msgpack [msgid, kind, method,
+  payload]]`` where payload is a pickle-5 blob (see serialization.py). This
+  replaces the reference's per-service protobufs — the control plane here is
+  a single-digit number of services, and pickled dataclasses keep the
+  schemas in one language while staying introspectable.
+* Server-push NOTIFY frames on long-lived connections replace the
+  reference's long-poll pubsub (ref: src/ray/pubsub/publisher.h:297) — an
+  asyncio stream is already a persistent channel, so the publisher just
+  writes frames.
+* Chaos hooks (drop request / drop reply with configured probability)
+  mirror RAY_testing_rpc_failure (ref: src/ray/rpc/rpc_chaos.h:23).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+from ray_tpu._internal.config import get_config
+from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
+
+REQUEST, RESPONSE, ERROR, NOTIFY = 0, 1, 2, 3
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """An exception raised inside a remote handler, re-raised locally."""
+
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+    def __str__(self):
+        s = super().__str__()
+        if self.remote_traceback:
+            s += "\n--- remote traceback ---\n" + self.remote_traceback
+        return s
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class _Chaos:
+    """Probabilistic request/reply dropping for chaos tests."""
+
+    def __init__(self):
+        cfg = get_config()
+        self.prob = cfg.testing_rpc_failure_prob
+        self.rng = random.Random(cfg.testing_chaos_seed or None)
+
+    def should_drop(self) -> bool:
+        return self.prob > 0 and self.rng.random() < self.prob
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    data = await reader.readexactly(length)
+    return msgpack.unpackb(data, raw=False, use_list=True)
+
+
+def _frame(msgid: int, kind: int, method: str, payload: bytes) -> bytes:
+    body = msgpack.packb([msgid, kind, method, payload], use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+class Connection:
+    """One live peer connection (either direction). Thread-unsafe; use from
+    the owning event loop only."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._msgid = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._notify_handlers: dict[str, Callable[[Any], None]] = {}
+        self._closed = asyncio.Event()
+        self._chaos = _Chaos()
+        self._read_task: asyncio.Task | None = None
+        # Set by RpcServer for inbound connections:
+        self.server_handlers: dict[str, Callable] | None = None
+        self.on_close: list[Callable[["Connection"], None]] = []
+
+    def start(self):
+        self._read_task = asyncio.ensure_future(self._read_loop())
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def peername(self):
+        try:
+            return self.writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msgid, kind, method, payload = await _read_frame(self.reader)
+                if kind == REQUEST:
+                    asyncio.ensure_future(self._handle_request(msgid, method, payload))
+                elif kind in (RESPONSE, ERROR):
+                    fut = self._pending.pop(msgid, None)
+                    if fut is not None and not fut.done():
+                        if kind == RESPONSE:
+                            fut.set_result(deserialize(payload))
+                        else:
+                            msg, tb = deserialize(payload)
+                            fut.set_exception(RemoteError(msg, tb))
+                elif kind == NOTIFY:
+                    handler = self._notify_handlers.get(method)
+                    if handler is not None:
+                        try:
+                            res = handler(deserialize(payload))
+                            if asyncio.iscoroutine(res):
+                                asyncio.ensure_future(res)
+                        except Exception:
+                            traceback.print_exc()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._teardown()
+
+    def _teardown(self):
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection closed"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        for cb in self.on_close:
+            try:
+                cb(self)
+            except Exception:
+                traceback.print_exc()
+
+    async def _handle_request(self, msgid: int, method: str, payload: bytes):
+        handlers = self.server_handlers or {}
+        try:
+            handler = handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            arg = deserialize(payload)
+            result = handler(self, arg)
+            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                result = await result
+            if self._chaos.should_drop():
+                return  # drop the reply: client sees a timeout
+            out = _frame(msgid, RESPONSE, method, serialize_to_bytes(result))
+        except Exception as e:
+            out = _frame(
+                msgid, ERROR, method,
+                serialize_to_bytes((f"{type(e).__name__}: {e}", traceback.format_exc())),
+            )
+        try:
+            self.writer.write(out)
+            await self.writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    async def call(self, method: str, arg: Any = None, timeout: float | None = None) -> Any:
+        if self.closed:
+            raise ConnectionLost("connection closed")
+        if timeout is None:
+            timeout = get_config().rpc_request_timeout_s
+        msgid = next(self._msgid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msgid] = fut
+        if self._chaos.should_drop():
+            pass  # drop the request on the floor: client sees a timeout
+        else:
+            self.writer.write(_frame(msgid, REQUEST, method, serialize_to_bytes(arg)))
+            await self.writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(msgid, None)
+            raise RpcError(f"rpc {method!r} timed out after {timeout}s") from None
+
+    async def notify(self, method: str, arg: Any = None):
+        """One-way message (used for pubsub pushes and fire-and-forget)."""
+        if self.closed:
+            raise ConnectionLost("connection closed")
+        self.writer.write(_frame(0, NOTIFY, method, serialize_to_bytes(arg)))
+        await self.writer.drain()
+
+    def on_notify(self, method: str, handler: Callable[[Any], None]):
+        self._notify_handlers[method] = handler
+
+    async def close(self):
+        if self._read_task is not None:
+            self._read_task.cancel()
+        self._teardown()
+
+    async def wait_closed(self):
+        await self._closed.wait()
+
+
+class RpcServer:
+    """Serves a handler table. Handlers: ``(conn, arg) -> result | awaitable``."""
+
+    def __init__(self, handlers: dict[str, Callable] | None = None):
+        self.handlers: dict[str, Callable] = dict(handlers or {})
+        self.connections: set[Connection] = set()
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    def add_handler(self, method: str, fn: Callable):
+        self.handlers[method] = fn
+
+    def add_service(self, obj: Any, prefix: str = ""):
+        """Register every ``rpc_*`` method of obj as ``<prefix><name>``."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.handlers[prefix + name[4:]] = getattr(obj, name)
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer)
+        conn.server_handlers = self.handlers
+        conn.on_close.append(lambda c: self.connections.discard(c))
+        self.connections.add(conn)
+        conn.start()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def connect(
+    host: str, port: int, *, handlers: dict[str, Callable] | None = None,
+    retries: int | None = None,
+) -> Connection:
+    """Dial a peer with retry/backoff (ref analog: retryable_grpc_client)."""
+    cfg = get_config()
+    if retries is None:
+        retries = cfg.rpc_max_retries
+    delay = cfg.rpc_retry_delay_s
+    last: Exception | None = None
+    for _ in range(retries + 1):
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), cfg.rpc_connect_timeout_s)
+            conn = Connection(reader, writer)
+            if handlers is not None:
+                conn.server_handlers = handlers
+            conn.start()
+            return conn
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            last = e
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 2.0)
+    raise ConnectionLost(f"could not connect to {host}:{port}: {last}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    The driver and workers are synchronous Python; all their RPC runs on
+    this loop (ref analog: the C++ io_service threads under core_worker).
+    """
+
+    def __init__(self, name: str = "rayt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float | None = None):
+        """Run a coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

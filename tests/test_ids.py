@@ -1,0 +1,43 @@
+import pickle
+
+from ray_tpu._internal.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                                   WorkerID)
+
+
+def test_lengths_and_roundtrip():
+    job = JobID.random()
+    actor = ActorID.of(job)
+    t_norm = TaskID.for_normal_task(job)
+    t_act = TaskID.for_actor_task(actor)
+    obj = ObjectID.for_return(t_norm, 3)
+
+    assert actor.job_id() == job
+    assert t_norm.job_id() == job
+    assert not t_norm.has_actor()
+    assert t_act.has_actor()
+    assert t_act.actor_id() == actor
+    assert obj.task_id() == t_norm
+    assert obj.index() == 3
+    assert obj.job_id() == job
+
+
+def test_put_vs_return_distinct():
+    t = TaskID.for_normal_task(JobID.random())
+    assert ObjectID.for_put(t, 1) != ObjectID.for_return(t, 1)
+    assert ObjectID.for_put(t, 1).task_id() == t
+
+
+def test_hex_pickle_hash():
+    for cls in (JobID, NodeID, WorkerID, ActorID, TaskID):
+        x = cls.random()
+        assert cls.from_hex(x.hex()) == x
+        assert pickle.loads(pickle.dumps(x)) == x
+        assert hash(x) == hash(cls(x.binary()))
+        assert not x.is_nil()
+        assert cls.nil().is_nil()
+
+
+def test_cross_type_inequality():
+    n = NodeID.random()
+    w = WorkerID(n.binary())
+    assert n != w

@@ -1,0 +1,149 @@
+import asyncio
+
+import numpy as np
+import pytest
+
+from ray_tpu._internal import rpc
+
+
+async def _start_echo_server():
+    server = rpc.RpcServer()
+
+    async def echo(conn, arg):
+        return arg
+
+    def double(conn, arg):
+        return arg * 2
+
+    async def fail(conn, arg):
+        raise RuntimeError("kaboom")
+
+    server.add_handler("echo", echo)
+    server.add_handler("double", double)
+    server.add_handler("fail", fail)
+    port = await server.start()
+    return server, port
+
+
+def test_request_response():
+    async def main():
+        server, port = await _start_echo_server()
+        conn = await rpc.connect("127.0.0.1", port)
+        assert await conn.call("echo", {"x": 1}) == {"x": 1}
+        assert await conn.call("double", 21) == 42
+        arr = np.arange(100.0)
+        np.testing.assert_array_equal(await conn.call("echo", arr), arr)
+        await conn.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_remote_error_propagates():
+    async def main():
+        server, port = await _start_echo_server()
+        conn = await rpc.connect("127.0.0.1", port)
+        with pytest.raises(rpc.RemoteError, match="kaboom"):
+            await conn.call("fail")
+        # connection still usable after a handler error
+        assert await conn.call("double", 2) == 4
+        await conn.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_notify_push():
+    async def main():
+        server, port = await _start_echo_server()
+        got = asyncio.Event()
+        received = []
+
+        async def subscribe(conn, arg):
+            # server pushes a notify back on the same connection
+            await conn.notify("update", {"seq": 7})
+            return "ok"
+
+        server.add_handler("subscribe", subscribe)
+        conn = await rpc.connect("127.0.0.1", port)
+
+        def on_update(msg):
+            received.append(msg)
+            got.set()
+
+        conn.on_notify("update", on_update)
+        assert await conn.call("subscribe") == "ok"
+        await asyncio.wait_for(got.wait(), 5)
+        assert received == [{"seq": 7}]
+        await conn.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_connection_lost_fails_pending():
+    async def main():
+        server = rpc.RpcServer()
+
+        async def hang(conn, arg):
+            await asyncio.sleep(30)
+
+        server.add_handler("hang", hang)
+        port = await server.start()
+        conn = await rpc.connect("127.0.0.1", port)
+        task = asyncio.ensure_future(conn.call("hang"))
+        await asyncio.sleep(0.05)
+        await server.stop()
+        with pytest.raises((rpc.ConnectionLost, rpc.RpcError)):
+            await asyncio.wait_for(task, 5)
+
+    asyncio.run(main())
+
+
+def test_concurrent_calls_multiplex():
+    async def main():
+        server = rpc.RpcServer()
+
+        async def slow_id(conn, arg):
+            await asyncio.sleep(0.05 * (5 - arg))
+            return arg
+
+        server.add_handler("slow_id", slow_id)
+        port = await server.start()
+        conn = await rpc.connect("127.0.0.1", port)
+        results = await asyncio.gather(*[conn.call("slow_id", i) for i in range(5)])
+        assert results == list(range(5))
+        await conn.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_event_loop_thread():
+    elt = rpc.EventLoopThread()
+    try:
+        server, port = elt.run(_start_echo_server())
+        conn = elt.run(rpc.connect("127.0.0.1", port))
+        assert elt.run(conn.call("double", 5)) == 10
+        elt.run(server.stop())
+    finally:
+        elt.stop()
+
+
+def test_chaos_dropped_requests_timeout(monkeypatch):
+    from ray_tpu._internal import config as config_mod
+
+    cfg = config_mod.Config(testing_rpc_failure_prob=1.0,
+                            rpc_request_timeout_s=0.2)
+    monkeypatch.setattr(config_mod, "_config", cfg)
+
+    async def main():
+        server, port = await _start_echo_server()
+        conn = await rpc.connect("127.0.0.1", port)
+        with pytest.raises(rpc.RpcError, match="timed out"):
+            await conn.call("echo", 1, timeout=0.2)
+        await conn.close()
+        await server.stop()
+
+    asyncio.run(main())
+    monkeypatch.setattr(config_mod, "_config", None)

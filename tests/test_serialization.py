@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+from ray_tpu._internal.serialization import (deserialize, serialize,
+                                             serialize_to_bytes,
+                                             serialized_size)
+
+
+def test_roundtrip_simple():
+    for obj in [1, "x", {"a": [1, 2, (3, None)]}, b"bytes", 3.5, True]:
+        assert deserialize(serialize_to_bytes(obj)) == obj
+
+
+def test_roundtrip_numpy_zero_copy():
+    arr = np.arange(1 << 16, dtype=np.float32).reshape(256, 256)
+    blob = serialize_to_bytes({"w": arr, "tag": "t"})
+    out = deserialize(blob)
+    np.testing.assert_array_equal(out["w"], arr)
+    # the deserialized array must be a view over the input buffer, not a copy
+    assert not out["w"].flags.owndata
+
+
+def test_chunks_size_accounting():
+    arr = np.ones(1000, dtype=np.int64)
+    chunks = serialize(arr)
+    assert serialized_size(chunks) == len(b"".join(bytes(c) for c in chunks))
+
+
+def test_lambda_and_closure():
+    y = 41
+
+    def f(x):
+        return x + y
+
+    g = deserialize(serialize_to_bytes(f))
+    assert g(1) == 42
+
+
+def test_exception_roundtrip():
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        err = e
+    out = deserialize(serialize_to_bytes(err))
+    assert isinstance(out, ValueError) and str(out) == "boom"
+
+
+def test_unaligned_buffer_sizes():
+    for n in [1, 7, 8, 9, 127]:
+        arr = np.frombuffer(bytes(range(n % 256)) * 1, dtype=np.uint8) if n < 256 else None
+        arr = np.arange(n, dtype=np.uint8)
+        out = deserialize(serialize_to_bytes([arr, arr]))
+        np.testing.assert_array_equal(out[0], arr)
+        np.testing.assert_array_equal(out[1], arr)
