@@ -1,8 +1,37 @@
 """ray_tpu: a TPU-native distributed AI framework.
 
 Tasks/actors/objects core under a JAX/XLA compute path. See SURVEY.md for
-the blueprint; API mirrors the reference (LydiaXwQ/ray) where it makes sense
-and diverges where TPU hardware demands it.
+the blueprint; the API mirrors the reference (LydiaXwQ/ray) where that helps
+users migrate, and diverges where TPU hardware demands it (mesh-first
+collectives, gang scheduling by default, device arrays as first-class
+values that never leave HBM).
+
+Core surface:
+    import ray_tpu as rt
+    rt.init()
+    @rt.remote
+    def f(x): return x * 2
+    rt.get(f.remote(2))
 """
 
 __version__ = "0.1.0"
+
+from ray_tpu.api import (ActorClass, ActorHandle, PlacementGroup,  # noqa: F401
+                         available_resources, cluster_resources, get,
+                         get_actor, kill, nodes, placement_group, put, remote,
+                         remove_placement_group, wait)
+from ray_tpu.core.common import (ActorDiedError, GetTimeoutError,  # noqa: F401
+                                 NodeAffinitySchedulingStrategy, ObjectLostError,
+                                 PlacementGroupSchedulingStrategy, RayTpuError,
+                                 TaskError, WorkerCrashedError)
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.runtime import init, is_initialized, shutdown  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy heavyweight submodules (keep `import ray_tpu` jax-free).
+    if name in ("train", "tune", "serve", "data", "rl", "collective", "util"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

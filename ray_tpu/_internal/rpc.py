@@ -91,6 +91,14 @@ class Connection:
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self.reader = reader
         self.writer = writer
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                import socket as _socket
+
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         self._msgid = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._notify_handlers: dict[str, Callable[[Any], None]] = {}

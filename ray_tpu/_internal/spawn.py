@@ -1,0 +1,55 @@
+"""Fast process spawning for cluster daemons and workers.
+
+The interpreter's `site` import can be arbitrarily expensive (on TPU VMs a
+sitecustomize hook typically registers the PJRT plugin and imports jax —
+~2s). Daemons and workers must boot in ~100ms for lease latency to be sane
+(ref analog: raylet pre-forked worker pool exists for the same reason,
+worker_pool.h:212), so we spawn children with ``python -S`` and put the
+site-packages dirs on PYTHONPATH explicitly. Processes that may need jax
+later call :func:`import_site_background` right after registration, which
+replays sitecustomize on a daemon thread (the import lock makes a
+concurrent task-triggered jax import safe).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import sysconfig
+import threading
+
+
+def fast_python_argv(module: str) -> list[str]:
+    return [sys.executable, "-S", "-m", module]
+
+
+def child_env(pkg_root: str, base: dict | None = None) -> dict:
+    env = dict(base if base is not None else os.environ)
+    paths = [pkg_root]
+    for key in ("purelib", "platlib"):
+        p = sysconfig.get_paths().get(key)
+        if p and p not in paths:
+            paths.append(p)
+    # any extra dirs site added (e.g. .pth expansions) that hold importable
+    # top-level modules like sitecustomize itself
+    for p in sys.path:
+        if p and p.endswith("site-packages") and p not in paths:
+            paths.append(p)
+    if base is None or "PYTHONPATH" in env:
+        existing = env.get("PYTHONPATH", "")
+        if existing:
+            paths.append(existing)
+    env["PYTHONPATH"] = os.pathsep.join(paths)
+    return env
+
+
+def import_site_background():
+    """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path."""
+
+    def _go():
+        try:
+            import sitecustomize  # noqa: F401
+        except Exception:
+            pass
+
+    threading.Thread(target=_go, name="rayt-site-import", daemon=True).start()

@@ -1,0 +1,282 @@
+"""Public tasks/actors/objects API (ref analogs:
+python/ray/remote_function.py:303, python/ray/actor.py, worker.py get/put/
+wait). `import ray_tpu as rt; @rt.remote` mirrors the reference surface."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu._internal.ids import ActorID, PlacementGroupID
+from ray_tpu.core.common import (ActorOptions, ActorState, ResourceSpec,
+                                 TaskOptions)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import get_runtime_context
+
+
+def _core_worker():
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    if cw is not None:
+        return cw  # inside a worker process, or an initialized driver
+    return get_runtime_context().core_worker
+
+
+def _make_resources(num_cpus=None, num_tpus=None, memory=None,
+                    resources=None) -> ResourceSpec:
+    return ResourceSpec(
+        num_cpus=1.0 if num_cpus is None else float(num_cpus),
+        tpu=float(num_tpus or 0),
+        memory=float(memory or 0),
+        custom=dict(resources or {}))
+
+
+class RemoteFunction:
+    def __init__(self, fn, **opts):
+        self._fn = fn
+        self._opts = opts
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return RemoteFunction(self._fn, **merged)
+
+    def _task_options(self) -> TaskOptions:
+        o = self._opts
+        return TaskOptions(
+            resources=_make_resources(
+                o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
+                o.get("resources")),
+            max_retries=o.get("max_retries", -1),
+            retry_exceptions=bool(o.get("retry_exceptions", False)),
+            num_returns=o.get("num_returns", 1),
+            name=o.get("name", ""),
+            scheduling_strategy=o.get("scheduling_strategy"),
+            runtime_env=o.get("runtime_env"))
+
+    def remote(self, *args, **kwargs):
+        refs = _core_worker().submit_task(
+            self._fn, args, kwargs, self._task_options())
+        if self._task_options().num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called "
+            "directly; use .remote()")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 max_retries: int = -1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+        self._max_retries = max_retries
+
+    def options(self, num_returns: int | None = None,
+                max_retries: int | None = None, **_):
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            self._max_retries if max_retries is None else max_retries)
+
+    def remote(self, *args, **kwargs):
+        opts = TaskOptions(num_returns=self._num_returns,
+                           max_retries=(self._handle._max_task_retries
+                                        if self._max_retries < 0
+                                        else self._max_retries))
+        refs = _core_worker().submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs, opts)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "",
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._class_name, self._max_task_retries))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls, **opts):
+        self._cls = cls
+        self._opts = opts
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ActorClass(self._cls, **merged)
+
+    def _actor_options(self) -> ActorOptions:
+        o = self._opts
+        return ActorOptions(
+            resources=_make_resources(
+                o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
+                o.get("resources")),
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            name=o.get("name", ""),
+            namespace=o.get("namespace", ""),
+            lifetime=o.get("lifetime", ""),
+            max_concurrency=o.get("max_concurrency", 1),
+            scheduling_strategy=o.get("scheduling_strategy"),
+            runtime_env=o.get("runtime_env"))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        opts = self._actor_options()
+        actor_id = _core_worker().create_actor(self._cls, args, kwargs, opts)
+        return ActorHandle(actor_id, self._cls.__name__,
+                           opts.max_task_retries)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly; use .remote()")
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes, with or without
+    options: @remote / @remote(num_cpus=2, num_tpus=1, ...)."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    return wrap
+
+
+def get(refs, timeout: float | None = None):
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    if not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("ray_tpu.get() accepts ObjectRef or list of ObjectRef")
+    values = _core_worker().get(list(refs), timeout=timeout)
+    return values[0] if single else values
+
+
+def put(value) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("calling put() on an ObjectRef is not allowed")
+    return _core_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None):
+    if not refs:
+        return [], []
+    return _core_worker().wait(list(refs), num_returns=num_returns,
+                               timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _core_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    cw = _core_worker()
+    res = cw.io.run(cw.gcs.get_named_actor(name, namespace))
+    if res is None:
+        raise ValueError(f"no actor named {name!r}")
+    info, spec = res
+    if info.state == ActorState.DEAD:
+        raise ValueError(f"actor {name!r} is dead")
+    opts = spec.actor_options if spec is not None else None
+    return ActorHandle(info.actor_id, info.class_name,
+                       opts.max_task_retries if opts else 0)
+
+
+def available_resources() -> dict:
+    cw = _core_worker()
+    view = cw.io.run(cw.gcs.get_cluster_resources())
+    out: dict[str, float] = {}
+    for v in view.values():
+        if not v.get("alive"):
+            continue
+        for r, amt in v.get("available", {}).items():
+            out[r] = out.get(r, 0.0) + amt
+    return out
+
+
+def cluster_resources() -> dict:
+    cw = _core_worker()
+    view = cw.io.run(cw.gcs.get_cluster_resources())
+    out: dict[str, float] = {}
+    for v in view.values():
+        if not v.get("alive"):
+            continue
+        for r, amt in v.get("total", {}).items():
+            out[r] = out.get(r, 0.0) + amt
+    return out
+
+
+def nodes() -> list:
+    cw = _core_worker()
+    return cw.io.run(cw.gcs.get_all_nodes())
+
+
+# ----------------------------------------------------------- placement groups
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles, strategy, placement):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.placement = placement  # list[NodeID] per bundle
+
+    def bundle_strategy(self, bundle_index: int = -1):
+        from ray_tpu.core.common import PlacementGroupSchedulingStrategy
+
+        return PlacementGroupSchedulingStrategy(self.id, bundle_index)
+
+    def __reduce__(self):
+        return (PlacementGroup,
+                (self.id, self.bundles, self.strategy, self.placement))
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    timeout: float = 60.0) -> PlacementGroup:
+    """Gang reservation (ref: util/placement_group.py:145). Blocks until
+    reserved or raises. Bundles are resource dicts, e.g. [{"TPU": 4}] * 4."""
+    import time
+
+    cw = _core_worker()
+    pg_id = PlacementGroupID.random()
+    deadline = time.monotonic() + timeout
+    while True:
+        placement = cw.io.run(cw.gcs.conn.call(
+            "create_placement_group", (pg_id, bundles, strategy)))
+        if placement is not None:
+            return PlacementGroup(pg_id, bundles, strategy, placement)
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"placement group {bundles} ({strategy}) not satisfiable")
+        time.sleep(0.2)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    cw = _core_worker()
+    cw.io.run(cw.gcs.conn.call("remove_placement_group", pg.id))

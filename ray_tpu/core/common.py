@@ -1,0 +1,198 @@
+"""Shared control-plane types: addresses, task specs, resources, options.
+
+TPU-native analog of ref src/ray/common/task/task_spec.h:258 and
+python/ray/_private/ray_option_utils.py. These are plain dataclasses carried
+over the RPC layer (pickle-5), the one-language replacement for the
+reference's protobuf TaskSpec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from ray_tpu._internal.ids import (ActorID, JobID, NodeID, ObjectID,
+                                   PlacementGroupID, TaskID, WorkerID)
+
+
+@dataclasses.dataclass(frozen=True)
+class Address:
+    """Where to reach a process's RPC server."""
+    host: str
+    port: int
+
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclasses.dataclass
+class ResourceSpec:
+    """Resource demand of a task/actor. TPU chips are first-class: `tpu`
+    counts chips on the host; custom covers pod-slice head resources like
+    'TPU-v5p-16-head' (ref: python/ray/_private/accelerators/tpu.py:197)."""
+    num_cpus: float = 1.0
+    tpu: float = 0.0
+    memory: float = 0.0
+    custom: dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def to_demand(self) -> dict[str, float]:
+        d = {}
+        if self.num_cpus:
+            d["CPU"] = self.num_cpus
+        if self.tpu:
+            d["TPU"] = self.tpu
+        if self.memory:
+            d["memory"] = self.memory
+        d.update(self.custom)
+        return d
+
+
+@dataclasses.dataclass
+class TaskOptions:
+    resources: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
+    max_retries: int = -1            # -1 = use config default
+    retry_exceptions: bool = False
+    num_returns: int = 1
+    name: str = ""
+    scheduling_strategy: Any = None  # None | "SPREAD" | PlacementGroupSchedulingStrategy
+    runtime_env: dict | None = None
+
+
+@dataclasses.dataclass
+class ActorOptions:
+    resources: ResourceSpec = dataclasses.field(default_factory=ResourceSpec)
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    name: str = ""                   # named actor (GCS-registered)
+    namespace: str = ""
+    lifetime: str = ""               # "" | "detached"
+    max_concurrency: int = 1
+    scheduling_strategy: Any = None
+    runtime_env: dict | None = None
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """Everything a worker needs to run one task (ref: task_spec.h:258)."""
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # Pickled function (normal task) or (method name, args) for actor tasks.
+    function_blob: bytes | None
+    args: list[Any]                  # mix of inline values and ObjectRefMeta
+    kwargs: dict[str, Any]
+    num_returns: int
+    resources: dict[str, float]
+    owner: "WorkerInfo"
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Actor-task fields:
+    actor_id: ActorID | None = None
+    method_name: str = ""
+    seq_no: int = -1                 # per-caller ordering for actor tasks
+    # Actor-creation fields:
+    is_actor_creation: bool = False
+    actor_options: ActorOptions | None = None
+    scheduling_strategy: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerInfo:
+    worker_id: WorkerID
+    node_id: NodeID
+    address: Address                 # the worker's own RPC server
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: Address                 # node manager RPC server
+    resources_total: dict[str, float]
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    alive: bool = True
+    # TPU topology hints for slice-aware gang scheduling:
+    slice_name: str = ""
+    slice_worker_index: int = -1
+
+
+class ActorState:
+    PENDING = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclasses.dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str
+    namespace: str
+    state: str
+    address: Address | None          # actor worker RPC server when ALIVE
+    worker_id: WorkerID | None
+    node_id: NodeID | None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    class_name: str = ""
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    """Owner-side record of where an object lives."""
+    object_id: ObjectID
+    size: int = -1                   # -1 = unknown/pending
+    inline: bool = False             # small object stored in owner memory
+    in_shm: bool = False
+    node_ids: list[NodeID] = dataclasses.field(default_factory=list)
+    error: Any = None                # stored exception, if task failed
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group_id: PlacementGroupID
+    bundle_index: int = -1           # -1 = any bundle
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: NodeID
+    soft: bool = False
+
+
+def now() -> float:
+    return time.time()
+
+
+class RayTpuError(Exception):
+    """Base class for framework errors (ref analog: RayError hierarchy)."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an application exception raised in a task; re-raised on get."""
+
+    def __init__(self, cause: BaseException, task_name: str = "",
+                 remote_traceback: str = ""):
+        super().__init__(f"task {task_name!r} failed: {cause!r}")
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id, cause: str = ""):
+        super().__init__(f"actor {actor_id} died: {cause}")
+        self.actor_id = actor_id
+        self.cause = cause
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError):
+    pass

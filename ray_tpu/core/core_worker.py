@@ -1,0 +1,965 @@
+"""CoreWorker — the per-process runtime (driver and workers alike).
+
+Ref analog: src/ray/core_worker/core_worker.h:166 plus its transport stack
+(normal_task_submitter.h:108, actor_task_submitter.h:75, scheduling
+queues), task_manager.h:212 (retries), memory_store.h:42.
+
+Threading model: user code runs on its own threads and calls the sync API,
+which hops onto a dedicated asyncio IO loop (EventLoopThread — the analog
+of the C++ io_service threads). Task execution happens on executor
+threads; async actors get their own asyncio loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any
+
+import cloudpickle
+
+from ray_tpu._internal.config import get_config
+from ray_tpu._internal.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                                   WorkerID)
+from ray_tpu._internal.logging_utils import setup_logger
+from ray_tpu._internal.rpc import (Connection, ConnectionLost, RemoteError,
+                                   RpcError, RpcServer, EventLoopThread,
+                                   connect)
+from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
+from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
+                                 GetTimeoutError, ObjectLostError, ObjectMeta,
+                                 TaskError, TaskSpec, WorkerCrashedError,
+                                 WorkerInfo)
+from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
+from ray_tpu.core.object_ref import ObjectRef, set_core_worker
+from ray_tpu.core.object_store import MemoryStore, ShmObjectStore
+from ray_tpu.core.reference_counter import ReferenceCounter
+
+logger = setup_logger("core_worker")
+
+_TASK_PUSH_TIMEOUT = 7 * 24 * 3600.0
+
+
+@dataclass
+class RefArg:
+    """Marker for an ObjectRef positioned as a top-level task argument."""
+    object_id: ObjectID
+    owner: WorkerInfo | None
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    pinned: list[ObjectID] = field(default_factory=list)
+    done: bool = False
+
+
+class _ExecutionContext(threading.local):
+    task_id: TaskID | None = None
+
+
+class CoreWorker:
+    def __init__(self, mode: str, job_id: JobID, gcs_address: Address,
+                 node_address: Address, node_id: NodeID):
+        assert mode in ("driver", "worker")
+        self.mode = mode
+        self.job_id = job_id
+        self.gcs_address = gcs_address
+        self.node_address = node_address
+        self.node_id = node_id
+        self.worker_id = WorkerID.random()
+        self.io = EventLoopThread()
+        self.server = RpcServer()
+        self.server.add_service(self)
+        self.memory_store = MemoryStore(self.io.loop)
+        self.shm = ShmObjectStore()
+        self.object_meta: dict[ObjectID, ObjectMeta] = {}
+        self._object_events: dict[ObjectID, asyncio.Event] = {}
+        self.pending_tasks: dict[TaskID, _PendingTask] = {}
+        self._return_to_task: dict[ObjectID, TaskID] = {}
+        self.reference_counter = ReferenceCounter(
+            is_owner=self._owns, free_fn=self._free_object,
+            notify_owner_fn=self._notify_owner_refcount)
+        self.root_task_id = TaskID.for_normal_task(job_id)
+        self._exec_ctx = _ExecutionContext()
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+        self._conns: dict[str, Connection] = {}
+        self._conn_locks: dict[str, asyncio.Lock] = {}
+        self._node_addrs: dict[NodeID, Address] = {}
+        self._lease_cache: dict[tuple, list] = {}
+        self._actor_submitters: dict[ActorID, _ActorTaskSubmitter] = {}
+        # worker-mode execution state
+        self.executor = ThreadPoolExecutor(max_workers=1,
+                                           thread_name_prefix="rayt-exec")
+        self.actor_instance = None
+        self.actor_id: ActorID | None = None
+        self._actor_async_loop: EventLoopThread | None = None
+        self._actor_seq_state: dict[str, dict] = {}
+        self._shutdown = False
+        self.gcs: GcsClient | None = None
+        self.node_conn: Connection | None = None
+        self.worker_info: WorkerInfo | None = None
+
+    # ------------------------------------------------------------ bootstrap
+    def connect_cluster(self):
+        self.io.run(self._async_connect())
+        set_core_worker(self)
+
+    async def _async_connect(self):
+        host = "127.0.0.1"
+        port = await self.server.start(host, 0)
+        self.worker_info = WorkerInfo(self.worker_id, self.node_id,
+                                      Address(host, port))
+        self.gcs = await GcsClient.connect(self.gcs_address)
+        self.node_conn = await connect(self.node_address.host,
+                                       self.node_address.port)
+        for n in await self.gcs.get_all_nodes():
+            self._node_addrs[n.node_id] = n.address
+
+        def on_node_event(msg):
+            info = msg["node"]
+            if msg["event"] == "added":
+                self._node_addrs[info.node_id] = info.address
+
+        await self.gcs.subscribe(CH_NODE, on_node_event)
+
+        def on_actor_event(info):
+            sub = self._actor_submitters.get(info.actor_id)
+            if sub is not None:
+                asyncio.ensure_future(sub.on_actor_update(info))
+
+        await self.gcs.subscribe(CH_ACTOR, on_actor_event)
+        if self.mode == "worker":
+            await self.node_conn.call(
+                "register_worker", (self.worker_info, os.getpid()))
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        set_core_worker(None)
+        try:
+            self.io.run(self._async_shutdown(), timeout=5)
+        except Exception:
+            pass
+        self.executor.shutdown(wait=False)
+        self.io.stop()
+
+    async def _async_shutdown(self):
+        for conn in self._conns.values():
+            await conn.close()
+        if self.gcs is not None:
+            await self.gcs.close()
+        if self.node_conn is not None:
+            await self.node_conn.close()
+        await self.server.stop()
+        self.shm.close()
+
+    # ---------------------------------------------------------- connections
+    async def _conn_to(self, address: Address) -> Connection:
+        key = address.key()
+        lock = self._conn_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(key)
+            if conn is None or conn.closed:
+                conn = await connect(address.host, address.port)
+                self._conns[key] = conn
+            return conn
+
+    # ------------------------------------------------------------ ownership
+    def _owns(self, oid: ObjectID) -> bool:
+        meta = self.object_meta.get(oid)
+        if meta is not None or self.memory_store.contains(oid):
+            return True
+        return oid in self._return_to_task
+
+    def current_task_id(self) -> TaskID:
+        return self._exec_ctx.task_id or self.root_task_id
+
+    def _free_object(self, oid: ObjectID):
+        self.memory_store.delete(oid)
+        meta = self.object_meta.pop(oid, None)
+        tid = self._return_to_task.pop(oid, None)
+        if tid is not None:
+            pt = self.pending_tasks.get(tid)
+            if pt is not None and pt.done:
+                self.pending_tasks.pop(tid, None)
+        if meta is not None and meta.in_shm:
+            async def _free():
+                try:
+                    for nid in meta.node_ids:
+                        if nid == self.node_id:
+                            await self.node_conn.call("free_object", oid)
+                        else:
+                            addr = self._node_addrs.get(nid)
+                            if addr is not None:
+                                c = await self._conn_to(addr)
+                                await c.call("free_object", oid)
+                except Exception:
+                    pass
+            self.io.spawn(_free())
+
+    def _notify_owner_refcount(self, oid: ObjectID, owner, kind: str):
+        if owner is None:
+            return
+
+        async def _send():
+            try:
+                conn = await self._conn_to(owner.address)
+                await conn.notify(kind, (oid, self.worker_info.address.key()))
+            except Exception:
+                pass
+        try:
+            self.io.spawn(_send())
+        except Exception:
+            pass
+
+    def rpc_add_borrower(self, conn, arg):
+        oid, key = arg
+        self.reference_counter.add_borrower(oid, key)
+
+    def rpc_remove_borrower(self, conn, arg):
+        oid, key = arg
+        self.reference_counter.remove_borrower(oid, key)
+
+    # ---------------------------------------------------------------- put
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(self.current_task_id(), idx)
+        self._store_owned_value(oid, value)
+        return ObjectRef(oid, self.worker_info)
+
+    def _store_owned_value(self, oid: ObjectID, value: Any,
+                           is_exception: bool = False):
+        cfg = get_config()
+        blob = None
+        try:
+            blob = serialize_to_bytes(value)
+        except Exception as e:
+            value = TaskError(e, "serialization", traceback.format_exc())
+            is_exception = True
+        if blob is not None and len(blob) > cfg.max_direct_call_object_size \
+                and not is_exception:
+            self.shm.create_from_bytes(oid, blob)
+            meta = ObjectMeta(oid, size=len(blob), in_shm=True,
+                              node_ids=[self.node_id])
+            self.object_meta[oid] = meta
+            self.io.spawn(self.node_conn.call(
+                "object_created", (oid, len(blob), self.worker_info)))
+        else:
+            self.memory_store.put(oid, value, is_exception)
+            self.object_meta[oid] = ObjectMeta(
+                oid, size=len(blob) if blob else -1, inline=True)
+        self._signal_object_ready(oid)
+
+    def _signal_object_ready(self, oid: ObjectID):
+        def _set():
+            ev = self._object_events.pop(oid, None)
+            if ev is not None:
+                ev.set()
+        self.io.loop.call_soon_threadsafe(_set)
+
+    # ---------------------------------------------------------------- get
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list:
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _get_all():
+            return await asyncio.gather(
+                *[self._async_get(r, deadline) for r in refs])
+
+        values = self.io.run(_get_all())
+        out = []
+        for v, kind in values:
+            if kind == "exc":
+                if isinstance(v, TaskError):
+                    raise v
+                raise v
+            if kind == "blob":
+                v = deserialize(v)
+                if isinstance(v, BaseException):
+                    raise v
+            out.append(v)
+        return out
+
+    async def _async_get(self, ref: ObjectRef, deadline: float | None):
+        oid = ref.id
+        while True:
+            # 1. owner-local inline
+            obj = self.memory_store.get_if_exists(oid)
+            if obj is not None:
+                return (obj.value, "exc" if obj.is_exception else "val")
+            meta = self.object_meta.get(oid)
+            if meta is not None and meta.error is not None:
+                return (meta.error, "exc")
+            # 2. node-local shm
+            if meta is not None and meta.in_shm:
+                return (self.shm.read_bytes(oid, meta.size), "blob")
+            if self.shm.contains_locally(oid):
+                info = await self.node_conn.call("object_lookup", oid)
+                if info is not None:
+                    return (self.shm.read_bytes(oid, info["size"]), "blob")
+            if self._owns(oid):
+                # pending task return: wait for completion signal
+                ok = await self._wait_object_event(oid, deadline)
+                if not ok:
+                    raise GetTimeoutError(f"get({oid}) timed out")
+                continue
+            # 3. remote owner
+            if ref.owner is None:
+                raise ObjectLostError(f"{oid} has no known owner")
+            res = await self._remote_status(ref, wait_s=self._poll_budget(deadline))
+            kind = res[0]
+            if kind == "inline":
+                _, blob, is_exc = res
+                val = deserialize(blob)
+                return (val, "exc" if is_exc else "val")
+            if kind == "shm":
+                _, size, locations = res
+                if not self.shm.contains_locally(oid):
+                    pulled = False
+                    for nid, addr in locations:
+                        if nid == self.node_id:
+                            continue
+                        ok = await self.node_conn.call(
+                            "store_remote_object",
+                            (oid, size, ref.owner, addr), timeout=300)
+                        if ok:
+                            pulled = True
+                            break
+                    if not pulled and not self.shm.contains_locally(oid):
+                        raise ObjectLostError(f"could not pull {oid}")
+                return (self.shm.read_bytes(oid, size), "blob")
+            if kind == "pending":
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"get({oid}) timed out")
+                continue
+            raise ObjectLostError(f"{oid}: owner reports {kind}")
+
+    def _poll_budget(self, deadline: float | None) -> float:
+        if deadline is None:
+            return 5.0
+        return max(0.05, min(5.0, deadline - time.monotonic()))
+
+    async def _remote_status(self, ref: ObjectRef, wait_s: float):
+        conn = await self._conn_to(ref.owner.address)
+        return await conn.call("get_object", (ref.id, wait_s),
+                               timeout=wait_s + 30.0)
+
+    async def _wait_object_event(self, oid: ObjectID,
+                                 deadline: float | None) -> bool:
+        ev = self._object_events.get(oid)
+        if ev is None:
+            ev = asyncio.Event()
+            self._object_events[oid] = ev
+        # re-check after registering to avoid lost wakeups
+        if self.memory_store.contains(oid) or (
+                self.object_meta.get(oid) is not None
+                and not self._is_pending(oid)):
+            return True
+        try:
+            budget = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            await asyncio.wait_for(ev.wait(), budget)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def _is_pending(self, oid: ObjectID) -> bool:
+        meta = self.object_meta.get(oid)
+        if meta is not None:
+            return meta.size == -1 and not meta.inline and meta.error is None
+        tid = self._return_to_task.get(oid)
+        if tid is None:
+            return False
+        pt = self.pending_tasks.get(tid)
+        return pt is not None and not pt.done
+
+    async def rpc_get_object(self, conn, arg):
+        """Owner-side object status/fetch (long-poll when pending)."""
+        oid, wait_s = arg
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while True:
+            obj = self.memory_store.get_if_exists(oid)
+            if obj is not None:
+                return ("inline", serialize_to_bytes(obj.value), obj.is_exception)
+            meta = self.object_meta.get(oid)
+            if meta is not None and meta.error is not None:
+                return ("inline", serialize_to_bytes(meta.error), True)
+            if meta is not None and meta.in_shm:
+                locs = [(nid, self._node_addrs.get(nid)) for nid in meta.node_ids
+                        if self._node_addrs.get(nid) is not None]
+                return ("shm", meta.size, locs)
+            if self._is_pending(oid):
+                if time.monotonic() >= deadline:
+                    return ("pending",)
+                ok = await self._wait_object_event(oid, deadline)
+                if not ok:
+                    return ("pending",)
+                continue
+            return ("unknown",)
+
+    # --------------------------------------------------------------- wait
+    def wait(self, refs: list[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _status(ref: ObjectRef) -> bool:
+            oid = ref.id
+            if self.memory_store.contains(oid):
+                return True
+            meta = self.object_meta.get(oid)
+            if meta is not None:
+                return not self._is_pending(oid)
+            if self._owns(oid):
+                return not self._is_pending(oid)
+            if self.shm.contains_locally(oid):
+                return True
+            try:
+                res = await self._remote_status(ref, wait_s=0.0)
+                return res[0] not in ("pending",)
+            except Exception:
+                return False
+
+        async def _wait_loop():
+            while True:
+                statuses = await asyncio.gather(*[_status(r) for r in refs])
+                ready = [r for r, s in zip(refs, statuses) if s]
+                if len(ready) >= num_returns or (
+                        deadline is not None
+                        and time.monotonic() >= deadline):
+                    ready_set = {r.id for r in ready}
+                    not_ready = [r for r in refs if r.id not in ready_set]
+                    return ready, not_ready
+                await asyncio.sleep(0.01)
+
+        return self.io.run(_wait_loop())
+
+    # ------------------------------------------------------ task submission
+    def submit_task(self, function: Any, args: tuple, kwargs: dict,
+                    options) -> list[ObjectRef]:
+        task_id = TaskID.for_normal_task(self.job_id)
+        spec_args, pinned = self._prepare_args(args)
+        spec_kwargs, pinned_kw = self._prepare_args(kwargs)
+        cfg = get_config()
+        max_retries = options.max_retries
+        if max_retries < 0:
+            max_retries = cfg.default_max_retries
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id,
+            name=options.name or getattr(function, "__name__", "task"),
+            function_blob=cloudpickle.dumps(function),
+            args=spec_args, kwargs=spec_kwargs,
+            num_returns=options.num_returns,
+            resources=self._demand_for(options),
+            owner=self.worker_info, max_retries=max_retries,
+            retry_exceptions=options.retry_exceptions,
+            scheduling_strategy=options.scheduling_strategy)
+        refs = self._register_task(spec, pinned + pinned_kw)
+        self.io.spawn(self._run_normal_task(spec))
+        return refs
+
+    def _demand_for(self, options) -> dict[str, float]:
+        from ray_tpu.core.common import PlacementGroupSchedulingStrategy
+        demand = options.resources.to_demand()
+        strat = options.scheduling_strategy
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            # rewrite demand onto the PG's reserved bundle resources
+            pgid = strat.placement_group_id
+            idx = strat.bundle_index
+            if idx >= 0:
+                demand = {f"{r}_pg_{pgid.hex()}_{idx}": amt
+                          for r, amt in demand.items()}
+        return demand
+
+    def _prepare_args(self, args):
+        pinned: list[ObjectID] = []
+        if isinstance(args, dict):
+            out = {}
+            for k, v in args.items():
+                if isinstance(v, ObjectRef):
+                    out[k] = RefArg(v.id, v.owner)
+                    self.reference_counter.add_task_pin(v.id)
+                    pinned.append(v.id)
+                else:
+                    out[k] = v
+            return out, pinned
+        out = []
+        for v in args:
+            if isinstance(v, ObjectRef):
+                out.append(RefArg(v.id, v.owner))
+                self.reference_counter.add_task_pin(v.id)
+                pinned.append(v.id)
+            else:
+                out.append(v)
+        return out, pinned
+
+    def _register_task(self, spec: TaskSpec, pinned) -> list[ObjectRef]:
+        pt = _PendingTask(spec=spec, retries_left=spec.max_retries,
+                          pinned=pinned)
+        self.pending_tasks[spec.task_id] = pt
+        refs = []
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_return(spec.task_id, i)
+            self._return_to_task[oid] = spec.task_id
+            refs.append(ObjectRef(oid, self.worker_info))
+        return refs
+
+    # --- lease management (ref: normal_task_submitter lease reuse) ---
+    def _lease_key(self, demand: dict[str, float]) -> tuple:
+        return tuple(sorted(demand.items()))
+
+    async def _acquire_lease(self, demand: dict[str, float]):
+        key = self._lease_key(demand)
+        cache = self._lease_cache.get(key)
+        while cache:
+            winfo, token, nm_addr, _ = cache.pop()
+            return winfo, token, nm_addr
+        nm_addr = Address(self.node_address.host, self.node_address.port)
+        allow_spill = True
+        for _hop in range(4):
+            conn = (self.node_conn if nm_addr.key() == self.node_address.key()
+                    else await self._conn_to(nm_addr))
+            res = await conn.call("request_lease", (demand, allow_spill),
+                                  timeout=_TASK_PUSH_TIMEOUT)
+            if res[0] == "granted":
+                return res[1], res[2], nm_addr
+            if res[0] == "spillback":
+                nm_addr = res[1]
+                allow_spill = False
+                continue
+            raise RuntimeError(f"infeasible task: {res[1]}")
+        raise RuntimeError("lease spillback loop exceeded")
+
+    async def _release_lease(self, winfo, token, nm_addr,
+                             reusable: bool = True):
+        try:
+            conn = (self.node_conn if nm_addr.key() == self.node_address.key()
+                    else await self._conn_to(nm_addr))
+            await conn.call("return_lease", token)
+        except Exception:
+            pass
+
+    async def _run_normal_task(self, spec: TaskSpec):
+        pt = self.pending_tasks[spec.task_id]
+        while True:
+            try:
+                winfo, token, nm_addr = await self._acquire_lease(spec.resources)
+            except Exception as e:
+                self._fail_task(spec, TaskError(e, spec.name, ""))
+                return
+            try:
+                conn = await self._conn_to(winfo.address)
+                reply = await conn.call("push_task", spec,
+                                        timeout=_TASK_PUSH_TIMEOUT)
+            except (ConnectionLost, RpcError, OSError) as e:
+                await self._release_lease(winfo, token, nm_addr, reusable=False)
+                if pt.retries_left > 0:
+                    pt.retries_left -= 1
+                    logger.warning("task %s worker crash, retrying (%s)",
+                                   spec.name, e)
+                    await asyncio.sleep(0.05)
+                    continue
+                self._fail_task(spec, WorkerCrashedError(
+                    f"worker died running {spec.name}: {e}"))
+                return
+            await self._release_lease(winfo, token, nm_addr)
+            if reply[0] == "task_error":
+                _, err_blob, tb = reply
+                if spec.retry_exceptions and pt.retries_left > 0:
+                    pt.retries_left -= 1
+                    continue
+                try:
+                    cause = deserialize(err_blob)
+                except Exception as e:
+                    cause = RuntimeError(f"undeserializable task error: {e}")
+                self._fail_task(spec, TaskError(cause, spec.name, tb))
+                return
+            self._complete_task(spec, reply[1], winfo)
+            return
+
+    def _complete_task(self, spec: TaskSpec, results: list, winfo: WorkerInfo):
+        pt = self.pending_tasks.get(spec.task_id)
+        for i, entry in enumerate(results):
+            oid = ObjectID.for_return(spec.task_id, i)
+            if entry[0] == "inline":
+                _, blob, is_exc = entry
+                try:
+                    value = deserialize(blob)
+                except Exception as e:
+                    value, is_exc = TaskError(e, spec.name, ""), True
+                self.memory_store.put(oid, value, is_exc)
+                self.object_meta[oid] = ObjectMeta(oid, size=len(blob),
+                                                   inline=True)
+            else:  # ("shm", size)
+                _, size = entry
+                self.object_meta[oid] = ObjectMeta(
+                    oid, size=size, in_shm=True, node_ids=[winfo.node_id])
+            self._signal_object_ready(oid)
+        if pt is not None:
+            pt.done = True
+            for oid in pt.pinned:
+                self.reference_counter.remove_task_pin(oid)
+
+    def _fail_task(self, spec: TaskSpec, error: Exception):
+        pt = self.pending_tasks.get(spec.task_id)
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_return(spec.task_id, i)
+            self.memory_store.put(oid, error, is_exception=True)
+            meta = self.object_meta.setdefault(oid, ObjectMeta(oid))
+            meta.error = error
+            self._signal_object_ready(oid)
+        if pt is not None:
+            pt.done = True
+            for oid in pt.pinned:
+                self.reference_counter.remove_task_pin(oid)
+
+    # ------------------------------------------------------ actor lifecycle
+    def create_actor(self, cls: Any, args: tuple, kwargs: dict,
+                     options) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_task(actor_id)
+        spec_args, pinned = self._prepare_args(args)
+        spec_kwargs, pinned_kw = self._prepare_args(kwargs)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id,
+            name=getattr(cls, "__name__", "Actor"),
+            function_blob=cloudpickle.dumps(cls),
+            args=spec_args, kwargs=spec_kwargs, num_returns=1,
+            resources=self._demand_for(options),
+            owner=self.worker_info, actor_id=actor_id,
+            is_actor_creation=True, actor_options=options,
+            scheduling_strategy=options.scheduling_strategy)
+        self.io.run(self.gcs.register_actor(spec))
+        return actor_id
+
+    def get_actor_submitter(self, actor_id: ActorID) -> "_ActorTaskSubmitter":
+        sub = self._actor_submitters.get(actor_id)
+        if sub is None:
+            sub = _ActorTaskSubmitter(self, actor_id)
+            self._actor_submitters[actor_id] = sub
+        return sub
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict, options) -> list[ObjectRef]:
+        task_id = TaskID.for_actor_task(actor_id)
+        spec_args, pinned = self._prepare_args(args)
+        spec_kwargs, pinned_kw = self._prepare_args(kwargs)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id,
+            name=f"{method_name}", function_blob=None,
+            args=spec_args, kwargs=spec_kwargs,
+            num_returns=options.num_returns,
+            resources={}, owner=self.worker_info,
+            max_retries=options.max_retries if options.max_retries >= 0 else 0,
+            actor_id=actor_id, method_name=method_name)
+        refs = self._register_task(spec, pinned + pinned_kw)
+        sub = self.get_actor_submitter(actor_id)
+        self.io.spawn(sub.submit(spec))
+        return refs
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.io.run(self.gcs.kill_actor(actor_id, no_restart))
+
+    # ------------------------------------------------- worker-side execution
+    async def rpc_push_task(self, conn, spec: TaskSpec):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.executor, self._execute_task, spec)
+
+    def _execute_task(self, spec: TaskSpec):
+        self._exec_ctx.task_id = spec.task_id
+        try:
+            fn = cloudpickle.loads(spec.function_blob)
+            args = self._resolve_args(spec.args)
+            kwargs = self._resolve_args(spec.kwargs)
+            result = fn(*args, **kwargs)
+            return self._package_returns(spec, result)
+        except Exception as e:
+            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
+        finally:
+            self._exec_ctx.task_id = None
+
+    def _resolve_args(self, args):
+        if isinstance(args, dict):
+            return {k: (self.get([ObjectRef(v.object_id, v.owner,
+                                            _add_local_ref=False)])[0]
+                        if isinstance(v, RefArg) else v)
+                    for k, v in args.items()}
+        return [self.get([ObjectRef(v.object_id, v.owner,
+                                    _add_local_ref=False)])[0]
+                if isinstance(v, RefArg) else v
+                for v in args]
+
+    def _package_returns(self, spec: TaskSpec, result):
+        cfg = get_config()
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but "
+                    f"returned {len(values)} values")
+        out = []
+        for i, value in enumerate(values):
+            oid = ObjectID.for_return(spec.task_id, i)
+            try:
+                blob = serialize_to_bytes(value)
+            except Exception as e:
+                out.append(("inline", serialize_to_bytes(
+                    TaskError(e, spec.name, traceback.format_exc())), True))
+                continue
+            if len(blob) > cfg.max_direct_call_object_size:
+                self.shm.create_from_bytes(oid, blob)
+                self.io.run(self.node_conn.call(
+                    "object_created", (oid, len(blob), spec.owner)))
+                out.append(("shm", len(blob)))
+            else:
+                out.append(("inline", blob, False))
+        return ("ok", out)
+
+    async def rpc_create_actor(self, conn, spec: TaskSpec):
+        loop = asyncio.get_running_loop()
+        opts = spec.actor_options
+        if opts is not None and opts.max_concurrency > 1:
+            self.executor = ThreadPoolExecutor(
+                max_workers=opts.max_concurrency,
+                thread_name_prefix="rayt-actor")
+        err = await loop.run_in_executor(
+            None, self._instantiate_actor, spec)
+        return err
+
+    def _instantiate_actor(self, spec: TaskSpec) -> str | None:
+        self._exec_ctx.task_id = spec.task_id
+        try:
+            cls = cloudpickle.loads(spec.function_blob)
+            args = self._resolve_args(spec.args)
+            kwargs = self._resolve_args(spec.kwargs)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = spec.actor_id
+            # async actors: methods that are coroutines run on their own loop
+            if any(asyncio.iscoroutinefunction(getattr(cls, m, None))
+                   for m in dir(cls) if not m.startswith("__")):
+                self._actor_async_loop = EventLoopThread("rayt-actor-async")
+            return None
+        except Exception:
+            return traceback.format_exc()
+        finally:
+            self._exec_ctx.task_id = None
+
+    async def rpc_push_actor_task(self, conn, arg):
+        """Ordered actor-task execution (ref: actor_scheduling_queue.cc).
+
+        Ordering contract (mirrors the reference): calls from one caller
+        *start* in seq order. With max_concurrency=1 the single executor
+        thread makes start order == completion order (sequential actors);
+        with max_concurrency>1 (threaded) or async methods, starts are
+        ordered but bodies overlap — same as the reference's threaded/async
+        actors (out_of_order_actor_scheduling_queue.cc)."""
+        spec, caller_key = arg
+        st = self._actor_seq_state.get(caller_key)
+        if st is None:
+            st = {"next": 0, "cond": asyncio.Condition()}
+            self._actor_seq_state[caller_key] = st
+        async with st["cond"]:
+            await st["cond"].wait_for(lambda: st["next"] >= spec.seq_no)
+            if st["next"] == spec.seq_no:
+                st["next"] = spec.seq_no + 1
+                st["cond"].notify_all()
+        loop = asyncio.get_running_loop()
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if asyncio.iscoroutinefunction(method):
+            # async actor: runs concurrently on the actor's asyncio loop
+            cfut = asyncio.run_coroutine_threadsafe(
+                self._run_async_method(spec), self._actor_async_loop.loop)
+            return await asyncio.wrap_future(cfut)
+        # run_in_executor queues FIFO, so start order is preserved; the
+        # executor's max_workers bounds actual concurrency
+        return await loop.run_in_executor(
+            self.executor, self._execute_actor_task, spec)
+
+    async def _run_async_method(self, spec: TaskSpec):
+        self._exec_ctx.task_id = spec.task_id
+        try:
+            method = getattr(self.actor_instance, spec.method_name)
+            args = self._resolve_args_async(spec.args)
+            kwargs = self._resolve_args_async(spec.kwargs)
+            result = await method(*args, **kwargs)
+            return self._package_returns(spec, result)
+        except Exception as e:
+            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
+        finally:
+            self._exec_ctx.task_id = None
+
+    def _resolve_args_async(self, args):
+        # async path: refs resolved via blocking get on a worker thread would
+        # deadlock the actor loop only if it waited on itself; args are
+        # resolved eagerly here via the IO loop (cheap for inline objects).
+        return self._resolve_args(args)
+
+    def _execute_actor_task(self, spec: TaskSpec):
+        self._exec_ctx.task_id = spec.task_id
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor not initialized")
+            method = getattr(self.actor_instance, spec.method_name, None)
+            if method is None:
+                raise AttributeError(
+                    f"actor has no method {spec.method_name!r}")
+            args = self._resolve_args(spec.args)
+            kwargs = self._resolve_args(spec.kwargs)
+            result = method(*args, **kwargs)
+            return self._package_returns(spec, result)
+        except Exception as e:
+            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
+        finally:
+            self._exec_ctx.task_id = None
+
+    def rpc_exit_worker(self, conn, arg=None):
+        def _die():
+            os._exit(0)
+        threading.Timer(0.1, _die).start()
+        return True
+
+    def rpc_worker_stats(self, conn, arg=None):
+        return {
+            "worker_id": self.worker_id.hex(),
+            "mode": self.mode,
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "num_pending_tasks": sum(
+                1 for t in self.pending_tasks.values() if not t.done),
+            "memory_store_size": len(self.memory_store),
+            "refcount": self.reference_counter.stats(),
+        }
+
+
+class _ActorTaskSubmitter:
+    """Per-actor ordered submission pipeline (ref: actor_task_submitter.h:75).
+
+    Calls are pipelined: each gets a seq_no; the receiver reorders. The
+    submitter tracks actor liveness via GCS pubsub and queues while the
+    actor is PENDING/RESTARTING."""
+
+    def __init__(self, cw: CoreWorker, actor_id: ActorID):
+        self.cw = cw
+        self.actor_id = actor_id
+        self.seq = 0
+        self.state = ActorState.PENDING
+        self.address: Address | None = None
+        self.node_id: NodeID | None = None
+        self.death_cause = ""
+        self._resolved = asyncio.Event()
+        self._resolve_started = False
+        # address observed to be dead (connection refused/lost); GCS may lag
+        # behind the death, so an ALIVE report at this address is stale
+        self._avoid_address: Address | None = None
+
+    async def _ensure_resolved(self):
+        if not self._resolve_started:
+            self._resolve_started = True
+            asyncio.ensure_future(self._resolve_loop())
+        await self._resolved.wait()
+
+    async def _resolve_loop(self):
+        while True:
+            try:
+                res = await self.cw.gcs.actor_handle_state(self.actor_id)
+            except Exception:
+                await asyncio.sleep(0.2)
+                continue
+            if res is None:
+                await asyncio.sleep(0.1)
+                continue
+            state, address, death_cause, _, node_id = res
+            self.state = state
+            self.death_cause = death_cause
+            if state == ActorState.ALIVE and address is not None \
+                    and address == self._avoid_address:
+                # stale ALIVE record for an endpoint we saw die
+                await asyncio.sleep(0.05)
+                continue
+            if state == ActorState.ALIVE and address is not None:
+                if address != self.address:
+                    self.seq = 0  # fresh incarnation: restart ordering
+                self.address = address
+                self.node_id = node_id
+                self._resolved.set()
+                return
+            if state == ActorState.DEAD:
+                self._resolved.set()
+                return
+            await asyncio.sleep(0.05)
+
+    async def on_actor_update(self, info):
+        self.state = info.state
+        self.death_cause = info.death_cause
+        if info.state == ActorState.ALIVE and info.address is not None:
+            if info.address == self._avoid_address:
+                return
+            if info.address != self.address:
+                self.seq = 0
+            self.address = info.address
+            self.node_id = info.node_id
+            self._resolved.set()
+        elif info.state == ActorState.DEAD:
+            self.address = None
+            self._resolved.set()
+        elif info.state == ActorState.RESTARTING:
+            self.address = None
+            self._resolved.clear()
+            asyncio.ensure_future(self._resolve_loop())
+
+    async def submit(self, spec: TaskSpec):
+        attempts = spec.max_retries + 1
+        while attempts > 0:
+            attempts -= 1
+            await self._ensure_resolved()
+            if self.state == ActorState.DEAD:
+                self.cw._fail_task(spec, ActorDiedError(
+                    self.actor_id, self.death_cause))
+                return
+            # seq assigned synchronously post-resolution so pipelined calls
+            # from this caller reach the current incarnation in order
+            spec.seq_no = self.seq
+            self.seq += 1
+            address = self.address
+            try:
+                conn = await self.cw._conn_to(address)
+                reply = await conn.call(
+                    "push_actor_task",
+                    (spec, self.cw.worker_info.address.key()),
+                    timeout=_TASK_PUSH_TIMEOUT)
+            except (ConnectionLost, RpcError, OSError) as e:
+                # actor worker died mid-call; wait for GCS verdict. Don't
+                # trust ALIVE records still pointing at the dead endpoint.
+                self._avoid_address = address
+                self.address = None
+                self._resolved.clear()
+                asyncio.ensure_future(self._resolve_loop())
+                if attempts > 0:
+                    continue
+                self.cw._fail_task(spec, ActorDiedError(
+                    self.actor_id, f"connection lost: {e}"))
+                return
+            if reply[0] == "task_error":
+                _, err_blob, tb = reply
+                try:
+                    cause = deserialize(err_blob)
+                except Exception as e:
+                    cause = RuntimeError(f"undeserializable error: {e}")
+                self.cw._fail_task(spec, TaskError(cause, spec.name, tb))
+                return
+            winfo = WorkerInfo(WorkerID.nil(),
+                               self.node_id or self.cw.node_id, address)
+            self.cw._complete_task(spec, reply[1], winfo)
+            return

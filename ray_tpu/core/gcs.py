@@ -1,0 +1,520 @@
+"""GCS — the global control store (cluster head service).
+
+TPU-native analog of ref src/ray/gcs/gcs_server/ (gcs_server.h:89): one
+asyncio process hosting node membership, the actor directory + lifecycle
+manager, job table, internal KV (also the collective-rendezvous store, like
+NCCLUniqueId exchange in ref nccl_collective_group.py:29), placement
+groups, and pubsub. Storage is in-memory (a Redis-backed store can be
+slotted behind ``_Tables`` later, ref: gcs/store_client/).
+
+Health checking: node managers hold a persistent RPC connection; disconnect
+or missed heartbeats mark the node dead and broadcast the death (ref:
+gcs_health_check_manager.h:45).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+from ray_tpu._internal.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._internal.logging_utils import setup_logger
+from ray_tpu._internal.rpc import Connection, RpcServer, connect
+from ray_tpu.core.common import (ActorInfo, ActorState, Address, NodeInfo,
+                                 TaskSpec, now)
+
+logger = setup_logger("gcs")
+
+# Pubsub channel names
+CH_NODE = "node_events"          # {"event": "added"|"removed", "node": NodeInfo}
+CH_ACTOR = "actor_events"        # ActorInfo
+CH_ERROR = "error_events"
+CH_LOG = "log_events"
+
+
+class GcsServer:
+    def __init__(self):
+        self.server = RpcServer()
+        self.kv: dict[str, dict[str, bytes]] = {}
+        self.nodes: dict[NodeID, NodeInfo] = {}
+        self.node_conns: dict[NodeID, Connection] = {}
+        self.node_resources_available: dict[NodeID, dict[str, float]] = {}
+        self.node_last_heartbeat: dict[NodeID, float] = {}
+        self.actors: dict[ActorID, ActorInfo] = {}
+        self.actor_specs: dict[ActorID, TaskSpec] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.jobs: dict[JobID, dict] = {}
+        self.placement_groups: dict[PlacementGroupID, dict] = {}
+        # channel -> set of subscribed connections
+        self.subscribers: dict[str, set[Connection]] = {}
+        self.server.add_service(self)
+        self._started = now()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        port = await self.server.start(host, port)
+        logger.info("GCS listening on %s:%s", host, port)
+        return port
+
+    async def stop(self):
+        await self.server.stop()
+
+    # ------------------------------------------------------------- pubsub
+    async def publish(self, channel: str, message: Any):
+        dead = []
+        for conn in self.subscribers.get(channel, ()):  # push-based pubsub
+            if conn.closed:
+                dead.append(conn)
+                continue
+            try:
+                await conn.notify("pubsub:" + channel, message)
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self.subscribers.get(channel, set()).discard(conn)
+
+    def rpc_subscribe(self, conn: Connection, channel: str):
+        self.subscribers.setdefault(channel, set()).add(conn)
+        conn.on_close.append(
+            lambda c: self.subscribers.get(channel, set()).discard(c))
+        return True
+
+    async def rpc_publish(self, conn: Connection, arg):
+        channel, message = arg
+        await self.publish(channel, message)
+        return True
+
+    # ----------------------------------------------------------------- KV
+    def rpc_kv_put(self, conn, arg):
+        ns, key, value, overwrite = arg
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    def rpc_kv_get(self, conn, arg):
+        ns, key = arg
+        return self.kv.get(ns, {}).get(key)
+
+    def rpc_kv_multi_get(self, conn, arg):
+        ns, keys = arg
+        table = self.kv.get(ns, {})
+        return {k: table[k] for k in keys if k in table}
+
+    def rpc_kv_del(self, conn, arg):
+        ns, key = arg
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    def rpc_kv_keys(self, conn, arg):
+        ns, prefix = arg
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    def rpc_kv_exists(self, conn, arg):
+        ns, key = arg
+        return key in self.kv.get(ns, {})
+
+    # -------------------------------------------------------------- nodes
+    async def rpc_register_node(self, conn: Connection, info: NodeInfo):
+        self.nodes[info.node_id] = info
+        self.node_conns[info.node_id] = conn
+        self.node_resources_available[info.node_id] = dict(info.resources_total)
+        self.node_last_heartbeat[info.node_id] = now()
+        conn.on_close.append(lambda c: asyncio.ensure_future(
+            self._on_node_lost(info.node_id)))
+        await self.publish(CH_NODE, {"event": "added", "node": info})
+        logger.info("node %s registered (%s)", info.node_id, info.resources_total)
+        return True
+
+    async def _on_node_lost(self, node_id: NodeID):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self.node_conns.pop(node_id, None)
+        self.node_resources_available.pop(node_id, None)
+        logger.warning("node %s lost", node_id)
+        await self.publish(CH_NODE, {"event": "removed", "node": info})
+        # Fail over actors on this node (restart if budget remains).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (
+                    ActorState.ALIVE, ActorState.PENDING):
+                await self._handle_actor_failure(actor, "node died")
+
+    def rpc_heartbeat(self, conn, arg):
+        """Resource-view sync (ref analog: RaySyncer resource broadcast)."""
+        node_id, available = arg
+        self.node_last_heartbeat[node_id] = now()
+        if node_id in self.nodes and self.nodes[node_id].alive:
+            self.node_resources_available[node_id] = available
+        return True
+
+    def rpc_get_all_nodes(self, conn, arg=None):
+        return list(self.nodes.values())
+
+    def rpc_get_cluster_resources(self, conn, arg=None):
+        return {
+            nid.hex(): {
+                "total": self.nodes[nid].resources_total,
+                "available": self.node_resources_available.get(nid, {}),
+                "alive": self.nodes[nid].alive,
+                "address": self.nodes[nid].address,
+            }
+            for nid in self.nodes
+        }
+
+    def rpc_drain_node(self, conn, node_id: NodeID):
+        info = self.nodes.get(node_id)
+        if info is None:
+            return False
+        info.labels["draining"] = "1"
+        return True
+
+    # --------------------------------------------------------------- jobs
+    def rpc_register_job(self, conn, arg):
+        job_id, metadata = arg
+        self.jobs[job_id] = {"metadata": metadata, "start_time": now(),
+                             "status": "RUNNING"}
+        return True
+
+    def rpc_finish_job(self, conn, job_id: JobID):
+        if job_id in self.jobs:
+            self.jobs[job_id]["status"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = now()
+        return True
+
+    def rpc_get_all_jobs(self, conn, arg=None):
+        return {j.hex(): meta for j, meta in self.jobs.items()}
+
+    # -------------------------------------------------------------- actors
+    async def rpc_register_actor(self, conn: Connection, spec: TaskSpec):
+        """Register + schedule an actor (ref: gcs_actor_manager.cc)."""
+        opts = spec.actor_options
+        assert spec.actor_id is not None and opts is not None
+        if opts.name:
+            key = (opts.namespace, opts.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != ActorState.DEAD:
+                    raise ValueError(
+                        f"actor name {opts.name!r} already taken in "
+                        f"namespace {opts.namespace!r}")
+            self.named_actors[key] = spec.actor_id
+        info = ActorInfo(
+            actor_id=spec.actor_id, name=opts.name, namespace=opts.namespace,
+            state=ActorState.PENDING, address=None, worker_id=None,
+            node_id=None, max_restarts=opts.max_restarts,
+            class_name=spec.name)
+        self.actors[spec.actor_id] = info
+        self.actor_specs[spec.actor_id] = spec
+        await self.publish(CH_ACTOR, info)
+        asyncio.ensure_future(self._schedule_actor(spec.actor_id))
+        return True
+
+    def _pick_node_for(self, demand: dict[str, float],
+                       strategy=None) -> NodeID | None:
+        """Actor/PG placement against the synced resource view (ref:
+        gcs_actor_scheduler.h:111, simplified to best-fit over the view)."""
+        from ray_tpu.core.common import NodeAffinitySchedulingStrategy
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            info = self.nodes.get(strategy.node_id)
+            if info is not None and info.alive:
+                return strategy.node_id
+            if not strategy.soft:
+                return None
+        best, best_score = None, -1.0
+        for nid, info in self.nodes.items():
+            if not info.alive or info.labels.get("draining"):
+                continue
+            avail = self.node_resources_available.get(nid, {})
+            if all(avail.get(r, 0.0) >= amt for r, amt in demand.items()):
+                # prefer nodes with more slack (spread-ish)
+                score = sum(avail.get(r, 0.0) - amt for r, amt in demand.items())
+                if score > best_score:
+                    best, best_score = nid, score
+        return best
+
+    async def _schedule_actor(self, actor_id: ActorID):
+        info = self.actors[actor_id]
+        spec = self.actor_specs[actor_id]
+        demand = dict(spec.resources)
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            node_id = self._pick_node_for(demand, spec.scheduling_strategy)
+            if node_id is None or node_id not in self.node_conns:
+                await asyncio.sleep(0.2)
+                continue
+            conn = self.node_conns[node_id]
+            try:
+                result = await conn.call("start_actor", spec, timeout=120.0)
+            except Exception as e:
+                logger.warning("start_actor on %s failed: %s", node_id, e)
+                await asyncio.sleep(0.2)
+                continue
+            if result is None:
+                await asyncio.sleep(0.1)
+                continue
+            worker_info, err = result
+            if err is not None:
+                # creation task raised: actor is DEAD with cause
+                info.state = ActorState.DEAD
+                info.death_cause = err
+                await self.publish(CH_ACTOR, info)
+                return
+            info.state = ActorState.ALIVE
+            info.address = worker_info.address
+            info.worker_id = worker_info.worker_id
+            info.node_id = worker_info.node_id
+            await self.publish(CH_ACTOR, info)
+            logger.info("actor %s alive on %s", actor_id, info.address)
+            return
+        info.state = ActorState.DEAD
+        info.death_cause = "scheduling timed out (unsatisfiable resources?)"
+        await self.publish(CH_ACTOR, info)
+
+    async def _handle_actor_failure(self, info: ActorInfo, cause: str):
+        if info.max_restarts != 0 and (
+                info.max_restarts < 0 or info.num_restarts < info.max_restarts):
+            info.num_restarts += 1
+            info.state = ActorState.RESTARTING
+            info.address = None
+            await self.publish(CH_ACTOR, info)
+            asyncio.ensure_future(self._schedule_actor(info.actor_id))
+        else:
+            info.state = ActorState.DEAD
+            info.death_cause = cause
+            info.address = None
+            await self.publish(CH_ACTOR, info)
+
+    async def rpc_report_actor_failure(self, conn, arg):
+        """Called by node managers when an actor's worker process dies."""
+        actor_id, cause = arg
+        info = self.actors.get(actor_id)
+        if info is None or info.state == ActorState.DEAD:
+            return False
+        await self._handle_actor_failure(info, cause)
+        return True
+
+    async def rpc_kill_actor(self, conn, arg):
+        actor_id, no_restart = arg
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        if no_restart:
+            info.max_restarts = 0
+        if info.node_id in self.node_conns:
+            try:
+                await self.node_conns[info.node_id].call(
+                    "kill_actor_worker", actor_id)
+            except Exception:
+                pass
+        return True
+
+    def rpc_get_actor_info(self, conn, actor_id: ActorID):
+        return self.actors.get(actor_id)
+
+    def rpc_get_named_actor(self, conn, arg):
+        namespace, name = arg
+        actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        return self.actors.get(actor_id), self.actor_specs.get(actor_id)
+
+    def rpc_get_all_actors(self, conn, arg=None):
+        return list(self.actors.values())
+
+    def rpc_actor_handle_state(self, conn, actor_id: ActorID):
+        """Lightweight poll used by callers resolving an actor address."""
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        return (info.state, info.address, info.death_cause,
+                info.num_restarts, info.node_id)
+
+    # ---------------------------------------------------- placement groups
+    async def rpc_create_placement_group(self, conn, arg):
+        """Gang reservation: all-or-nothing bundle reservation across
+        nodes (ref: gcs_placement_group_manager + 2-phase commit on
+        raylets; here prepare/commit RPCs against node managers)."""
+        pg_id, bundles, strategy = arg
+        placement = await self._schedule_pg(pg_id, bundles, strategy)
+        if placement is None:
+            return None
+        self.placement_groups[pg_id] = {
+            "bundles": bundles, "strategy": strategy,
+            "placement": placement, "state": "CREATED",
+        }
+        return placement
+
+    async def _schedule_pg(self, pg_id, bundles, strategy):
+        alive = [(nid, info) for nid, info in self.nodes.items() if info.alive]
+        if not alive:
+            return None
+        placement: list[NodeID] = []
+        tentative: dict[NodeID, dict[str, float]] = {
+            nid: dict(self.node_resources_available.get(nid, {}))
+            for nid, _ in alive}
+
+        def fits(nid, demand):
+            avail = tentative[nid]
+            return all(avail.get(r, 0) >= amt for r, amt in demand.items())
+
+        def take(nid, demand):
+            for r, amt in demand.items():
+                tentative[nid][r] = tentative[nid].get(r, 0) - amt
+
+        node_ids = [nid for nid, _ in alive]
+        if strategy in ("STRICT_PACK", "PACK"):
+            order = node_ids
+            for demand in bundles:
+                placed = False
+                # PACK prefers reusing nodes already used
+                for nid in sorted(order, key=lambda n: -placement.count(n)):
+                    if fits(nid, demand):
+                        take(nid, demand)
+                        placement.append(nid)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            if strategy == "STRICT_PACK" and len(set(placement)) > 1:
+                return None
+        else:  # SPREAD / STRICT_SPREAD
+            for i, demand in enumerate(bundles):
+                candidates = sorted(
+                    node_ids, key=lambda n: placement.count(n))
+                placed = False
+                for nid in candidates:
+                    if strategy == "STRICT_SPREAD" and nid in placement:
+                        continue
+                    if fits(nid, demand):
+                        take(nid, demand)
+                        placement.append(nid)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+        # 2-phase: prepare on each node, commit if all succeed.
+        prepared: list[tuple[NodeID, int]] = []
+        ok = True
+        for i, nid in enumerate(placement):
+            conn2 = self.node_conns.get(nid)
+            if conn2 is None:
+                ok = False
+                break
+            try:
+                good = await conn2.call(
+                    "pg_prepare", (pg_id, i, bundles[i]), timeout=10)
+            except Exception:
+                good = False
+            if not good:
+                ok = False
+                break
+            prepared.append((nid, i))
+        if not ok:
+            for nid, i in prepared:
+                conn2 = self.node_conns.get(nid)
+                if conn2 is not None:
+                    try:
+                        await conn2.call("pg_return", (pg_id, i), timeout=10)
+                    except Exception:
+                        pass
+            return None
+        for nid, i in prepared:
+            await self.node_conns[nid].call("pg_commit", (pg_id, i), timeout=10)
+        return placement
+
+    async def rpc_remove_placement_group(self, conn, pg_id):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return False
+        for i, nid in enumerate(pg["placement"]):
+            c = self.node_conns.get(nid)
+            if c is not None:
+                try:
+                    await c.call("pg_return", (pg_id, i), timeout=10)
+                except Exception:
+                    pass
+        return True
+
+    def rpc_get_placement_group(self, conn, pg_id):
+        return self.placement_groups.get(pg_id)
+
+    # ---------------------------------------------------------- debugging
+    def rpc_cluster_status(self, conn, arg=None):
+        return {
+            "uptime_s": now() - self._started,
+            "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+            "num_actors": len(self.actors),
+            "num_jobs": len(self.jobs),
+            "num_placement_groups": len(self.placement_groups),
+        }
+
+
+class GcsClient:
+    """Typed async client for the GCS (ref analog: gcs_client/ accessors)."""
+
+    def __init__(self, conn: Connection):
+        self.conn = conn
+        self._subs: dict[str, list] = {}
+
+    @classmethod
+    async def connect(cls, address: Address) -> "GcsClient":
+        conn = await connect(address.host, address.port)
+        return cls(conn)
+
+    # KV
+    async def kv_put(self, key: str, value: bytes, *, namespace: str = "default",
+                     overwrite: bool = True) -> bool:
+        return await self.conn.call("kv_put", (namespace, key, value, overwrite))
+
+    async def kv_get(self, key: str, *, namespace: str = "default"):
+        return await self.conn.call("kv_get", (namespace, key))
+
+    async def kv_del(self, key: str, *, namespace: str = "default") -> bool:
+        return await self.conn.call("kv_del", (namespace, key))
+
+    async def kv_keys(self, prefix: str = "", *, namespace: str = "default"):
+        return await self.conn.call("kv_keys", (namespace, prefix))
+
+    async def kv_exists(self, key: str, *, namespace: str = "default") -> bool:
+        return await self.conn.call("kv_exists", (namespace, key))
+
+    # pubsub
+    async def subscribe(self, channel: str, callback):
+        self._subs.setdefault(channel, []).append(callback)
+        if len(self._subs[channel]) == 1:
+            def dispatch(msg, _ch=channel):
+                for cb in self._subs.get(_ch, []):
+                    cb(msg)
+            self.conn.on_notify("pubsub:" + channel, dispatch)
+            await self.conn.call("subscribe", channel)
+
+    async def publish(self, channel: str, message: Any):
+        await self.conn.call("publish", (channel, message))
+
+    # nodes / cluster
+    async def get_all_nodes(self) -> list[NodeInfo]:
+        return await self.conn.call("get_all_nodes")
+
+    async def get_cluster_resources(self):
+        return await self.conn.call("get_cluster_resources")
+
+    # actors
+    async def register_actor(self, spec: TaskSpec):
+        return await self.conn.call("register_actor", spec)
+
+    async def actor_handle_state(self, actor_id: ActorID):
+        return await self.conn.call("actor_handle_state", actor_id)
+
+    async def get_named_actor(self, name: str, namespace: str = ""):
+        return await self.conn.call("get_named_actor", (namespace, name))
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool):
+        return await self.conn.call("kill_actor", (actor_id, no_restart))
+
+    async def get_all_actors(self):
+        return await self.conn.call("get_all_actors")
+
+    async def close(self):
+        await self.conn.close()

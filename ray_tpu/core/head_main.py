@@ -1,0 +1,53 @@
+"""Head process: GCS + the head node's manager in one asyncio process
+(ref analog: `ray start --head` spawning gcs_server + raylet; merged here
+because both are asyncio services and separate daemons buy nothing on a
+single host — multi-node tests spawn extra node managers via
+cluster_utils).
+
+Prints one JSON line with the bound ports on stdout, then serves forever.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+async def run(args):
+    from ray_tpu._internal.ids import NodeID
+    from ray_tpu.core.common import Address
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.node_manager import NodeManager
+
+    gcs = GcsServer()
+    gcs_port = await gcs.start(port=args.gcs_port)
+    resources = json.loads(args.resources)
+    nm = NodeManager(
+        node_id=NodeID.random(), resources=resources,
+        gcs_address=Address("127.0.0.1", gcs_port),
+        labels={"head": "1"})
+    addr = await nm.start()
+    print(json.dumps({"gcs_port": gcs_port, "nm_port": addr.port,
+                      "node_id": nm.node_id.hex()}), flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await nm.stop()
+        await gcs.stop()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs-port", type=int, default=0)
+    p.add_argument("--resources", type=str, default="{}")
+    args = p.parse_args()
+    try:
+        asyncio.run(run(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

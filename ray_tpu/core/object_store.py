@@ -1,0 +1,179 @@
+"""Object storage: per-process memory store + per-node shared-memory store.
+
+Ref analogs:
+ * MemoryStore — src/ray/core_worker/store_provider/memory_store/
+   memory_store.h:42 (small objects live in the owner process; waiters are
+   async futures).
+ * ShmObjectStore — the plasma store
+   (src/ray/object_manager/plasma/store.h:55) redesigned host-side: every
+   sealed object is one named POSIX shm segment (mmap'd by any process on
+   the node, zero-copy reads via pickle-5 buffer views). The directory +
+   pinning + eviction live in the node manager; this class is the
+   per-process mapping cache. A C++ arena allocator can replace the
+   per-object segments without changing this interface.
+
+Device arrays (jax.Array) do NOT pass through here — they stay in HBM and
+move over ICI via the mesh/collective layer. This store is for host objects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from multiprocessing import shared_memory, resource_tracker
+from typing import Any
+
+from ray_tpu._internal.ids import ObjectID
+from ray_tpu._internal.serialization import deserialize, serialize, serialized_size
+
+
+class _StoredObject:
+    __slots__ = ("value", "is_exception")
+
+    def __init__(self, value: Any, is_exception: bool = False):
+        self.value = value
+        self.is_exception = is_exception
+
+
+class MemoryStore:
+    """In-process store for small objects owned by this worker."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._objects: dict[ObjectID, _StoredObject] = {}
+        self._waiters: dict[ObjectID, list[asyncio.Future]] = {}
+
+    def put(self, object_id: ObjectID, value: Any, is_exception: bool = False):
+        obj = _StoredObject(value, is_exception)
+        self._objects[object_id] = obj
+
+        def _wake():
+            for fut in self._waiters.pop(object_id, []):
+                if not fut.done():
+                    fut.set_result(obj)
+        self._loop.call_soon_threadsafe(_wake)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> _StoredObject | None:
+        return self._objects.get(object_id)
+
+    async def wait_for(self, object_id: ObjectID) -> _StoredObject:
+        obj = self._objects.get(object_id)
+        if obj is not None:
+            return obj
+        fut = self._loop.create_future()
+        self._waiters.setdefault(object_id, []).append(fut)
+        return await fut
+
+    def delete(self, object_id: ObjectID):
+        self._objects.pop(object_id, None)
+
+    def __len__(self):
+        return len(self._objects)
+
+
+def _shm_name(object_id: ObjectID) -> str:
+    return "rayt_" + object_id.hex()[:40]
+
+
+def _unregister_tracker(shm: shared_memory.SharedMemory):
+    # The resource tracker would unlink segments when *any* process exits;
+    # lifetime is owned by the node manager instead (like plasma).
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+class ShmObjectStore:
+    """Create/open node-local shared-memory objects by ObjectID."""
+
+    def __init__(self):
+        self._open: dict[ObjectID, shared_memory.SharedMemory] = {}
+
+    def create_and_seal(self, object_id: ObjectID, value: Any) -> int:
+        chunks = serialize(value)
+        size = serialized_size(chunks)
+        shm = shared_memory.SharedMemory(
+            name=_shm_name(object_id), create=True, size=max(size, 1))
+        _unregister_tracker(shm)
+        off = 0
+        buf = shm.buf
+        for c in chunks:
+            n = len(c) if isinstance(c, bytes) else c.nbytes
+            buf[off:off + n] = bytes(c) if isinstance(c, bytes) else c
+            off += n
+        self._open[object_id] = shm
+        return size
+
+    def create_from_bytes(self, object_id: ObjectID, data: bytes) -> int:
+        """Seal a pre-serialized payload (used by node-to-node transfer)."""
+        shm = shared_memory.SharedMemory(
+            name=_shm_name(object_id), create=True, size=max(len(data), 1))
+        _unregister_tracker(shm)
+        shm.buf[:len(data)] = data
+        self._open[object_id] = shm
+        return len(data)
+
+    def contains_locally(self, object_id: ObjectID) -> bool:
+        if object_id in self._open:
+            return True
+        try:
+            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+            _unregister_tracker(shm)
+            self._open[object_id] = shm
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get(self, object_id: ObjectID, size: int) -> Any:
+        """Zero-copy deserialize; the mapping stays cached so buffer views
+        remain valid while this process holds the ref."""
+        shm = self._open.get(object_id)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+            _unregister_tracker(shm)
+            self._open[object_id] = shm
+        return deserialize(shm.buf[:size])
+
+    def read_bytes(self, object_id: ObjectID, size: int) -> bytes:
+        shm = self._open.get(object_id)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+            _unregister_tracker(shm)
+            self._open[object_id] = shm
+        return bytes(shm.buf[:size])
+
+    def release(self, object_id: ObjectID):
+        shm = self._open.pop(object_id, None)
+        if shm is not None:
+            try:
+                shm.close()
+            except BufferError:
+                # views still alive; keep mapping until process exit
+                self._open[object_id] = shm
+
+    def unlink(self, object_id: ObjectID):
+        """Destroy the segment (node-manager only, when refcount hits 0)."""
+        try:
+            shm = self._open.pop(object_id, None)
+            if shm is None:
+                shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+                _unregister_tracker(shm)
+            shm.close()
+            # shm.unlink() sends an unregister; balance the one we already
+            # sent at open/create time by re-registering first.
+            try:
+                resource_tracker.register(shm._name, "shared_memory")  # type: ignore[attr-defined]
+            except Exception:
+                pass
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except BufferError:
+            pass
+
+    def close(self):
+        for oid in list(self._open):
+            self.release(oid)

@@ -1,0 +1,157 @@
+"""Distributed reference counting (owner-side GC).
+
+Ref analog: src/ray/core_worker/reference_count.h:66. Simplified borrowing
+protocol for round 1:
+
+* The owner of an object tracks: local Python refs, registered borrowers
+  (processes that deserialized the ref), and task-argument pins (refs held
+  by in-flight tasks the owner submitted).
+* A borrower registers itself on deserialize and sends a release when its
+  local count drops to zero.
+* Refs serialized through opaque channels (inside a put object / return
+  value) conservatively pin the object until job teardown ("escaped") —
+  correct, may leak; the full borrower-chain accounting is future work.
+
+When every count reaches zero the owner frees: memory-store entry dropped,
+shm segment unlinked via the node manager.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Callable
+
+from ray_tpu._internal.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.object_ref import ObjectRef
+
+
+class _Record:
+    __slots__ = ("local", "borrowers", "task_pins", "escaped", "owned")
+
+    def __init__(self, owned: bool):
+        self.local = 0
+        self.borrowers: set[str] = set()
+        self.task_pins = 0
+        self.escaped = 0
+        self.owned = owned
+
+    def total(self) -> int:
+        return self.local + len(self.borrowers) + self.task_pins + self.escaped
+
+
+class ReferenceCounter:
+    def __init__(self, is_owner: Callable[[ObjectID], bool],
+                 free_fn: Callable[[ObjectID], None],
+                 notify_owner_fn: Callable[[ObjectID, object, str], None]):
+        """free_fn: called when an owned object's count hits 0.
+        notify_owner_fn(oid, owner, kind): send add/remove-borrower to a
+        remote owner (fire-and-forget)."""
+        self._lock = threading.RLock()
+        self._records: dict[ObjectID, _Record] = {}
+        self._is_owner = is_owner
+        self._free = free_fn
+        self._notify_owner = notify_owner_fn
+        # Serialization context flag: when >0, refs being pickled are task
+        # args (pinned via task_pins, not escaped).
+        self._tls = threading.local()
+
+    def _record(self, oid: ObjectID) -> _Record:
+        rec = self._records.get(oid)
+        if rec is None:
+            rec = _Record(owned=self._is_owner(oid))
+            self._records[oid] = rec
+        return rec
+
+    # ---- local refs -------------------------------------------------
+    def add_local_ref(self, ref: "ObjectRef"):
+        with self._lock:
+            self._record(ref.id).local += 1
+
+    def remove_local_ref(self, ref: "ObjectRef"):
+        to_free = None
+        notify = None
+        with self._lock:
+            rec = self._records.get(ref.id)
+            if rec is None:
+                return
+            rec.local = max(0, rec.local - 1)
+            if rec.total() == 0:
+                if rec.owned:
+                    to_free = ref.id
+                    del self._records[ref.id]
+                else:
+                    notify = (ref.id, ref.owner, "remove_borrower")
+                    del self._records[ref.id]
+        if to_free is not None:
+            self._free(to_free)
+        if notify is not None:
+            self._notify_owner(*notify)
+
+    # ---- serialization events ---------------------------------------
+    def begin_task_arg_serialization(self):
+        self._tls.task_arg = getattr(self._tls, "task_arg", 0) + 1
+
+    def end_task_arg_serialization(self):
+        self._tls.task_arg = max(0, getattr(self._tls, "task_arg", 0) - 1)
+
+    def on_ref_serialized(self, ref: "ObjectRef"):
+        with self._lock:
+            rec = self._record(ref.id)
+            if getattr(self._tls, "task_arg", 0) > 0:
+                pass  # pinned via add_task_pin by the submitter
+            else:
+                rec.escaped += 1
+
+    def on_ref_deserialized(self, ref: "ObjectRef"):
+        """Running in the receiving process: register as borrower."""
+        with self._lock:
+            rec = self._record(ref.id)
+            rec.local += 1
+        if not self._is_owner(ref.id) and ref.owner is not None:
+            self._notify_owner(ref.id, ref.owner, "add_borrower")
+
+    # ---- owner-side borrower registry --------------------------------
+    def add_borrower(self, oid: ObjectID, borrower_key: str):
+        with self._lock:
+            self._record(oid).borrowers.add(borrower_key)
+
+    def remove_borrower(self, oid: ObjectID, borrower_key: str):
+        to_free = None
+        with self._lock:
+            rec = self._records.get(oid)
+            if rec is None:
+                return
+            rec.borrowers.discard(borrower_key)
+            if rec.owned and rec.total() == 0:
+                to_free = oid
+                del self._records[oid]
+        if to_free is not None:
+            self._free(to_free)
+
+    # ---- task-argument pins ------------------------------------------
+    def add_task_pin(self, oid: ObjectID):
+        with self._lock:
+            self._record(oid).task_pins += 1
+
+    def remove_task_pin(self, oid: ObjectID):
+        to_free = None
+        with self._lock:
+            rec = self._records.get(oid)
+            if rec is None:
+                return
+            rec.task_pins = max(0, rec.task_pins - 1)
+            if rec.owned and rec.total() == 0:
+                to_free = oid
+                del self._records[oid]
+        if to_free is not None:
+            self._free(to_free)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_tracked": len(self._records),
+                "num_owned": sum(1 for r in self._records.values() if r.owned),
+                "num_escaped": sum(r.escaped for r in self._records.values()),
+            }

@@ -1,0 +1,12 @@
+"""TPU compute ops: fused/pallas kernels with jax reference fallbacks.
+
+The reference delegates all device math to torch/CUDA; here the hot ops
+are first-class: flash attention (Pallas), ring attention over the `seq`
+mesh axis (SP/CP — absent in the reference, see SURVEY.md §2.4), rmsnorm,
+rope, and cross entropy.
+"""
+
+from ray_tpu.ops.norms import rms_norm  # noqa: F401
+from ray_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401
+from ray_tpu.ops.attention import dot_product_attention  # noqa: F401
+from ray_tpu.ops.cross_entropy import softmax_cross_entropy  # noqa: F401

@@ -1,0 +1,27 @@
+"""Softmax cross entropy over large vocabularies.
+
+Computed in fp32 without materializing [batch*seq, vocab] probabilities
+twice: logsumexp + gather, which XLA fuses tightly. Supports masking
+(ignore index) for padded batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          ignore_index: int = -100
+                          ) -> tuple[jax.Array, jax.Array]:
+    """logits: [..., vocab] (any dtype, accumulated fp32); labels: [...]
+    int32. Returns (mean_loss, num_valid_tokens)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (lse - picked) * valid
+    n = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / n, valid.sum()

@@ -1,0 +1,157 @@
+"""Flash attention forward kernel (Pallas/TPU).
+
+Blockwise online-softmax attention: O(seq) memory, causal block skipping,
+GQA via block-index mapping (no KV repeat materialization). Grid is
+(batch, heads, q_blocks, k_blocks) with the k axis innermost so the
+accumulator lives in VMEM scratch across k steps (see
+/opt/skills/guides/pallas_guide.md, double-buffering pattern — pallas
+pipelines the HBM->VMEM block copies automatically).
+
+Backward: custom VJP that recomputes attention with the XLA path —
+correct and simple; a Pallas backward kernel is a planned optimization
+(the forward is where decode/prefill serving time goes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      m_scratch, l_scratch, acc_scratch, *,
+                      scale: float, causal: bool,
+                      block_q: int, block_k: int, num_k_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)          # [block_q, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [block_k, d]
+        v = v_ref[0, 0].astype(jnp.float32)          # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scratch[:, 0:1]                    # [block_q, 1]
+        l_prev = l_scratch[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)    # [block_q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [block_q, block_k]
+        alpha = jnp.exp(m_prev - m_new)               # [block_q, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_scratch[:, 0:1] = m_new
+        l_scratch[:, 0:1] = l_new
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [block_q, d]
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scratch[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool, scale: float | None,
+                   block_q: int, block_k: int) -> jax.Array:
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    n_rep = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (
+        f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    num_q_blocks = sq // block_q
+    num_k_blocks = sk // block_k
+    # layout: [b, h, s, d] so the head dim is a grid axis
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    grid = (b, h, num_q_blocks, num_k_blocks)
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
+                    block_q: int = 512, block_k: int = 512):
+    return _flash_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _flash_forward(q, k, v, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    from ray_tpu.ops.attention import xla_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal,
+                                         scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,137 @@
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+The reference has NO sequence parallelism (SURVEY.md §2.4 — verified
+absent); for the TPU build it is a core op. Two schemes, both expressed
+over the `seq` mesh axis inside shard_map:
+
+* Ring attention (`ring_attention`): K/V shards rotate around the ICI
+  ring via `ppermute` while each device accumulates blockwise
+  online-softmax attention for its resident Q shard. Memory O(s/N),
+  compute overlapped with neighbor transfers by XLA's async collective
+  scheduling. (Liu et al. 2023 — blockwise parallel transformers.)
+
+* Ulysses (`ulysses_attention`): `all_to_all` re-shards seq -> heads so
+  each device sees the full sequence for h/N heads, runs dense (flash)
+  attention locally, and all_to_alls back. Cheaper at moderate seq
+  lengths, requires n_heads % seq_parallelism == 0.
+
+Both are callable only inside shard_map with the axis bound; the model
+layer wraps them (ray_tpu/models/llama.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_stats(q, k, v, q_offset, k_offset, causal, scale):
+    """One blockwise attention step, returning online-softmax stats.
+
+    q: [b, sq, h, d], k/v: [b, sk, h, d] (kv already GQA-expanded or
+    head counts equal). Returns m [b,h,sq,1], l [b,h,sq,1], pv [b,sq,h,d].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                   # [b,h,sq,1]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(m <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)                   # [b,h,sq,1]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_safe, l, pv
+
+
+def _repeat_kv(x, n_rep):
+    if n_rep == 1:
+        return x
+    b, s, hk, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, hk, n_rep, d)).reshape(b, s, hk * n_rep, d)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq", *, causal: bool = True,
+                   scale: float | None = None) -> jax.Array:
+    """Call inside shard_map with seq sharded over `axis_name`.
+
+    q/k/v: [b, s_local, h|hk, d]. Returns [b, s_local, h, d].
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if scale is None:
+        scale = d ** -0.5
+    q_offset = idx * s_local
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - t) % n
+        k_offset = src * s_local
+        m_i, l_i, pv_i = _block_stats(q, k_blk, v_blk, q_offset, k_offset,
+                                      causal, scale)
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        l_new = alpha * l + beta * l_i
+        # pv_i was computed against m_i; rescale into the new basis
+        acc_new = acc * alpha.transpose(0, 2, 1, 3) + \
+            pv_i * beta.transpose(0, 2, 1, 3)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s_local, 1), NEG_INF / 2, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    (k_f, v_f, m_f, l_f, acc_f), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    l_f = jnp.where(l_f == 0.0, 1.0, l_f)
+    out = acc_f / l_f.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "seq", *, causal: bool = True,
+                      scale: float | None = None,
+                      inner_impl: str = "xla") -> jax.Array:
+    """All-to-all SP: re-shard seq->heads, dense attention, shard back.
+
+    q: [b, s_local, h, d]; requires h % axis_size == 0. Call inside
+    shard_map with `axis_name` bound.
+    """
+    n = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+
+    # [b, s_local, h, d] -> [b, n*s_local, h/n, d]
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    from ray_tpu.ops.attention import xla_attention
+
+    out = xla_attention(qg, kg, vg, causal=causal, scale=scale)
+    return gather_heads(out).astype(q.dtype)

@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int,
+                     theta: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """Precompute cos/sin tables, shape [max_len, head_dim // 2], fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """Rotate pairs (split-half convention, llama-style).
+
+    x: [..., seq, heads, head_dim]; cos/sin: [max_len, head_dim//2] or
+    already gathered [..., seq, head_dim//2]. positions: [..., seq] int32
+    (defaults to arange, which is the common pre-fill case).
+    """
+    seq = x.shape[-3]
+    if positions is None and cos.ndim == 2:
+        cos = cos[:seq]
+        sin = sin[:seq]
+    elif positions is not None:
+        cos = jnp.take(cos, positions, axis=0)
+        sin = jnp.take(sin, positions, axis=0)
+    # broadcast over heads: [..., seq, 1, head_dim//2]
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    dtype = x.dtype
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1)
+    return out.astype(dtype)

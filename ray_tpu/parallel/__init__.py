@@ -1,0 +1,13 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+This is where the TPU build diverges hardest from the reference: instead
+of NCCL process groups bolted on from outside (ref:
+python/ray/util/collective/), parallelism is expressed as named mesh axes
+(data / fsdp / tensor / seq / expert) and XLA inserts the collectives
+(ref mapping documented in SURVEY.md §2.4/§2.5).
+"""
+
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP,  # noqa: F401
+                                   AXIS_SEQ, AXIS_TENSOR, MeshConfig,
+                                   build_mesh, local_mesh, named_sharding,
+                                   shard_params, replicated)

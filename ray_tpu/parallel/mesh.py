@@ -1,0 +1,141 @@
+"""Device meshes and sharding rules.
+
+The mesh is the TPU-native replacement for the reference's process-group
+world (ref: train/torch/config.py:66 `_setup_torch_process_group`): axes
+are named by *role* and every parallelism strategy in SURVEY.md §2.4 is a
+mesh axis:
+
+  data   — batch sharding (DP); gradient allreduce rides ICI automatically
+  fsdp   — parameter/optimizer sharding (ZeRO/FSDP as GSPMD, not a wrapper)
+  tensor — megatron-style TP within attention/MLP blocks
+  seq    — sequence/context parallelism (ring attention over ICI neighbors)
+  expert — MoE expert parallelism (all_to_all dispatch)
+
+`mesh_utils.create_device_mesh` lays axes out so the innermost axes land
+on physically adjacent chips (ICI rings), which is what makes ring
+collectives fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+# canonical axis order: outer (DCN-friendly) -> inner (ICI-friendly).
+AXIS_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Sizes per axis; at most one axis may be -1 (fill remaining devices)."""
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
+                AXIS_EXPERT: self.expert, AXIS_SEQ: self.seq,
+                AXIS_TENSOR: self.tensor}
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        return build_mesh(self.axis_sizes(), devices)
+
+
+def build_mesh(axes: dict[str, int],
+               devices: Sequence[jax.Device] | None = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    names = [a for a in AXIS_ORDER if a in axes]
+    names += [a for a in axes if a not in names]  # custom axes at the end
+    sizes = [axes[a] for a in names]
+    fills = [i for i, s in enumerate(sizes) if s == -1]
+    if len(fills) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if fills:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[fills[0]] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {math.prod(sizes)} "
+            f"devices, have {n}")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            sizes, devices=list(devices))
+    except Exception:
+        dev_array = np.array(list(devices)).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def local_mesh(**axes: int) -> Mesh:
+    """Convenience: build a mesh over all local devices, e.g.
+    local_mesh(data=-1, tensor=2)."""
+    return build_mesh(dict(axes))
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# --------------------------------------------------------- logical axis rules
+# flax-style logical-to-mesh rules: params carry logical axis names; the
+# rules map them to mesh axes. Multiple strategies = just different rules.
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": (AXIS_DATA, AXIS_FSDP),
+    "seq": AXIS_SEQ,
+    "embed": AXIS_FSDP,          # FSDP shards params along embed dim
+    "heads": AXIS_TENSOR,
+    "kv_heads": AXIS_TENSOR,
+    "head_dim": None,
+    "mlp": AXIS_TENSOR,
+    "vocab": AXIS_TENSOR,
+    "expert": AXIS_EXPERT,
+    "layers": None,              # scanned-layer leading dim stays replicated
+    None: None,
+}
+
+
+def spec_for(logical_axes: Sequence[str | None],
+             rules: dict[str, Any] | None = None) -> P:
+    rules = rules or DEFAULT_RULES
+    entries = []
+    for ax in logical_axes:
+        entries.append(rules.get(ax))
+    return P(*entries)
+
+
+def shard_params(params: Any, logical_specs: Any, mesh: Mesh,
+                 rules: dict[str, Any] | None = None) -> Any:
+    """Map a pytree of logical axis tuples to NamedShardings (same tree
+    structure as params)."""
+    def to_sharding(spec):
+        return NamedSharding(mesh, spec_for(spec, rules))
+
+    return jax.tree.map(
+        to_sharding, logical_specs,
+        is_leaf=lambda x: isinstance(x, (tuple, list)) and all(
+            isinstance(e, (str, type(None))) for e in x))
+
+
+def mesh_axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

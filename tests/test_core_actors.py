@@ -1,0 +1,173 @@
+"""Actor semantics tests (model: reference python/ray/tests/test_actor*.py
+— ordering, concurrency, restarts, named actors)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = rt.init(num_cpus=8, resources={"TPU": 8})
+    yield ctx
+    rt.shutdown()
+
+
+@rt.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+
+def test_actor_basic(cluster):
+    c = Counter.remote()
+    assert rt.get(c.incr.remote()) == 1
+    assert rt.get(c.incr.remote(5)) == 6
+    assert rt.get(c.value.remote()) == 6
+
+
+def test_actor_ctor_args(cluster):
+    c = Counter.remote(100)
+    assert rt.get(c.value.remote()) == 100
+
+
+def test_actor_call_ordering(cluster):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    # pipelined calls must execute in submission order
+    assert rt.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_error(cluster):
+    @rt.remote
+    class Fragile:
+        def ok(self):
+            return "ok"
+
+        def bad(self):
+            raise RuntimeError("actor method error")
+
+    a = Fragile.remote()
+    with pytest.raises(rt.TaskError, match="actor method error"):
+        rt.get(a.bad.remote())
+    # actor survives a method error
+    assert rt.get(a.ok.remote()) == "ok"
+
+
+def test_actor_handle_passing(cluster):
+    c = Counter.remote()
+
+    @rt.remote
+    def bump(counter):
+        return rt.get(counter.incr.remote(10))
+
+    assert rt.get(bump.remote(c)) == 10
+    assert rt.get(c.value.remote()) == 10
+
+
+def test_named_actor(cluster):
+    Counter.options(name="shared_counter").remote(7)
+    time.sleep(0.1)
+    h = rt.get_actor("shared_counter")
+    assert rt.get(h.value.remote()) == 7
+    with pytest.raises(ValueError):
+        rt.get_actor("no_such_actor")
+
+
+def test_actor_death_raises(cluster):
+    @rt.remote
+    class Suicidal:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    a = Suicidal.remote()
+    assert rt.get(a.ping.remote()) == "pong"
+    ref = a.die.remote()
+    with pytest.raises((rt.ActorDiedError, rt.RayTpuError)):
+        rt.get(ref, timeout=30)
+    with pytest.raises((rt.ActorDiedError, rt.RayTpuError)):
+        rt.get(a.ping.remote(), timeout=30)
+
+
+def test_actor_restart(cluster):
+    @rt.remote(max_restarts=1, max_task_retries=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def crash_once(self):
+            import os
+            import tempfile
+
+            path = f"{tempfile.gettempdir()}/rayt_phoenix"
+            if not os.path.exists(path):
+                open(path, "w").close()
+                os._exit(1)
+            os.unlink(path)
+            return "reborn"
+
+        def ping(self):
+            return "pong"
+
+    a = Phoenix.remote()
+    assert rt.get(a.ping.remote()) == "pong"
+    assert rt.get(a.crash_once.remote(), timeout=60) == "reborn"
+
+
+def test_kill_actor(cluster):
+    a = Counter.remote()
+    assert rt.get(a.incr.remote()) == 1
+    rt.kill(a)
+    with pytest.raises((rt.ActorDiedError, rt.RayTpuError)):
+        rt.get(a.incr.remote(), timeout=30)
+
+
+def test_async_actor(cluster):
+    @rt.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.work.remote(i) for i in range(8)]
+    assert rt.get(refs) == [i * 2 for i in range(8)]
+
+
+def test_max_concurrency_threaded(cluster):
+    @rt.remote(max_concurrency=4)
+    class Slow:
+        def block(self, t):
+            time.sleep(t)
+            return "done"
+
+    a = Slow.remote()
+    t0 = time.monotonic()
+    refs = [a.block.remote(0.5) for _ in range(4)]
+    rt.get(refs)
+    # 4 concurrent 0.5s sleeps should take ~0.5s, far less than 2s serial
+    assert time.monotonic() - t0 < 1.9
+
+
+def test_actor_in_placement_group(cluster):
+    pg = rt.placement_group([{"CPU": 1}], strategy="PACK")
+    c = Counter.options(
+        scheduling_strategy=pg.bundle_strategy(0)).remote()
+    assert rt.get(c.incr.remote()) == 1
+    rt.remove_placement_group(pg)

@@ -1,0 +1,208 @@
+"""Task/object semantics tests (model: reference python/ray/tests/
+test_basic*.py — same behaviors, TPU-build runtime)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = rt.init(num_cpus=8, resources={"TPU": 8})
+    yield ctx
+    rt.shutdown()
+
+
+def test_basic_task(cluster):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_kwargs_and_options(cluster):
+    @rt.remote
+    def f(a, b=10, c=0):
+        return a + b + c
+
+    assert rt.get(f.remote(1, c=5)) == 16
+    assert rt.get(f.options(name="custom").remote(2)) == 12
+
+
+def test_many_small_tasks(cluster):
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert rt.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(cluster):
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_object_ref_args(cluster):
+    @rt.remote
+    def plus_one(x):
+        return x + 1
+
+    ref = plus_one.remote(1)
+    ref2 = plus_one.remote(ref)
+    ref3 = plus_one.remote(ref2)
+    assert rt.get(ref3) == 4
+
+
+def test_put_get_roundtrip(cluster):
+    obj = {"a": np.arange(10), "b": "text"}
+    ref = rt.put(obj)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(out["a"], obj["a"])
+    assert out["b"] == "text"
+
+
+def test_put_as_task_arg(cluster):
+    @rt.remote
+    def total(arr):
+        return float(arr.sum())
+
+    big = np.ones((512, 1024), dtype=np.float32)  # 2 MiB -> shm path
+    ref = rt.put(big)
+    assert rt.get(total.remote(ref)) == big.sum()
+
+
+def test_large_return_via_shm(cluster):
+    @rt.remote
+    def make_big():
+        return np.arange(1 << 20, dtype=np.float32)  # 4 MiB
+
+    out = rt.get(make_big.remote())
+    assert out.shape == (1 << 20,)
+    assert out[-1] == float((1 << 20) - 1)
+
+
+def test_task_error_propagates(cluster):
+    @rt.remote
+    def boom():
+        raise ValueError("intentional")
+
+    with pytest.raises(rt.TaskError, match="intentional"):
+        rt.get(boom.remote())
+
+
+def test_error_through_dependency(cluster):
+    @rt.remote
+    def boom():
+        raise ValueError("dep fail")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(rt.RayTpuError):
+        rt.get(consume.remote(boom.remote()))
+
+
+def test_nested_tasks(cluster):
+    @rt.remote
+    def inner(x):
+        return x * 2
+
+    @rt.remote
+    def outer(x):
+        return rt.get(inner.remote(x)) + 1
+
+    assert rt.get(outer.remote(10)) == 21
+
+
+def test_wait(cluster):
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = rt.wait([f, s], num_returns=1, timeout=2.5)
+    assert ready == [f] and not_ready == [s]
+    assert rt.get(s) == "slow"
+
+
+def test_get_timeout(cluster):
+    @rt.remote
+    def sleepy():
+        time.sleep(10)
+
+    ref = sleepy.remote()
+    with pytest.raises(rt.GetTimeoutError):
+        rt.get(ref, timeout=0.5)
+
+
+def test_worker_crash_retry(cluster):
+    # A task that kills its worker on first attempt; default retries rerun it.
+    @rt.remote(max_retries=2)
+    def flaky(marker):
+        import os
+        import tempfile
+
+        path = f"{tempfile.gettempdir()}/rayt_flaky_{marker}"
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        os.unlink(path)
+        return "recovered"
+
+    assert rt.get(flaky.remote(time.time_ns())) == "recovered"
+
+
+def test_worker_crash_no_retry_raises(cluster):
+    @rt.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(rt.WorkerCrashedError):
+        rt.get(die.remote())
+
+
+def test_resource_demand_scheduling(cluster):
+    @rt.remote(num_tpus=8)
+    def uses_all_tpus():
+        return "tpu"
+
+    @rt.remote(resources={"TPU": 4})
+    def custom_resource():
+        return "half"
+
+    assert rt.get(uses_all_tpus.remote()) == "tpu"
+    assert rt.get(custom_resource.remote()) == "half"
+
+
+def test_infeasible_task_fails(cluster):
+    @rt.remote(num_tpus=1000)
+    def impossible():
+        return 1
+
+    with pytest.raises(rt.RayTpuError):
+        rt.get(impossible.remote())
+
+
+def test_cluster_resources_api(cluster):
+    total = rt.cluster_resources()
+    assert total.get("CPU") == 8.0
+    assert total.get("TPU") == 8.0
+    avail = rt.available_resources()
+    assert avail.get("CPU", 0) > 0
