@@ -1,0 +1,91 @@
+"""Headline benchmark: Llama train-step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star metric (BASELINE.json) is Llama fine-tune tokens/sec/chip
+at >=35% MFU on TPU; `vs_baseline` here is achieved-MFU / 0.35 so >=1.0
+means the target is met. Falls back to a smaller model + CPU-sane sizes
+when no TPU is present (CI) — the driver runs this on the real chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# bf16 peak FLOP/s per chip by TPU generation (public specs)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for gen, peak in PEAK_FLOPS.items():
+        if gen in kind:
+            return peak
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return PEAK_FLOPS.get(gen, 197e12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        preset, batch, seq, steps = "160m", 8, 2048, 20
+    else:
+        preset, batch, seq, steps = "debug", 4, 128, 5
+
+    cfg = llama.config_for(preset, max_seq_len=seq,
+                           attn_impl="flash" if on_tpu else "xla")
+    mesh = build_mesh({"data": 1}, jax.devices()[:1])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    step, state = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(3e-4), params,
+        llama.param_logical_axes(cfg), mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    data = shard_batch(data, mesh)
+
+    # warmup / compile. Sync via host readback of a scalar that depends on
+    # the step — block_until_ready can be a no-op on tunneled backends.
+    state, aux = step(state, data)
+    float(aux["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, aux = step(state, data)
+    float(aux["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    flops_per_tok = cfg.flops_per_token()
+    achieved = tok_s * flops_per_tok
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
+    mfu = achieved / peak
+    print(json.dumps({
+        "metric": f"llama_{preset}_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -128,9 +128,12 @@ class ActorClass:
 
     def _actor_options(self) -> ActorOptions:
         o = self._opts
+        # Actors default to 0 CPUs while running (ref semantics:
+        # python/ray/actor.py — actors need 1 CPU to schedule but hold 0,
+        # so long-lived actors don't starve the node of task resources).
         return ActorOptions(
             resources=_make_resources(
-                o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
+                o.get("num_cpus", 0), o.get("num_tpus"), o.get("memory"),
                 o.get("resources")),
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),

@@ -237,7 +237,9 @@ class GcsServer:
     async def _schedule_actor(self, actor_id: ActorID):
         info = self.actors[actor_id]
         spec = self.actor_specs[actor_id]
-        demand = dict(spec.resources)
+        # placement check only: zero-resource actors still target a node
+        # with a CPU free (they hold nothing once placed)
+        demand = dict(spec.resources) or {"CPU": 1.0}
         deadline = time.monotonic() + 300.0
         while time.monotonic() < deadline:
             node_id = self._pick_node_for(demand, spec.scheduling_strategy)

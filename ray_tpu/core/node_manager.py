@@ -177,10 +177,15 @@ class NodeManager:
             w = next((c for c in self._unregistered if c.info is None), None)
         if w is None:
             w = _Worker(proc=_FakeProc())
-        else:
+            self._unregistered.append(w)
+        # conn must be live before the worker becomes claimable (info set /
+        # in self.workers), else a concurrent lease grant sees conn=None.
+        # Stay in _unregistered across the await so _replenish_pool keeps
+        # counting this worker as "starting".
+        w.conn = await connect(info.address.host, info.address.port)
+        if w in self._unregistered:
             self._unregistered.remove(w)
         w.info = info
-        w.conn = await connect(info.address.host, info.address.port)
         self.workers[info.worker_id] = w
         w.registered.set()
         self._maybe_grant_pending()
@@ -192,10 +197,23 @@ class NodeManager:
         the same worker (which would co-locate a task with an actor and
         deadlock its executor)."""
         for w in self.workers.values():
-            if not w.busy and w.actor_id is None:
+            if not w.busy and w.actor_id is None and w.conn is not None:
                 w.busy = True
+                self._replenish_pool()
                 return w
         return None
+
+    def _replenish_pool(self):
+        """Keep idle_worker_pool_size workers warm (ref: worker_pool.h:212
+        prestart) so actor/task starts don't pay interpreter cold-boot."""
+        if self._stopping:
+            return
+        target = get_config().idle_worker_pool_size
+        idle = sum(1 for w in self.workers.values()
+                   if not w.busy and w.actor_id is None)
+        starting = len(self._unregistered)
+        for _ in range(target - idle - starting):
+            self._spawn_worker()
 
     async def _get_idle_worker(self) -> _Worker:
         w = self._try_claim_idle()
@@ -205,7 +223,8 @@ class NodeManager:
         cfg = get_config()
         deadline = time.monotonic() + cfg.worker_startup_timeout_s
         while time.monotonic() < deadline:
-            if spawned.info is not None and not spawned.busy:
+            if spawned.info is not None and spawned.conn is not None \
+                    and not spawned.busy:
                 spawned.busy = True
                 return spawned
             # registration may have been matched to another _Worker entry;
@@ -310,9 +329,17 @@ class NodeManager:
         """Lease a dedicated worker and run the actor-creation task on it.
         Returns (WorkerInfo, error_str|None) or None if resources are busy."""
         demand = dict(spec.resources)
-        if not self._can_ever_satisfy(demand):
+        # Zero-resource actors still need a 1-CPU *placement* check (ref
+        # semantics: actors need 1 CPU to schedule but hold 0) so they don't
+        # land on CPU-starved nodes; nothing is deducted for them.
+        placement_demand = demand or {"CPU": 1.0}
+        if not self._can_ever_satisfy(placement_demand):
             return None
-        if not self._try_acquire(demand):
+        if demand:
+            if not self._try_acquire(demand):
+                return None
+        elif any(self.resources_available.get(r, 0.0) < amt
+                 for r, amt in placement_demand.items()):
             return None
         try:
             w = await self._get_idle_worker()
@@ -325,8 +352,25 @@ class NodeManager:
         try:
             err = await w.conn.call("create_actor", spec, timeout=300)
         except Exception as e:
-            await self._on_worker_death(w) if w.proc.poll() is not None else None
-            return (None, f"actor creation push failed: {e}")
+            # Creation not committed: the GCS _schedule_actor loop owns the
+            # retry (returning None). Keep this the ONLY recovery path:
+            # clear actor_id first so worker-death reaping doesn't also
+            # report an actor failure, and recycle the process rather than
+            # returning it to the idle pool (its state is unknown — the
+            # create may still be executing on it).
+            w.actor_id = None
+            if w.lease_resources:
+                self._release_resources(w.lease_resources)
+                w.lease_resources = None
+            if w.info is not None:
+                self.workers.pop(w.info.worker_id, None)
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+            self._maybe_grant_pending()
+            logger.warning("actor creation push failed, will reschedule: %s", e)
+            return None
         if err is not None:
             w.busy = False
             w.actor_id = None

@@ -1,0 +1,6 @@
+"""Model zoo: TPU-first implementations (the reference delegates models to
+torch; here the model layer is co-designed with sharding, see
+models/llama.py docstring)."""
+
+from ray_tpu.models import llama  # noqa: F401
+from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init, mlp_loss  # noqa: F401

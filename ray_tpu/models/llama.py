@@ -1,0 +1,323 @@
+"""Llama-family transformer, TPU-first.
+
+The reference framework (LydiaXwQ/ray) carries no model code of its own —
+LLMs arrive via torch/DeepSpeed examples (ref:
+release/air_examples/dolly_v2_lightning_fsdp_finetuning/,
+doc/source/train/examples/deepspeed Llama-2 fine-tune). For the TPU build
+the model layer is first-class because GSPMD sharding, remat, and kernel
+choice must be co-designed with the parallelism layer (SURVEY.md §2.4).
+
+Design (idiomatic JAX, nothing torch-shaped):
+
+* Params are a plain pytree of ``jnp`` arrays; per-layer weights are
+  **stacked on a leading "layers" axis** and the block stack is a single
+  ``lax.scan`` — one trace/compile of one block regardless of depth.
+* Every parameter has a tuple of *logical axis names*
+  (``param_logical_axes``); ``ray_tpu.parallel.mesh.shard_params`` maps
+  them to mesh axes, so DP/FSDP/TP/SP/EP are just different rule tables.
+* Compute in bf16, params f32 (configurable), softmax/norm/rope in f32.
+* ``jax.checkpoint`` around each block (policy: save nothing but dots'
+  inputs) trades FLOPs for HBM — the standard TPU recipe.
+* Attention dispatches to the Pallas flash kernel on TPU, XLA elsewhere,
+  and to ring attention (ppermute over the ICI ring) when the mesh has a
+  nontrivial ``seq`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.cross_entropy import softmax_cross_entropy
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
+    attn_impl: str = "auto"
+    seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd, 6ND rule plus
+        attention quadratic term)."""
+        n_params = self.num_params(include_embed=False)
+        attn = 12 * self.n_layers * self.dim * self.max_seq_len
+        return 6 * n_params + attn
+
+    def num_params(self, include_embed: bool = True) -> int:
+        d, h = self.dim, self.hidden_dim
+        kv_dim = self.n_kv_heads * self.head_dim
+        per_layer = (d * d + 2 * d * kv_dim + d * d) + 3 * d * h + 2 * d
+        total = self.n_layers * per_layer + d
+        if include_embed:
+            total += self.vocab_size * d
+            if not self.tie_embeddings:
+                total += d * self.vocab_size
+        return total
+
+
+# ----------------------------------------------------------------- presets
+PRESETS: dict[str, dict] = {
+    # debug-size model for tests / CI (CPU-mesh friendly)
+    "debug": dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, hidden_dim=128, max_seq_len=128),
+    "160m": dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                 n_kv_heads=12, hidden_dim=2048, max_seq_len=2048),
+    "1b": dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+               n_kv_heads=8, hidden_dim=5632, max_seq_len=2048),
+    "llama2-7b": dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+                      n_kv_heads=32, hidden_dim=11008, max_seq_len=4096),
+    "llama2-13b": dict(vocab_size=32000, dim=5120, n_layers=40, n_heads=40,
+                       n_kv_heads=40, hidden_dim=13824, max_seq_len=4096),
+    "llama3-8b": dict(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                      n_kv_heads=8, hidden_dim=14336, max_seq_len=8192,
+                      rope_theta=500000.0),
+    "llama2-70b": dict(vocab_size=32000, dim=8192, n_layers=80, n_heads=64,
+                       n_kv_heads=8, hidden_dim=28672, max_seq_len=4096),
+}
+
+
+def config_for(name: str, **overrides) -> LlamaConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    kw = dict(PRESETS[name])
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+# ------------------------------------------------------------------- params
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize a param pytree. Per-layer weights carry a leading
+    [n_layers] axis so the block stack scans."""
+    pd = cfg.param_dtype
+    d, h, L = cfg.dim, cfg.hidden_dim, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    k = iter(jax.random.split(key, 16))
+
+    def dense(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(pd)
+
+    params = {
+        "embed": dense(next(k), (cfg.vocab_size, d), d),
+        "layers": {
+            "wq": dense(next(k), (L, d, nh * hd), d),
+            "wk": dense(next(k), (L, d, nkv * hd), d),
+            "wv": dense(next(k), (L, d, nkv * hd), d),
+            "wo": dense(next(k), (L, nh * hd, d), nh * hd),
+            "w_gate": dense(next(k), (L, d, h), d),
+            "w_up": dense(next(k), (L, d, h), d),
+            "w_down": dense(next(k), (L, h, d), h),
+            "attn_norm": jnp.ones((L, d), pd),
+            "mlp_norm": jnp.ones((L, d), pd),
+        },
+        "final_norm": jnp.ones((d,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (d, cfg.vocab_size), d)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> dict:
+    """Same tree structure as init_params, leaves = logical-axis tuples
+    consumed by parallel.mesh.shard_params."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "attn_norm": ("layers", None),
+            "mlp_norm": ("layers", None),
+        },
+        "final_norm": (None,),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ------------------------------------------------------------------ forward
+def _attention(cfg: LlamaConfig, q, k, v):
+    if cfg.attn_impl == "ring":
+        return ring_attention(q, k, v, cfg.seq_axis, causal=True)
+    if cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ring_attention import ulysses_attention
+
+        return ulysses_attention(q, k, v, cfg.seq_axis, causal=True)
+    return dot_product_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+
+def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
+    """One transformer block. x: [b, s, d] (cfg.dtype)."""
+    b, s, d = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    dt = cfg.dtype
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(b, s, nh, hd)
+    kk = (h @ layer["wk"].astype(dt)).reshape(b, s, nkv, hd)
+    vv = (h @ layer["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin, positions)
+    kk = apply_rope(kk, cos, sin, positions)
+    attn = _attention(cfg, q, kk, vv).reshape(b, s, nh * hd)
+    x = x + attn @ layer["wo"].astype(dt)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    return x
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            positions: jax.Array | None = None) -> jax.Array:
+    """tokens: [b, s] int32 -> logits [b, s, vocab] (f32).
+
+    The layer stack is one lax.scan over stacked weights; each step is
+    optionally rematerialized.
+    """
+    dt = cfg.dtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def step(x, layer):
+        return _block(cfg, x, layer, cos, sin, positions), None
+
+    if cfg.remat:
+        step = jax.checkpoint(
+            step, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    x, _ = jax.lax.scan(step, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(dt)
+    return (x @ head).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig):
+    """batch: {"tokens": [b, s], "targets": [b, s]} -> (loss, aux)."""
+    logits = forward(params, batch["tokens"], cfg)
+    loss, n_tok = softmax_cross_entropy(logits, batch["targets"])
+    return loss, {"loss": loss, "tokens": n_tok}
+
+
+# ----------------------------------------------------------------- decoding
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None
+                  ) -> dict:
+    max_len = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def kv_cache_logical_axes() -> dict:
+    return {"k": ("layers", "batch", None, "kv_heads", "head_dim"),
+            "v": ("layers", "batch", None, "kv_heads", "head_dim"),
+            "length": ()}
+
+
+def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
+                  positions, cache_len):
+    """Single-step (or chunked prefill) block with KV cache.
+
+    x: [b, s, d]; k_cache/v_cache: [b, max_len, nkv, hd]. Writes new K/V at
+    [cache_len, cache_len+s) via dynamic_update_slice (static shapes).
+    """
+    b, s, d = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    dt = cfg.dtype
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(b, s, nh, hd)
+    kk = (h @ layer["wk"].astype(dt)).reshape(b, s, nkv, hd)
+    vv = (h @ layer["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin, positions)
+    kk = apply_rope(kk, cos, sin, positions)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, kk, (0, cache_len, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, vv, (0, cache_len, 0, 0))
+    # mask: key j visible iff j <= query position
+    max_len = k_cache.shape[1]
+    q_pos = positions  # [b, s] absolute positions
+    k_pos = jnp.arange(max_len)[None, :]
+    mask = k_pos[:, None, :] <= q_pos[..., None]          # [b, s, max_len]
+    kr = _repeat_heads(k_cache, nh // nkv)
+    vr = _repeat_heads(v_cache, nh // nkv)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, s, nh * hd)
+    x = x + attn @ layer["wo"].astype(dt)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    x = x + (jax.nn.silu(h @ layer["w_gate"].astype(dt))
+             * (h @ layer["w_up"].astype(dt))) @ layer["w_down"].astype(dt)
+    return x, k_cache, v_cache
+
+
+def _repeat_heads(x, n_rep):
+    if n_rep == 1:
+        return x
+    b, s, hk, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, hk, n_rep, d)).reshape(b, s, hk * n_rep, d)
+
+
+def decode_step(params: dict, cache: dict, tokens: jax.Array,
+                cfg: LlamaConfig) -> tuple[jax.Array, dict]:
+    """Append `tokens` [b, s] to the cache, return logits for the last
+    position [b, vocab] and the updated cache. jit-able with static s
+    (s=1 for autoregressive decode; larger s = chunked prefill)."""
+    b, s = tokens.shape
+    dt = cfg.dtype
+    cache_len = cache["length"]
+    positions = cache_len + jnp.arange(s)[None, :].repeat(b, 0)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def step(x, inputs):
+        layer, kc, vc = inputs
+        x, kc, vc = _decode_block(cfg, x, layer, kc, vc, cos, sin,
+                                  positions, cache_len)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(dt)
+    logits = (x[:, -1] @ head).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "length": cache_len + s}
+    return logits, new_cache

@@ -116,12 +116,24 @@ DEFAULT_RULES: dict[str, Any] = {
 
 
 def spec_for(logical_axes: Sequence[str | None],
-             rules: dict[str, Any] | None = None) -> P:
+             rules: dict[str, Any] | None = None,
+             mesh: Mesh | None = None) -> P:
+    """Map logical axis names to a PartitionSpec. When `mesh` is given,
+    entries referencing axes absent from the mesh (or of size 1) are
+    dropped — the same rule table works on any mesh shape."""
     rules = rules or DEFAULT_RULES
-    entries = []
-    for ax in logical_axes:
-        entries.append(rules.get(ax))
-    return P(*entries)
+    present = None if mesh is None else {
+        a for a in mesh.axis_names if mesh.shape[a] > 1}
+
+    def keep(entry):
+        if entry is None or present is None:
+            return entry
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in present)
+            return kept if kept else None
+        return entry if entry in present else None
+
+    return P(*[keep(rules.get(ax)) for ax in logical_axes])
 
 
 def shard_params(params: Any, logical_specs: Any, mesh: Mesh,
@@ -129,7 +141,7 @@ def shard_params(params: Any, logical_specs: Any, mesh: Mesh,
     """Map a pytree of logical axis tuples to NamedShardings (same tree
     structure as params)."""
     def to_sharding(spec):
-        return NamedSharding(mesh, spec_for(spec, rules))
+        return NamedSharding(mesh, spec_for(spec, rules, mesh))
 
     return jax.tree.map(
         to_sharding, logical_specs,

@@ -158,6 +158,7 @@ def test_max_concurrency_threaded(cluster):
             return "done"
 
     a = Slow.remote()
+    rt.get(a.block.remote(0.0))  # warm up: exclude actor cold-start
     t0 = time.monotonic()
     refs = [a.block.remote(0.5) for _ in range(4)]
     rt.get(refs)

@@ -1,0 +1,151 @@
+"""Model-layer tests: llama forward/loss/decode parity, MLP, sharded
+train step on the 8-device virtual CPU mesh (SURVEY.md §4 implications:
+CPU-device JAX fake backend stands in for pod slices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_loss
+from ray_tpu.parallel.mesh import MeshConfig
+from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.config_for("debug", remat=False, attn_impl="xla")
+
+
+@pytest.fixture
+def params(cfg):
+    # function-scoped: train steps donate state buffers, and device_put
+    # memoization can alias them across build_train_step calls
+    return llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_forward_shape(cfg, params):
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_decreases_under_sgd(cfg, params):
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                     cfg.vocab_size),
+    }
+    batch["targets"] = jnp.roll(batch["tokens"], -1, axis=1)
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, batch):
+        (loss, _), g = jax.value_and_grad(
+            llama.loss_fn, has_aux=True)(params, batch, cfg)
+        updates, state = opt.update(g, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    p = params
+    losses = []
+    for _ in range(10):
+        p, state, loss = step(p, state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_decode_matches_forward(cfg, params):
+    """KV-cache decode must agree with the dense forward pass."""
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0,
+                                cfg.vocab_size)
+    dense = llama.forward(params, tokens, cfg)  # [1, 12, vocab]
+    cache = llama.init_kv_cache(cfg, 1, max_len=32)
+    # prefill first 8, then decode 4 one at a time
+    logits, cache = llama.decode_step(params, cache, tokens[:, :8], cfg)
+    np.testing.assert_allclose(logits, dense[:, 7], rtol=2e-2, atol=2e-2)
+    for i in range(8, 12):
+        logits, cache = llama.decode_step(params, cache, tokens[:, i:i + 1],
+                                          cfg)
+        np.testing.assert_allclose(logits, dense[:, i], rtol=2e-2, atol=2e-2)
+
+
+def test_remat_matches(cfg, params):
+    tokens = jnp.ones((1, 8), jnp.int32)
+    base = llama.forward(params, tokens, cfg)
+    import dataclasses
+
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    rem = llama.forward(params, tokens, cfg_r)
+    np.testing.assert_allclose(base, rem, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_train_step_dp_fsdp_tp(cfg, params):
+    """Full GSPMD train step over data=2 × fsdp=2 × tensor=2 on the
+    virtual CPU mesh — the multi-chip path the driver dry-runs."""
+    mesh = MeshConfig(data=2, fsdp=2, tensor=2).build()
+    opt = optax.adamw(1e-3)
+    step, state = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, params,
+        llama.param_logical_axes(cfg), mesh)
+    batch = {
+        "tokens": jnp.zeros((8, 16), jnp.int32),
+        "targets": jnp.zeros((8, 16), jnp.int32),
+    }
+    batch = shard_batch(batch, mesh)
+    state, aux = step(state, batch)
+    state, aux = step(state, batch)
+    assert int(state["step"]) == 2
+    assert np.isfinite(float(aux["loss"]))
+    # param sharding survived the update
+    wq = state["params"]["layers"]["wq"]
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(
+        None, "fsdp", "tensor")
+
+
+def test_grad_accum_matches_big_batch(cfg, params):
+    mesh = MeshConfig(data=2).build(jax.devices()[:2])
+    opt = optax.sgd(1e-2)
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                     cfg.vocab_size),
+    }
+    batch["targets"] = jnp.roll(batch["tokens"], -1, 1)
+
+    params2 = llama.init_params(cfg, jax.random.PRNGKey(0))
+    step1, state1 = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, params,
+        llama.param_logical_axes(cfg), mesh)
+    step2, state2 = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, params2,
+        llama.param_logical_axes(cfg), mesh, grad_accum=4)
+    s1, _ = step1(state1, shard_batch(batch, mesh))
+    s2, _ = step2(state2, shard_batch(batch, mesh))
+    a = jax.tree.leaves(s1["params"])[0]
+    b = jax.tree.leaves(s2["params"])[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_mlp_trains():
+    cfg = MLPConfig(in_dim=16, hidden=(32,), n_classes=4)
+    params = mlp_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+    y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        (loss, _), g = jax.value_and_grad(mlp_loss, has_aux=True)(
+            p, {"x": x, "y": y})
+        u, s = opt.update(g, s)
+        return optax.apply_updates(p, u), s, loss
+
+    losses = []
+    for _ in range(20):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
