@@ -248,7 +248,11 @@ class GcsServer:
                 continue
             conn = self.node_conns[node_id]
             try:
-                result = await conn.call("start_actor", spec, timeout=120.0)
+                # Must exceed the node-side create_actor push timeout (300s,
+                # node_manager rpc_start_actor): timing out first would make
+                # this retry loop create a duplicate actor while the first
+                # create is still running, leaking its worker + lease.
+                result = await conn.call("start_actor", spec, timeout=330.0)
             except Exception as e:
                 logger.warning("start_actor on %s failed: %s", node_id, e)
                 await asyncio.sleep(0.2)
@@ -292,7 +296,10 @@ class GcsServer:
         """Called by node managers when an actor's worker process dies."""
         actor_id, cause = arg
         info = self.actors.get(actor_id)
-        if info is None or info.state == ActorState.DEAD:
+        # RESTARTING means the previous worker is already accounted dead
+        # (e.g. kill() recorded it) — this report is stale, not a new death.
+        if info is None or info.state in (ActorState.DEAD,
+                                          ActorState.RESTARTING):
             return False
         await self._handle_actor_failure(info, cause)
         return True
@@ -310,6 +317,10 @@ class GcsServer:
                     "kill_actor_worker", actor_id)
             except Exception:
                 pass
+        # Record the death now (don't wait for the node's reap loop) so
+        # calls submitted after kill() returns fail fast instead of racing
+        # the SIGTERM to the still-live worker.
+        await self._handle_actor_failure(info, "killed via ray_tpu.kill()")
         return True
 
     def rpc_get_actor_info(self, conn, actor_id: ActorID):

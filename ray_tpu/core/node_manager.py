@@ -59,6 +59,7 @@ class NodeManager:
         self.gcs_conn: Connection | None = None
         self.workers: dict[WorkerID, _Worker] = {}
         self._unregistered: list[_Worker] = []
+        self._doomed: list[_Worker] = []  # terminated, awaiting reap
         self.shm = ShmObjectStore()
         # object directory: id -> {"size": int, "owner": WorkerInfo}
         self.object_dir: dict[ObjectID, dict] = {}
@@ -94,12 +95,12 @@ class NodeManager:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
-        for w in list(self.workers.values()) + self._unregistered:
+        for w in list(self.workers.values()) + self._unregistered + self._doomed:
             try:
                 w.proc.terminate()
             except Exception:
                 pass
-        for w in list(self.workers.values()) + self._unregistered:
+        for w in list(self.workers.values()) + self._unregistered + self._doomed:
             try:
                 w.proc.wait(timeout=3)
             except Exception:
@@ -132,6 +133,8 @@ class NodeManager:
                     await self._on_worker_death(w)
             self._unregistered = [w for w in self._unregistered
                                   if w.proc.poll() is None]
+            self._doomed = [w for w in self._doomed
+                            if w.proc.poll() is None]
             await asyncio.sleep(0.1)
 
     async def _on_worker_death(self, w: _Worker):
@@ -178,14 +181,20 @@ class NodeManager:
         if w is None:
             w = _Worker(proc=_FakeProc())
             self._unregistered.append(w)
-        # conn must be live before the worker becomes claimable (info set /
-        # in self.workers), else a concurrent lease grant sees conn=None.
-        # Stay in _unregistered across the await so _replenish_pool keeps
-        # counting this worker as "starting".
-        w.conn = await connect(info.address.host, info.address.port)
+        # Claim (set info) before the await so a concurrent registration
+        # can't grab this entry via the info-is-None fallback; stay in
+        # _unregistered so _replenish_pool keeps counting it as "starting".
+        # conn must be live before the worker enters self.workers
+        # (claimable), else a concurrent lease grant sees conn=None.
+        w.info = info
+        try:
+            w.conn = await connect(info.address.host, info.address.port)
+        except Exception:
+            if w in self._unregistered:
+                self._unregistered.remove(w)
+            raise
         if w in self._unregistered:
             self._unregistered.remove(w)
-        w.info = info
         self.workers[info.worker_id] = w
         w.registered.set()
         self._maybe_grant_pending()
@@ -368,6 +377,7 @@ class NodeManager:
                 w.proc.terminate()
             except Exception:
                 pass
+            self._doomed.append(w)  # keep poll()ing it so it gets reaped
             self._maybe_grant_pending()
             logger.warning("actor creation push failed, will reschedule: %s", e)
             return None

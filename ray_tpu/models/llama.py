@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.attention import _repeat_kv, dot_product_attention
 from ray_tpu.ops.cross_entropy import softmax_cross_entropy
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.ring_attention import ring_attention
@@ -273,8 +273,8 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     q_pos = positions  # [b, s] absolute positions
     k_pos = jnp.arange(max_len)[None, :]
     mask = k_pos[:, None, :] <= q_pos[..., None]          # [b, s, max_len]
-    kr = _repeat_heads(k_cache, nh // nkv)
-    vr = _repeat_heads(v_cache, nh // nkv)
+    kr = _repeat_kv(k_cache, nh // nkv)
+    vr = _repeat_kv(v_cache, nh // nkv)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
                         preferred_element_type=jnp.float32) * (hd ** -0.5)
     logits = jnp.where(mask[:, None], logits, -1e30)
@@ -285,14 +285,6 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     x = x + (jax.nn.silu(h @ layer["w_gate"].astype(dt))
              * (h @ layer["w_up"].astype(dt))) @ layer["w_down"].astype(dt)
     return x, k_cache, v_cache
-
-
-def _repeat_heads(x, n_rep):
-    if n_rep == 1:
-        return x
-    b, s, hk, d = x.shape
-    return jnp.broadcast_to(
-        x[:, :, :, None, :], (b, s, hk, n_rep, d)).reshape(b, s, hk * n_rep, d)
 
 
 def decode_step(params: dict, cache: dict, tokens: jax.Array,

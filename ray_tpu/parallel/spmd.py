@@ -134,7 +134,7 @@ def _opt_state_shardings(optimizer, params, param_shardings, mesh):
     return jax.tree.map(pick, shapes)
 
 
-def build_eval_step(loss_fn: Callable, mesh: Mesh, state_shardings=None):
+def build_eval_step(loss_fn: Callable):
     def eval_one(params, batch):
         _, aux = loss_fn(params, batch)
         return aux

@@ -1,0 +1,22 @@
+"""Utility layer: collectives, actor pool, queue, metrics (ref analog:
+python/ray/util/)."""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["collective", "ActorPool", "Queue", "metrics"]
+
+
+def __getattr__(name):
+    if name in ("collective", "metrics"):
+        return importlib.import_module(f"ray_tpu.util.{name}")
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+
+        return ActorPool
+    if name == "Queue":
+        from ray_tpu.util.queue import Queue
+
+        return Queue
+    raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")

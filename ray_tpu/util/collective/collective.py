@@ -1,0 +1,263 @@
+"""Out-of-band collectives between actors/tasks (ref analog:
+python/ray/util/collective/collective.py:120,258,423,472,531).
+
+Two planes, per SURVEY.md §2.5:
+  * **Device plane** — inside a jitted SPMD program, collectives are
+    `jax.lax.{psum,all_gather,ppermute,all_to_all}` over the mesh (ICI);
+    nothing here is involved. See ray_tpu.parallel.
+  * **Host plane** — this module: rendezvous + CPU collectives between
+    separate processes (actors/tasks), the analog of the reference's
+    Gloo groups with GCS-KV rendezvous
+    (collective_group/nccl_collective_group.py:29 `Rendezvous`).
+
+Rendezvous rides the GCS KV store exactly like the reference's
+NCCLUniqueId exchange: rank 0 starts a store server and publishes its
+address under `collective/<group>/store`; peers poll the key.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from ray_tpu.util.collective.store import (PeerServer, StoreServer, peer_send,
+                                           store_call)
+
+_NS = "collective"
+_groups: dict[str, "CollectiveGroup"] = {}
+_groups_lock = threading.Lock()
+
+
+def _core_worker():
+    from ray_tpu.core.object_ref import get_core_worker
+    from ray_tpu.core.runtime import get_runtime_context
+
+    cw = get_core_worker()
+    if cw is not None:
+        return cw
+    return get_runtime_context().core_worker
+
+
+def _kv_put(key: str, value, overwrite: bool = True):
+    import cloudpickle
+
+    cw = _core_worker()
+    cw.io.run(cw.gcs.kv_put(key, cloudpickle.dumps(value), namespace=_NS,
+                            overwrite=overwrite))
+
+
+def _kv_get(key: str):
+    import cloudpickle
+
+    cw = _core_worker()
+    raw = cw.io.run(cw.gcs.kv_get(key, namespace=_NS))
+    return None if raw is None else cloudpickle.loads(raw)
+
+
+def _kv_del(key: str):
+    cw = _core_worker()
+    cw.io.run(cw.gcs.kv_del(key, namespace=_NS))
+
+
+def _kv_wait(key: str, timeout: float) -> Any:
+    deadline = time.monotonic() + timeout
+    while True:
+        val = _kv_get(key)
+        if val is not None:
+            return val
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"rendezvous key {key!r} never appeared")
+        time.sleep(0.02)
+
+
+def _host_ip() -> str:
+    return os.environ.get("RAYT_NODE_IP", "127.0.0.1")
+
+
+class CollectiveGroup:
+    """One logical communicator: world_size ranks over the TCP store."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 timeout: float = 60.0):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range [0, {world_size})")
+        self.name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq: dict[str, int] = {}
+        self._seq_lock = threading.Lock()
+        self._store: StoreServer | None = None
+        self.peer = PeerServer()
+        _kv_put(f"{group_name}/peer/{rank}", (_host_ip(), self.peer.port))
+        if rank == 0:
+            self._store = StoreServer(world_size)
+            _kv_put(f"{group_name}/store", (_host_ip(), self._store.port))
+        self.store_addr = tuple(_kv_wait(f"{group_name}/store", timeout))
+        self._peer_addrs: dict[int, tuple[str, int]] = {}
+        self.barrier()  # everyone up before any op
+
+    # ------------------------------------------------------------- plumbing
+    def _next(self, kind: str) -> str:
+        with self._seq_lock:
+            n = self._seq.get(kind, 0)
+            self._seq[kind] = n + 1
+        return f"{kind}#{n}"
+
+    def _call(self, kind: str, payload, timeout: float = 300.0):
+        return store_call(self.store_addr, kind, self._next(kind), self.rank,
+                          payload, timeout)
+
+    def _peer_addr(self, rank: int) -> tuple[str, int]:
+        addr = self._peer_addrs.get(rank)
+        if addr is None:
+            addr = tuple(_kv_wait(f"{self.name}/peer/{rank}", 60.0))
+            self._peer_addrs[rank] = addr
+        return addr
+
+    # ----------------------------------------------------------- collectives
+    def barrier(self, timeout: float = 300.0):
+        self._call("barrier", None, timeout)
+
+    def allreduce(self, array, op: str = "sum", timeout: float = 300.0):
+        return self._call(f"allreduce:{op}", np.asarray(array), timeout)
+
+    def allgather(self, array, timeout: float = 300.0) -> list:
+        return self._call("gather", np.asarray(array), timeout)
+
+    def reducescatter(self, array, op: str = "sum", timeout: float = 300.0):
+        """Reduce across ranks, then scatter along axis 0 (rank i gets the
+        i-th split of the reduced array)."""
+        return self._call(f"reducescatter:{op}", np.asarray(array), timeout)
+
+    def broadcast(self, array=None, src_rank: int = 0, timeout: float = 300.0):
+        payload = np.asarray(array) if self.rank == src_rank else None
+        return self._call("bcast", payload, timeout)
+
+    def gather_obj(self, obj: Any, timeout: float = 300.0) -> list:
+        """Allgather of arbitrary picklable objects (rendezvous payloads)."""
+        return self._call("gather", obj, timeout)
+
+    def send(self, array, dst_rank: int, tag: int = 0):
+        if dst_rank == self.rank:
+            raise ValueError("cannot send to self")
+        peer_send(self._peer_addr(dst_rank), self.rank, tag, np.asarray(array))
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 300.0):
+        if src_rank == self.rank:
+            raise ValueError("cannot recv from self")
+        return self.peer.recv(src_rank, tag, timeout)
+
+    def destroy(self):
+        if self._store is not None:
+            _kv_del(f"{self.name}/store")
+            self._store.close()
+            self._store = None
+        _kv_del(f"{self.name}/peer/{self.rank}")
+        self.peer.close()
+
+
+# ------------------------------------------------------------------ module API
+def init_collective_group(world_size: int, rank: int, backend: str = "tcp",
+                          group_name: str = "default") -> CollectiveGroup:
+    """Imperative group setup — call in every participating actor/task
+    (ref: util/collective/collective.py:120)."""
+    if backend not in ("tcp", "gloo", "auto"):
+        raise ValueError(f"unsupported backend {backend!r}; the device data "
+                         "plane is jax.lax collectives inside pjit — use "
+                         "ray_tpu.parallel for in-mesh ops")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized")
+    group = CollectiveGroup(group_name, world_size, rank)
+    with _groups_lock:
+        _groups[group_name] = group
+    return group
+
+
+def create_collective_group(actors: Sequence, world_size: int,
+                            ranks: Sequence[int], backend: str = "tcp",
+                            group_name: str = "default") -> None:
+    """Declarative setup from the driver (ref:
+    util/collective/collective.py:151): records the rank assignment in GCS
+    KV; each actor lazily joins on its first collective call."""
+    if len(actors) != len(ranks) or len(set(ranks)) != len(ranks):
+        raise ValueError("actors/ranks must be same length and ranks unique")
+    for actor, rank in zip(actors, ranks):
+        _kv_put(f"{group_name}/decl/{actor._actor_id.hex()}",
+                (rank, world_size, backend))
+
+
+def _lazy_join(group_name: str) -> CollectiveGroup:
+    cw = _core_worker()
+    actor_id = getattr(cw, "actor_id", None)
+    if actor_id is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group() first")
+    decl = _kv_get(f"{group_name}/decl/{actor_id.hex()}")
+    if decl is None:
+        raise RuntimeError(
+            f"collective group {group_name!r}: this actor has no declared "
+            "rank (create_collective_group was not called for it)")
+    rank, world_size, backend = decl
+    return init_collective_group(world_size, rank, backend, group_name)
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    with _groups_lock:
+        group = _groups.get(group_name)
+    if group is None:
+        group = _lazy_join(group_name)
+    return group
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_group(group_name).world_size
+
+
+def allreduce(array, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).allreduce(array, op)
+
+
+def allgather(array, group_name: str = "default") -> list:
+    return get_group(group_name).allgather(array)
+
+
+def reducescatter(array, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).reducescatter(array, op)
+
+
+def broadcast(array=None, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default", tag: int = 0):
+    get_group(group_name).send(array, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    return get_group(group_name).recv(src_rank, tag)
