@@ -114,3 +114,68 @@ _context = SerializationContext()
 
 def get_context() -> SerializationContext:
     return _context
+
+
+# ------------------------------------------------- driver-local code shipping
+_by_value_registered: set[str] = set()
+_scanned_modules: set[str] = set()
+
+
+def ship_code_by_value(fn: Any) -> None:
+    """Make cloudpickle serialize `fn`'s defining module by value when that
+    module is driver-local (not installed in site/dist-packages), so workers
+    without the driver's sys.path can still unpickle it. Walks the module's
+    globals transitively so sibling driver-local modules it imports ship
+    too.
+
+    Ref analog: the function table ships pickled definitions through GCS KV
+    (python/ray/_private/function_manager.py:58); here the definition rides
+    inside the task spec instead, and by-value registration covers
+    module-level functions (closures/lambdas/__main__ are by-value already).
+    """
+    _register_module_tree(getattr(fn, "__module__", None))
+
+
+def _is_driver_local(mod) -> bool:
+    import sys
+
+    mod_file = getattr(mod, "__file__", None)
+    if mod_file is None:
+        return False
+    path = mod_file.replace("\\", "/")
+    if "/site-packages/" in path or "/dist-packages/" in path:
+        return False
+    return not path.startswith(getattr(sys, "base_prefix", "\0"))
+
+
+def _register_module_tree(mod_name: str | None) -> None:
+    import sys
+    import types
+
+    if not mod_name or mod_name in ("__main__", "builtins"):
+        return
+    if mod_name.split(".")[0] == "ray_tpu" or mod_name in _scanned_modules:
+        return
+    _scanned_modules.add(mod_name)
+    mod = sys.modules.get(mod_name)
+    if mod is None or not _is_driver_local(mod):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+        _by_value_registered.add(mod_name)
+    except Exception:
+        return
+    for value in list(vars(mod).values()):
+        if isinstance(value, types.ModuleType):
+            _register_module_tree(value.__name__)
+        else:
+            sub = getattr(value, "__module__", None)
+            if isinstance(sub, str):
+                _register_module_tree(sub)
+
+
+def dumps_code(fn: Any) -> bytes:
+    """Pickle a function/class for remote execution, shipping driver-local
+    module trees by value first."""
+    ship_code_by_value(fn)
+    return cloudpickle.dumps(fn)

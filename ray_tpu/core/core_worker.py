@@ -46,6 +46,12 @@ logger = setup_logger("core_worker")
 _TASK_PUSH_TIMEOUT = 7 * 24 * 3600.0
 
 
+def _dumps_code(fn) -> bytes:
+    from ray_tpu._internal.serialization import dumps_code
+
+    return dumps_code(fn)
+
+
 @dataclass
 class RefArg:
     """Marker for an ObjectRef positioned as a top-level task argument."""
@@ -457,7 +463,7 @@ class CoreWorker:
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=options.name or getattr(function, "__name__", "task"),
-            function_blob=cloudpickle.dumps(function),
+            function_blob=_dumps_code(function),
             args=spec_args, kwargs=spec_kwargs,
             num_returns=options.num_returns,
             resources=self._demand_for(options),
@@ -633,7 +639,7 @@ class CoreWorker:
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=getattr(cls, "__name__", "Actor"),
-            function_blob=cloudpickle.dumps(cls),
+            function_blob=_dumps_code(cls),
             args=spec_args, kwargs=spec_kwargs, num_returns=1,
             resources=self._demand_for(options),
             owner=self.worker_info, actor_id=actor_id,

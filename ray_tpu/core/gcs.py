@@ -18,7 +18,8 @@ import asyncio
 import time
 from typing import Any
 
-from ray_tpu._internal.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._internal.ids import (ActorID, JobID, NodeID, PlacementGroupID,
+                                   WorkerID)
 from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import Connection, RpcServer, connect
 from ray_tpu.core.common import (ActorInfo, ActorState, Address, NodeInfo,
@@ -43,6 +44,9 @@ class GcsServer:
         self.node_last_heartbeat: dict[NodeID, float] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
         self.actor_specs: dict[ActorID, TaskSpec] = {}
+        # worker ids whose death was reported before their start_actor
+        # reply landed (new-incarnation crash race)
+        self._dead_actor_workers: set[WorkerID] = set()
         self.named_actors: dict[tuple[str, str], ActorID] = {}
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
@@ -242,6 +246,8 @@ class GcsServer:
         demand = dict(spec.resources) or {"CPU": 1.0}
         deadline = time.monotonic() + 300.0
         while time.monotonic() < deadline:
+            if info.state == ActorState.DEAD:
+                return  # killed while pending placement
             node_id = self._pick_node_for(demand, spec.scheduling_strategy)
             if node_id is None or node_id not in self.node_conns:
                 await asyncio.sleep(0.2)
@@ -266,6 +272,19 @@ class GcsServer:
                 info.state = ActorState.DEAD
                 info.death_cause = err
                 await self.publish(CH_ACTOR, info)
+                return
+            if worker_info.worker_id in self._dead_actor_workers:
+                # the fresh worker died before this reply arrived
+                self._dead_actor_workers.discard(worker_info.worker_id)
+                await asyncio.sleep(0.1)
+                continue
+            if info.state == ActorState.DEAD:
+                # killed while creation was in flight: stop the worker we
+                # just made instead of resurrecting the actor
+                try:
+                    await conn.call("kill_actor_worker", actor_id)
+                except Exception:
+                    pass
                 return
             info.state = ActorState.ALIVE
             info.address = worker_info.address
@@ -294,13 +313,23 @@ class GcsServer:
 
     async def rpc_report_actor_failure(self, conn, arg):
         """Called by node managers when an actor's worker process dies."""
-        actor_id, cause = arg
+        actor_id, cause, *rest = arg
+        worker_id = rest[0] if rest else None
         info = self.actors.get(actor_id)
-        # RESTARTING means the previous worker is already accounted dead
-        # (e.g. kill() recorded it) — this report is stale, not a new death.
-        if info is None or info.state in (ActorState.DEAD,
-                                          ActorState.RESTARTING):
+        if info is None or info.state == ActorState.DEAD:
             return False
+        if info.state == ActorState.RESTARTING:
+            # The OLD incarnation's death is already accounted (that's what
+            # put us in RESTARTING). A report for a DIFFERENT worker is the
+            # NEW incarnation dying before its start_actor result landed —
+            # remember it so _schedule_actor treats the creation as failed
+            # instead of marking a dead worker ALIVE.
+            if worker_id is not None and worker_id != info.worker_id:
+                self._dead_actor_workers.add(worker_id)
+            return False
+        if (worker_id is not None and info.worker_id is not None
+                and worker_id != info.worker_id):
+            return False  # stale report for a previous incarnation's worker
         await self._handle_actor_failure(info, cause)
         return True
 
@@ -319,8 +348,17 @@ class GcsServer:
                 pass
         # Record the death now (don't wait for the node's reap loop) so
         # calls submitted after kill() returns fail fast instead of racing
-        # the SIGTERM to the still-live worker.
-        await self._handle_actor_failure(info, "killed via ray_tpu.kill()")
+        # the SIGTERM to the still-live worker. Only an ALIVE actor takes
+        # the failure path — a PENDING/RESTARTING one already has a
+        # _schedule_actor in flight and a second one would double-restart;
+        # those flows notice info.state == DEAD and stand down themselves.
+        if info.state == ActorState.ALIVE:
+            await self._handle_actor_failure(info, "killed via ray_tpu.kill()")
+        elif no_restart and info.state != ActorState.DEAD:
+            info.state = ActorState.DEAD
+            info.death_cause = "killed via ray_tpu.kill()"
+            info.address = None
+            await self.publish(CH_ACTOR, info)
         return True
 
     def rpc_get_actor_info(self, conn, actor_id: ActorID):

@@ -31,8 +31,19 @@ async def run(args):
     addr = await nm.start()
     print(json.dumps({"gcs_port": gcs_port, "nm_port": addr.port,
                       "node_id": nm.node_id.hex()}), flush=True)
+    # SIGTERM must run the shutdown path (terminate pool workers) — the
+    # default handler would kill this process and orphan every worker.
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
     try:
-        await asyncio.Event().wait()
+        await stop.wait()
     finally:
         await nm.stop()
         await gcs.stop()

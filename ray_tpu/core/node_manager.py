@@ -147,7 +147,9 @@ class NodeManager:
             try:
                 await self.gcs_conn.call(
                     "report_actor_failure",
-                    (w.actor_id, f"worker process exited with code {w.proc.returncode}"))
+                    (w.actor_id,
+                     f"worker process exited with code {w.proc.returncode}",
+                     w.info.worker_id if w.info else None))
             except Exception:
                 pass
         logger.warning("worker %s died (code %s)",
@@ -192,6 +194,11 @@ class NodeManager:
         except Exception:
             if w in self._unregistered:
                 self._unregistered.remove(w)
+            try:
+                w.proc.terminate()  # unreachable worker: don't leak it
+            except Exception:
+                pass
+            self._doomed.append(w)
             raise
         if w in self._unregistered:
             self._unregistered.remove(w)

@@ -43,6 +43,11 @@ def main():
         stop.set()
 
     signal.signal(signal.SIGTERM, _term)
+    # Orphan watchdog: if the node manager connection drops (raylet died,
+    # possibly SIGKILLed), exit instead of lingering forever (ref analog:
+    # workers die when their raylet does).
+    if cw.node_conn is not None:
+        cw.node_conn.on_close.append(lambda _c: stop.set())
     try:
         stop.wait()
     except KeyboardInterrupt:

@@ -1,0 +1,12 @@
+"""ray_tpu.train — distributed training on TPU slices (ref analog:
+python/ray/train; architecture per train/v2, SURVEY.md §2.3/§3.4)."""
+
+from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F401
+                                      load_pytree, save_pytree)
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
+                                  Result, RunConfig, ScalingConfig)
+from ray_tpu.train.controller import (FailurePolicy, ScalingPolicy,  # noqa: F401
+                                      TrainController, TrainingFailedError)
+from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
+                                   report)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

@@ -1,0 +1,166 @@
+"""Checkpoint directory abstraction + top-k manager (ref analogs:
+train/_internal/framework_checkpoint.py `Checkpoint`,
+train/_internal/checkpoint_manager.py, _internal/storage.py).
+
+JAX-native path: `save_pytree`/`load_pytree` write sharded `jax.Array`
+pytrees via orbax when available (async-capable, fsspec-backed), falling
+back to a pickle of host numpy arrays. Works for both single-chip state
+and GSPMD-sharded state on a mesh (each host writes its shards).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Optional
+
+
+class Checkpoint:
+    """A directory of framework-agnostic checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rayt-ckpt-")
+        with open(os.path.join(d, "dict_checkpoint.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, "dict_checkpoint.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, target: Optional[str] = None) -> str:
+        if target is None:
+            return self.path
+        os.makedirs(target, exist_ok=True)
+        shutil.copytree(self.path, target, dirs_exist_ok=True)
+        return target
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def subdir(self, name: str) -> "Checkpoint":
+        return Checkpoint(os.path.join(self.path, name))
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.path)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+# --------------------------------------------------------- jax pytree io
+def save_pytree(state: Any, path: str) -> None:
+    """Write a pytree of arrays (jax or numpy) to `path`. Uses orbax when
+    importable (handles sharded jax.Arrays, async commit); else pickles
+    fully-addressable host copies."""
+    os.makedirs(path, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        target = os.path.join(path, "pytree")
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ckptr.save(target, state)
+        return
+    except Exception:
+        pass
+    import jax
+
+    host_state = jax.tree.map(
+        lambda x: __import__("numpy").asarray(x), state)
+    with open(os.path.join(path, "pytree.pkl"), "wb") as f:
+        pickle.dump(host_state, f, protocol=5)
+
+
+def load_pytree(path: str, target: Any = None) -> Any:
+    """Load a pytree saved by save_pytree. `target` (a pytree of arrays
+    with the desired shardings/dtypes) restores sharded when given."""
+    orbax_dir = os.path.join(path, "pytree")
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        if target is not None:
+            import jax
+
+            restore_args = jax.tree.map(
+                lambda x: ocp.ArrayRestoreArgs(
+                    sharding=getattr(x, "sharding", None),
+                    dtype=getattr(x, "dtype", None)), target)
+            return ckptr.restore(
+                orbax_dir, args=ocp.args.PyTreeRestore(
+                    restore_args=restore_args))
+        return ckptr.restore(orbax_dir)
+    with open(os.path.join(path, "pytree.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+class _TrackedCheckpoint:
+    __slots__ = ("checkpoint", "metrics", "index")
+
+    def __init__(self, checkpoint: Checkpoint, metrics: dict, index: int):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+
+
+class CheckpointManager:
+    """Keeps the top-k checkpoints by a score attribute (ref:
+    train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        assert score_order in ("max", "min")
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: list[_TrackedCheckpoint] = []
+        self._index = 0
+        self.latest: Optional[Checkpoint] = None
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
+        self.latest = checkpoint
+        self._tracked.append(
+            _TrackedCheckpoint(checkpoint, dict(metrics), self._index))
+        self._index += 1
+        if self.num_to_keep is None or len(self._tracked) <= self.num_to_keep:
+            return
+        evicted = sorted(self._tracked, key=self._rank)[0]
+        self._tracked.remove(evicted)
+        if evicted.checkpoint.path != (self.latest and self.latest.path):
+            shutil.rmtree(evicted.checkpoint.path, ignore_errors=True)
+
+    def _rank(self, t: _TrackedCheckpoint):
+        if self.score_attribute and self.score_attribute in t.metrics:
+            score = float(t.metrics[self.score_attribute])
+            return (score, t.index) if self.score_order == "max" else (
+                -score, t.index)
+        return (float("-inf"), t.index)  # unscored: evict oldest first
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return sorted(self._tracked, key=self._rank)[-1].checkpoint
+
+    @property
+    def best_with_metrics(self) -> list[tuple[Checkpoint, dict]]:
+        return [(t.checkpoint, t.metrics)
+                for t in sorted(self._tracked, key=self._rank, reverse=True)]

@@ -1,0 +1,126 @@
+"""Per-worker training session (ref analog: train/_internal/session.py —
+`ray.train.report`, `get_checkpoint`, `get_context`).
+
+Runs inside each TrainWorker actor. `report()` persists the worker's
+checkpoint shard into run storage and queues the metrics row; the
+controller drains rows via a concurrent actor method (threaded actor).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import shutil
+import threading
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_context: "TrainContext | None" = None
+_context_lock = threading.Lock()
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, experiment_path: str,
+                 experiment_name: str, latest_checkpoint: Optional[str],
+                 mesh_axes: Optional[dict] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.experiment_path = experiment_path
+        self.experiment_name = experiment_name
+        self.mesh_axes = mesh_axes
+        self._latest_checkpoint_dir = latest_checkpoint
+        self._results: collections.deque = collections.deque()
+        self._results_cond = threading.Condition()
+        # resume past existing step dirs so a restarted worker group never
+        # reuses checkpoint_* names the controller has already seen
+        self._report_index = self._next_free_index(experiment_path)
+
+    @staticmethod
+    def _next_free_index(experiment_path: str) -> int:
+        import glob
+
+        top = 0
+        for d in glob.glob(os.path.join(experiment_path, "checkpoint_*")):
+            tail = os.path.basename(d).rsplit("_", 1)[-1]
+            if tail.isdigit():
+                top = max(top, int(tail) + 1)
+        return top
+
+    # -------------------------------------------------------------- API
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.rank  # single-host-per-worker model
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        if self._latest_checkpoint_dir is None:
+            return None
+        return Checkpoint(self._latest_checkpoint_dir)
+
+    def get_mesh(self, devices=None):
+        """Build the mesh described by ScalingConfig.mesh over the local
+        (per-host) device set; pure-DP mesh when no axes were given."""
+        from ray_tpu.parallel.mesh import build_mesh
+
+        axes = self.mesh_axes or {"data": -1}
+        return build_mesh(dict(axes), devices)
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        entry = {"metrics": dict(metrics), "rank": self.rank,
+                 "index": self._report_index, "checkpoint_dir": None}
+        if checkpoint is not None:
+            step_dir = os.path.join(
+                self.experiment_path,
+                f"checkpoint_{self._report_index:06d}")
+            rank_dir = os.path.join(step_dir, f"rank_{self.rank}")
+            if os.path.abspath(checkpoint.path) != os.path.abspath(rank_dir):
+                os.makedirs(step_dir, exist_ok=True)
+                shutil.copytree(checkpoint.path, rank_dir,
+                                dirs_exist_ok=True)
+            # durable completion marker: lets the controller recover this
+            # checkpoint even if the worker dies before results are drained
+            with open(os.path.join(step_dir, f".complete-rank_{self.rank}"),
+                      "w"):
+                pass
+            entry["checkpoint_dir"] = step_dir
+            self._latest_checkpoint_dir = step_dir
+        self._report_index += 1
+        with self._results_cond:
+            self._results.append(entry)
+            self._results_cond.notify_all()
+
+    # ------------------------------------------------------ controller side
+    def drain_results(self) -> list[dict]:
+        with self._results_cond:
+            out = list(self._results)
+            self._results.clear()
+        return out
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError("ray_tpu.train.get_context() called outside a "
+                           "training worker")
+    return _context
+
+
+def set_context(ctx: Optional[TrainContext]):
+    global _context
+    with _context_lock:
+        _context = ctx
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    get_context().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()

@@ -1,0 +1,40 @@
+"""JaxTrainer — the data-parallel-and-beyond trainer (ref analogs:
+train/base_trainer.py:111/567 `BaseTrainer.fit`,
+train/data_parallel_trainer.py:25; architecture follows train v2: the
+controller runs in the driver, NOT wrapped in a Tune trial).
+
+The torch-backend process-group bootstrap (train/torch/config.py:66) is
+replaced by mesh construction: each worker is one TPU host; the user loop
+asks the session for its mesh (`train.get_context().get_mesh()`) and
+builds a GSPMD train step (ray_tpu.parallel.spmd). Host-plane rendezvous
+(the NCCLUniqueId analog) rides the collective group the WorkerGroup sets
+up over GCS KV.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        controller = TrainController(
+            self.train_loop_per_worker, self.train_loop_config,
+            self.scaling_config, self.run_config)
+        return controller.run()
+
+
+# Alias matching the reference's naming for the DP trainer family.
+DataParallelTrainer = JaxTrainer

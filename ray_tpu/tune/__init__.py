@@ -1,0 +1,13 @@
+"""ray_tpu.tune — hyperparameter search over trial actors (ref analog:
+python/ray/tune; SURVEY.md §2.3 Tune)."""
+
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
+                                   report)
+from ray_tpu.tune.result_grid import ResultGrid  # noqa: F401
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,  # noqa: F401
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import (choice, grid_search, loguniform,  # noqa: F401
+                                 randint, sample_from, uniform)
+from ray_tpu.tune.trial import Trial, TrialStatus  # noqa: F401
+from ray_tpu.tune.tuner import TuneConfig, Tuner  # noqa: F401

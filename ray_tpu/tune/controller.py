@@ -1,0 +1,174 @@
+"""TuneController — event-driven trial lifecycle management (ref analog:
+python/ray/tune/execution/tune_controller.py:68).
+
+Each trial runs in its own threaded actor (the same TrainWorker host used
+by ray_tpu.train, with world_size=1); the controller polls run futures,
+drains reported rows, and applies scheduler decisions (ASHA stops, PBT
+exploit/explore restarts from a donor checkpoint).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Callable, Optional
+
+import cloudpickle
+
+import ray_tpu as rt
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.trial import Trial, TrialStatus
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, trials: list[Trial],
+                 *, metric: Optional[str], mode: str,
+                 scheduler: Optional[FIFOScheduler],
+                 experiment_path: str, experiment_name: str,
+                 max_concurrent: int, max_failures_per_trial: int = 0,
+                 resources_per_trial: Optional[dict] = None):
+        self.trainable = trainable
+        self.trials = trials
+        self.metric = metric
+        self.mode = mode
+        self.scheduler = scheduler or FIFOScheduler()
+        self.experiment_path = experiment_path
+        self.experiment_name = experiment_name
+        self.max_concurrent = max_concurrent
+        self.max_failures = max_failures_per_trial
+        self.resources = resources_per_trial or {"CPU": 1}
+        if hasattr(self.scheduler, "set_population"):
+            self.scheduler.set_population(self.trials)
+        from ray_tpu._internal.serialization import dumps_code
+
+        self._fn_blob = dumps_code(trainable)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> list[Trial]:
+        pending = [t for t in self.trials if t.status == TrialStatus.PENDING]
+        running: list[Trial] = []
+        while pending or running:
+            while pending and len(running) < self.max_concurrent:
+                trial = pending.pop(0)
+                self._launch(trial)
+                running.append(trial)
+            if not running:
+                break
+            done_refs, _ = rt.wait([t.run_ref for t in running],
+                                   num_returns=len(running), timeout=0.2)
+            self._drain(running, pending)
+            for trial in list(running):
+                if trial.run_ref in done_refs and trial.status == \
+                        TrialStatus.RUNNING:
+                    self._finish(trial, pending)
+                if trial.status != TrialStatus.RUNNING:
+                    running.remove(trial)
+            self._save_state()
+        self._save_state()
+        return self.trials
+
+    # ------------------------------------------------------------ internals
+    def _trial_dir(self, trial: Trial) -> str:
+        return os.path.join(self.experiment_path, trial.trial_id)
+
+    def _launch(self, trial: Trial, from_checkpoint: Optional[str] = None):
+        opts = {"max_concurrency": 2,
+                "num_cpus": self.resources.get("CPU", 1)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = self.resources["TPU"]
+        extra = {k: v for k, v in self.resources.items()
+                 if k not in ("CPU", "TPU")}
+        if extra:
+            opts["resources"] = extra
+        actor = rt.remote(TrainWorker).options(**opts).remote()
+        ckpt = from_checkpoint or trial.checkpoint_dir
+        rt.get(actor.setup.remote(
+            0, 1, self._trial_dir(trial), self.experiment_name, ckpt,
+            None, f"tune-{trial.trial_id}"), timeout=120)
+        trial.actor = actor
+        trial.run_ref = actor.run.remote(self._fn_blob, trial.config)
+        trial.status = TrialStatus.RUNNING
+
+    def _stop_trial_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                rt.kill(trial.actor)
+            except Exception:
+                pass
+        trial.actor = None
+        trial.run_ref = None
+
+    def _drain(self, running: list[Trial], pending: list[Trial]):
+        refs = {t.trial_id: t.actor.drain_results.remote()
+                for t in running if t.actor is not None}
+        for trial in running:
+            ref = refs.get(trial.trial_id)
+            if ref is None:
+                continue
+            try:
+                entries = rt.get(ref, timeout=30)
+            except Exception:
+                continue  # dying actor: the run_ref surface handles it
+            for entry in entries:
+                self._on_result(trial, entry, pending)
+                if trial.status != TrialStatus.RUNNING:
+                    break
+
+    def _on_result(self, trial: Trial, entry: dict, pending: list[Trial]):
+        metrics = dict(entry["metrics"])
+        trial.iteration += 1
+        metrics.setdefault("training_iteration", trial.iteration)
+        trial.last_result = metrics
+        trial.results.append(metrics)
+        if entry.get("checkpoint_dir"):
+            trial.checkpoint_dir = entry["checkpoint_dir"]
+        decision = self.scheduler.on_result(trial, metrics)
+        if decision == STOP:
+            self._stop_trial_actor(trial)
+            trial.status = TrialStatus.TERMINATED
+            return
+        instruction = self.scheduler.exploit_instruction(trial)
+        if instruction is not None:
+            donor, new_config = instruction
+            self._stop_trial_actor(trial)
+            trial.config = new_config
+            trial.checkpoint_dir = donor.checkpoint_dir
+            trial.status = TrialStatus.PENDING
+            trial.iteration = donor.iteration
+            pending.append(trial)
+
+    def _finish(self, trial: Trial, pending: list[Trial]):
+        try:
+            rt.get(trial.run_ref)
+            trial.status = TrialStatus.TERMINATED
+        except Exception as e:
+            trial.num_failures += 1
+            if trial.num_failures <= self.max_failures:
+                self._stop_trial_actor(trial)
+                trial.status = TrialStatus.PENDING
+                pending.append(trial)
+                return
+            trial.status = TrialStatus.ERROR
+            trial.error = repr(e)
+        self._stop_trial_actor(trial)
+
+    def _save_state(self):
+        state = {
+            "experiment_name": self.experiment_name,
+            "metric": self.metric, "mode": self.mode,
+            "timestamp": time.time(),
+            "trials": [t.snapshot() for t in self.trials],
+        }
+        os.makedirs(self.experiment_path, exist_ok=True)
+        tmp = os.path.join(self.experiment_path, ".tuner_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self.experiment_path,
+                                     "tuner_state.json"))
+
+
+def new_trial_id() -> str:
+    return uuid.uuid4().hex[:8]

@@ -1,0 +1,106 @@
+"""ActorPool: schedule a stream of work over a fixed set of actors (ref
+analog: python/ray/util/actor_pool.py:13)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict[int, Any] = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list[tuple[Callable, Any]] = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef, e.g.
+        pool.submit(lambda a, v: a.double.remote(v), 1)."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index or bool(
+            self._pending_submits)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in submission order."""
+        import ray_tpu as rt
+
+        if not self.has_next():
+            raise StopIteration("no more results")
+        idx = self._next_return_index
+        while idx not in self._index_to_future:
+            self._drain_one(timeout)
+        future = self._index_to_future.pop(idx)
+        self._next_return_index += 1
+        value = rt.get(future, timeout=timeout)
+        self._return_actor_for(future)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in completion order."""
+        import ray_tpu as rt
+
+        if not self.has_next():
+            raise StopIteration("no more results")
+        while not self._future_to_actor:
+            self._drain_one(timeout)
+        ready, _ = rt.wait(list(self._future_to_actor), num_returns=1,
+                           timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, _ = self._future_to_actor[future]
+        self._index_to_future.pop(idx, None)
+        # keep return index monotone past consumed entries
+        self._next_return_index = max(self._next_return_index, idx + 1)
+        value = rt.get(future)
+        self._return_actor_for(future)
+        return value
+
+    def _drain_one(self, timeout: float | None):
+        if not self._pending_submits:
+            raise RuntimeError("result requested but no work outstanding")
+        raise RuntimeError("internal: pending submits without idle actors "
+                           "should be flushed by _return_actor_for")
+
+    def _return_actor_for(self, future):
+        _, actor = self._future_to_actor.pop(future)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            new_future = fn(actor, value)
+            self._future_to_actor[new_future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = new_future
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._idle.append(actor)

@@ -75,6 +75,11 @@ def _kv_wait(key: str, timeout: float) -> Any:
 
 
 def _host_ip() -> str:
+    # the node manager address is the host's reachable IP on multi-host
+    # clusters (workers export it at spawn; see core/worker_main.py)
+    addr = os.environ.get("RAYT_NODE_ADDR")
+    if addr:
+        return addr.rsplit(":", 1)[0]
     return os.environ.get("RAYT_NODE_IP", "127.0.0.1")
 
 
@@ -157,6 +162,13 @@ class CollectiveGroup:
             self._store.close()
             self._store = None
         _kv_del(f"{self.name}/peer/{self.rank}")
+        cw = _core_worker()
+        actor_id = getattr(cw, "actor_id", None)
+        if actor_id is not None:
+            # drop any declarative rank record so a later collective call
+            # errors ("not initialized") instead of lazily re-joining a
+            # destroyed group
+            _kv_del(f"{self.name}/decl/{actor_id.hex()}")
         self.peer.close()
 
 

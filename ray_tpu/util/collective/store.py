@@ -16,6 +16,7 @@ condition is met (all world_size participants arrived).
 
 from __future__ import annotations
 
+import collections
 import pickle
 import socket
 import struct
@@ -183,10 +184,12 @@ def store_call(addr: tuple[str, int], kind: str, key: str, rank: int,
 
 
 class PeerServer:
-    """Per-rank inbox for point-to-point send/recv, tagged by (src, tag)."""
+    """Per-rank inbox for point-to-point send/recv, tagged by (src, tag).
+    Messages queue per key: back-to-back sends with the same tag are
+    delivered in order, never overwritten."""
 
     def __init__(self):
-        self._inbox: dict[tuple[int, int], Any] = {}
+        self._inbox: dict[tuple[int, int], collections.deque] = {}
         self._cond = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -210,7 +213,8 @@ class PeerServer:
         try:
             src, tag, payload = recv_msg(conn)
             with self._cond:
-                self._inbox[(src, tag)] = payload
+                self._inbox.setdefault(
+                    (src, tag), collections.deque()).append(payload)
                 self._cond.notify_all()
             send_msg(conn, True)
         except (ConnectionError, OSError, EOFError):
@@ -219,14 +223,19 @@ class PeerServer:
             conn.close()
 
     def recv(self, src: int, tag: int, timeout: float = 120.0) -> Any:
+        key = (src, tag)
         with self._cond:
             ok = self._cond.wait_for(
-                lambda: (src, tag) in self._inbox or self._closed, timeout)
+                lambda: self._inbox.get(key) or self._closed, timeout)
             if not ok:
                 raise TimeoutError(f"recv from rank {src} tag {tag} timed out")
             if self._closed:
                 raise ConnectionError("peer server closed")
-            return self._inbox.pop((src, tag))
+            q = self._inbox[key]
+            payload = q.popleft()
+            if not q:
+                del self._inbox[key]
+            return payload
 
     def close(self):
         self._closed = True

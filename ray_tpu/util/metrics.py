@@ -1,0 +1,138 @@
+"""User-facing metrics API: Counter / Gauge / Histogram (ref analog:
+python/ray/util/metrics.py:137,187,262).
+
+Metrics register in a per-process registry; each record also publishes to
+the GCS metrics channel (best-effort, dropped when no cluster is up) so
+the state API / dashboard can aggregate cluster-wide.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+_registry: dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+
+CH_METRICS = "metrics"
+
+
+def _publish(name: str, kind: str, value: float, tags: dict):
+    try:
+        from ray_tpu.core.object_ref import get_core_worker
+
+        cw = get_core_worker()
+        if cw is None or cw.gcs is None:
+            return
+        cw.io.spawn(cw.gcs.publish(CH_METRICS, {
+            "name": name, "kind": kind, "value": value, "tags": tags,
+            "ts": time.time()}))
+    except Exception:
+        pass
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged_tags(self, tags: Optional[Dict[str, str]]) -> dict:
+        out = dict(self._default_tags)
+        if tags:
+            out.update(tags)
+        unknown = set(out) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown} for metric "
+                             f"{self._name!r} (declared {self._tag_keys})")
+        return out
+
+
+class Counter(Metric):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._counts: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc() requires value > 0")
+        merged = self._merged_tags(tags)
+        key = tuple(sorted(merged.items()))
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0.0) + value
+        _publish(self._name, "counter", value, merged)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = tuple(sorted(self._merged_tags(tags).items()))
+        with self._lock:
+            return self._counts.get(key, 0.0)
+
+
+class Gauge(Metric):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        merged = self._merged_tags(tags)
+        key = tuple(sorted(merged.items()))
+        with self._lock:
+            self._values[key] = float(value)
+        _publish(self._name, "gauge", float(value), merged)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = tuple(sorted(self._merged_tags(tags).items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires bucket boundaries")
+        self._boundaries = sorted(float(b) for b in boundaries)
+        self._buckets: Dict[Tuple, list] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        merged = self._merged_tags(tags)
+        key = tuple(sorted(merged.items()))
+        with self._lock:
+            counts = self._buckets.setdefault(
+                key, [0] * (len(self._boundaries) + 1))
+            counts[bisect.bisect_left(self._boundaries, value)] += 1
+        _publish(self._name, "histogram", float(value), merged)
+
+    def buckets(self, tags: Optional[Dict[str, str]] = None) -> list:
+        key = tuple(sorted(self._merged_tags(tags).items()))
+        with self._lock:
+            return list(self._buckets.get(
+                key, [0] * (len(self._boundaries) + 1)))
+
+
+def registered_metrics() -> dict[str, Metric]:
+    with _registry_lock:
+        return dict(_registry)

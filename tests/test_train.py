@@ -1,0 +1,190 @@
+"""Train library tests — Milestone B (SURVEY.md §7): MLP DDP over a
+virtual 8-device CPU mesh, plus controller failure handling and
+checkpoint management."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,
+                                      load_pytree, save_pytree)
+
+
+# ------------------------------------------------------- pure-unit pieces
+def test_checkpoint_dict_roundtrip(tmp_path):
+    ckpt = Checkpoint.from_dict({"step": 3, "w": [1, 2]})
+    assert ckpt.to_dict() == {"step": 3, "w": [1, 2]}
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    mgr = CheckpointManager(num_to_keep=2, score_attribute="acc",
+                            score_order="max")
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        d = tmp_path / f"ck{i}"
+        d.mkdir()
+        (d / "x").write_text(str(i))
+        paths.append(str(d))
+        mgr.register(Checkpoint(str(d)), {"acc": acc})
+    # worst (acc=0.1) evicted and removed from disk
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    assert mgr.best.path == paths[1]
+    assert mgr.latest.path == paths[2]
+
+
+def test_save_load_pytree(tmp_path):
+    import jax.numpy as jnp
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(7)}
+    save_pytree(state, str(tmp_path / "ck"))
+    loaded = load_pytree(str(tmp_path / "ck"))
+    np.testing.assert_allclose(np.asarray(loaded["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert int(loaded["step"]) == 7
+
+
+# ------------------------------------------------------------ end-to-end
+def _mlp_train_loop(config):
+    """Runs inside a TrainWorker actor process: GSPMD DP over the virtual
+    CPU mesh, reporting loss + a checkpoint every epoch."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_loss
+    from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+    ctx = train.get_context()
+    mesh = ctx.get_mesh()
+    cfg = MLPConfig(in_dim=16, hidden=(32,), n_classes=4)
+    params = mlp_init(cfg, jax.random.PRNGKey(0))
+    axes = [{"w": (None, None), "b": (None,)} for _ in params]
+    step, state = build_train_step(mlp_loss, optax.adam(1e-2), params,
+                                   axes, mesh)
+
+    rng = np.random.RandomState(ctx.get_world_rank())
+    x = rng.randn(64, 16).astype("float32")
+    y = (x.sum(-1) > 0).astype("int32") % 4
+    batch = shard_batch({"x": jnp.asarray(x), "y": jnp.asarray(y)}, mesh)
+
+    start_epoch = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        meta = Checkpoint(ckpt.path).subdir(
+            f"rank_{ctx.get_world_rank()}")
+        restored = load_pytree(meta.path)
+        start_epoch = int(restored["epoch"]) + 1
+
+    import tempfile
+
+    for epoch in range(start_epoch, config["epochs"]):
+        for _ in range(5):
+            state, aux = step(state, batch)
+        loss = float(aux["loss"])
+        with tempfile.TemporaryDirectory() as d:
+            save_pytree({"epoch": epoch}, d)
+            train.report({"loss": loss, "epoch": epoch},
+                         checkpoint=Checkpoint(d))
+
+
+def test_jax_trainer_ddp_mesh(local_cluster, tmp_path):
+    from ray_tpu import train
+
+    trainer = train.JaxTrainer(
+        _mlp_train_loop,
+        train_loop_config={"epochs": 3},
+        scaling_config=train.ScalingConfig(num_workers=1,
+                                           mesh={"data": -1}),
+        run_config=train.RunConfig(name="mlp_ddp",
+                                   storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics is not None and result.metrics["epoch"] == 2
+    assert result.checkpoint is not None and result.checkpoint.exists()
+    assert "checkpoint_" in result.checkpoint.path
+
+
+def _failing_loop(config):
+    import os
+    import tempfile
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import Checkpoint, save_pytree
+
+    ctx = train.get_context()
+    start = 0
+    if train.get_checkpoint() is not None:
+        start = 1
+    for epoch in range(start, 2):
+        with tempfile.TemporaryDirectory() as d:
+            save_pytree({"epoch": epoch}, d)
+            train.report({"epoch": epoch, "rank": ctx.get_world_rank()},
+                         checkpoint=Checkpoint(d))
+        if epoch == 0 and train.get_checkpoint() is not None:
+            pass
+        if epoch == 0 and start == 0:
+            os._exit(1)  # hard crash: worker process dies
+
+
+def test_trainer_restart_from_checkpoint(local_cluster, tmp_path):
+    from ray_tpu import train
+
+    trainer = train.JaxTrainer(
+        _failing_loop,
+        train_loop_config={},
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            name="restarts", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["epoch"] == 1
+
+
+def test_trainer_failure_exhausted(local_cluster, tmp_path):
+    from ray_tpu import train
+
+    def always_crash(config):
+        import os
+
+        os._exit(1)
+
+    trainer = train.JaxTrainer(
+        always_crash,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            name="fatal", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=0)))
+    with pytest.raises(train.TrainingFailedError):
+        trainer.fit()
+
+
+def _dp_allreduce_loop(config):
+    """2-worker host-plane DP: per-worker grads averaged via the
+    collective group (cross-host path; in-slice DP is GSPMD/psum)."""
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.util import collective
+
+    ctx = train.get_context()
+    w = np.ones(4) * (ctx.get_world_rank() + 1)
+    g = collective.allreduce(
+        w, group_name=f"train-{ctx.get_experiment_name()}-0")
+    train.report({"gsum": float(g.sum()), "rank": ctx.get_world_rank()})
+
+
+def test_trainer_two_workers_collective(local_cluster, tmp_path):
+    from ray_tpu import train
+
+    trainer = train.JaxTrainer(
+        _dp_allreduce_loop,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="dp2", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    # sum over ranks of ones*(r+1): (1+2)*4 = 12
+    assert result.metrics["gsum"] == 12.0

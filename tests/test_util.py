@@ -1,0 +1,67 @@
+"""util-layer tests: ActorPool and distributed Queue (ref analogs:
+python/ray/tests/test_actor_pool.py, test_queue.py)."""
+
+import pytest
+
+
+def test_actor_pool_map(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu.util import ActorPool
+
+    @rt.remote
+    class Doubler:
+        def double(self, v):
+            return v * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(6))) == [
+        0, 2, 4, 6, 8, 10]
+    assert sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(4))) == [0, 2, 4, 6]
+
+    pool.submit(lambda a, v: a.double.remote(v), 21)
+    assert pool.get_next() == 42
+    assert not pool.has_next()
+
+
+def test_queue_basics(local_cluster):
+    from ray_tpu.util import Queue
+    from ray_tpu.util.queue import Empty
+
+    q = Queue(maxsize=4)
+    assert q.empty()
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.1)
+    q.put("x")
+    assert q.get_nowait_batch(5) == ["x"]
+    q.shutdown()
+
+
+def test_queue_producers_consumers(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu.util import Queue
+
+    q = Queue()
+
+    @rt.remote
+    def producer(q, lo, hi):
+        for i in range(lo, hi):
+            q.put(i)
+        return hi - lo
+
+    @rt.remote
+    def consumer(q, n):
+        return sorted(q.get() for _ in range(n))
+
+    p1 = producer.remote(q, 0, 5)
+    p2 = producer.remote(q, 5, 10)
+    c = consumer.remote(q, 10)
+    assert rt.get(p1) + rt.get(p2) == 10
+    assert rt.get(c) == list(range(10))
+    q.shutdown()
