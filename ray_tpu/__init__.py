@@ -30,7 +30,8 @@ from ray_tpu.core.runtime import init, is_initialized, shutdown  # noqa: F401
 
 def __getattr__(name):
     # Lazy heavyweight submodules (keep `import ray_tpu` jax-free).
-    if name in ("train", "tune", "serve", "data", "rl", "collective", "util"):
+    if name in ("train", "tune", "serve", "data", "rl", "collective", "util",
+                "state_api", "dag"):
         import importlib
 
         return importlib.import_module(f"ray_tpu.{name}")

@@ -1,0 +1,90 @@
+"""Core microbenchmarks (ref analog: python/ray/_private/ray_perf.py:93,
+run by `ray microbenchmark`). Measures the task/actor/object substrate —
+the scalability-envelope numbers SURVEY.md §6 tracks."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+
+def _timeit(name: str, fn: Callable, multiplier: int = 1,
+            duration: float = 2.0) -> dict:
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    return {"benchmark": name, "rate_per_s": round(rate, 1)}
+
+
+def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
+    import ray_tpu as rt
+
+    results = []
+
+    @rt.remote
+    def tiny(x):
+        return x
+
+    # batch submission throughput (tasks/s)
+    def submit_batch():
+        rt.get([tiny.remote(i) for i in range(100)])
+
+    results.append(_timeit("tasks_per_second", submit_batch, 100, duration))
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        async def aincr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    results.append(_timeit(
+        "actor_calls_sync_per_second", lambda: rt.get(c.incr.remote()),
+        1, duration))
+
+    def actor_batch():
+        rt.get([c.incr.remote() for _ in range(100)])
+
+    results.append(_timeit("actor_calls_async_per_second", actor_batch,
+                           100, duration))
+
+    ac = Counter.remote()
+
+    def async_actor_batch():
+        rt.get([ac.aincr.remote() for _ in range(100)])
+
+    results.append(_timeit("async_actor_calls_per_second",
+                           async_actor_batch, 100, duration))
+
+    small = np.zeros(16, np.float64)
+    results.append(_timeit(
+        "put_small_per_second", lambda: rt.put(small), 1, duration))
+
+    big = np.zeros(1 << 27, np.uint8)  # 128 MiB
+
+    def put_get_big():
+        rt.get(rt.put(big))
+
+    r = _timeit("put_get_gigabytes_per_second", put_get_big, 1,
+                max(duration, 1.0))
+    r["rate_per_s"] = round(r["rate_per_s"] * big.nbytes / (1 << 30), 3)
+    results.append(r)
+
+    for a in (c, ac):
+        rt.kill(a)
+    return results

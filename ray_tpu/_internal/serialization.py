@@ -176,6 +176,30 @@ def _register_module_tree(mod_name: str | None) -> None:
 
 def dumps_code(fn: Any) -> bytes:
     """Pickle a function/class for remote execution, shipping driver-local
-    module trees by value first."""
+    module trees by value first. If by-value capture hits an unpicklable
+    module-level global (open connections, locks), fall back to
+    by-reference for that tree — same-host workers can import it via
+    PYTHONPATH."""
     ship_code_by_value(fn)
-    return cloudpickle.dumps(fn)
+    try:
+        return cloudpickle.dumps(fn)
+    except Exception:
+        _unregister_module_tree(getattr(fn, "__module__", None))
+        return cloudpickle.dumps(fn)
+
+
+def _unregister_module_tree(mod_name: str | None) -> None:
+    import sys
+
+    if not mod_name:
+        return
+    for name in list(_by_value_registered):
+        mod = sys.modules.get(name)
+        if mod is None:
+            continue
+        try:
+            cloudpickle.unregister_pickle_by_value(mod)
+            _by_value_registered.discard(name)
+            _scanned_modules.discard(name)
+        except Exception:
+            pass

@@ -43,8 +43,12 @@ def child_env(pkg_root: str, base: dict | None = None) -> dict:
     return env
 
 
+_site_thread: threading.Thread | None = None
+
+
 def import_site_background():
     """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path."""
+    global _site_thread
 
     def _go():
         try:
@@ -52,4 +56,15 @@ def import_site_background():
         except Exception:
             pass
 
-    threading.Thread(target=_go, name="rayt-site-import", daemon=True).start()
+    _site_thread = threading.Thread(target=_go, name="rayt-site-import",
+                                    daemon=True)
+    _site_thread.start()
+
+
+def wait_site_ready(timeout: float = 15.0) -> None:
+    """Block until the background sitecustomize import finished. Call
+    before initializing a jax backend in a worker — the PJRT plugin the
+    env points at (JAX_PLATFORMS) may still be registering."""
+    t = _site_thread
+    if t is not None:
+        t.join(timeout)

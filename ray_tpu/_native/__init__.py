@@ -1,0 +1,241 @@
+"""Native components, built on demand with the system toolchain.
+
+The shm arena store (shm_store.cpp) is the plasma-equivalent C++ data
+plane: one mmap'd segment per node, boundary-tag allocator, LRU eviction,
+process-shared robust mutex. Python binds via ctypes (no pybind11 in the
+image) and maps the same segment for zero-copy reads.
+
+Build artifacts cache under ~/.cache/ray_tpu keyed by source hash, so the
+first import on a machine pays one g++ invocation (~1s) and every later
+process just dlopens.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+import subprocess
+import threading
+from typing import Any, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "shm_store.cpp")
+
+_lib = None
+_lib_err: Optional[str] = None
+_lib_lock = threading.Lock()
+
+
+def _build_lib() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        os.path.expanduser(os.environ.get("RAYT_CACHE_DIR",
+                                          "~/.cache/ray_tpu")))
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"libraytshm-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-o", tmp, _SRC,
+         "-lrt"],
+        check=True, capture_output=True, text=True)
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def load_shm_lib():
+    """Load (building if needed) the native store; None when unavailable."""
+    global _lib, _lib_err
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("RAYT_DISABLE_NATIVE_SHM"):
+            _lib_err = "disabled via RAYT_DISABLE_NATIVE_SHM"
+            return None
+        try:
+            lib = ctypes.CDLL(_build_lib())
+        except Exception as e:
+            _lib_err = repr(e)
+            return None
+        lib.rayt_shm_open.restype = ctypes.c_void_p
+        lib.rayt_shm_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_uint64]
+        lib.rayt_shm_arena_offset.restype = ctypes.c_uint64
+        lib.rayt_shm_arena_offset.argtypes = [ctypes.c_void_p]
+        for name in ("rayt_shm_create",):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_uint64,
+                           ctypes.POINTER(ctypes.c_uint64)]
+        lib.rayt_shm_get.restype = ctypes.c_int
+        lib.rayt_shm_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_uint64),
+                                     ctypes.POINTER(ctypes.c_uint64)]
+        for name in ("rayt_shm_seal", "rayt_shm_release",
+                     "rayt_shm_contains", "rayt_shm_delete"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for name in ("rayt_shm_used", "rayt_shm_capacity",
+                     "rayt_shm_num_objects", "rayt_shm_evictions"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.rayt_shm_close.restype = None
+        lib.rayt_shm_close.argtypes = [ctypes.c_void_p]
+        lib.rayt_shm_unlink.restype = ctypes.c_int
+        lib.rayt_shm_unlink.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def native_unavailable_reason() -> Optional[str]:
+    return _lib_err
+
+
+class NativeArenaStore:
+    """ctypes wrapper over one node-scoped arena (plasma-client analog).
+
+    Interface mirrors object_store.ShmObjectStore so the core worker and
+    node manager can use either transparently.
+    """
+
+    DEFAULT_SLOTS = 1 << 16
+
+    def __init__(self, name: str, capacity: int):
+        lib = load_shm_lib()
+        if lib is None:
+            raise RuntimeError(
+                f"native shm store unavailable: {native_unavailable_reason()}")
+        self._lib = lib
+        self._name = name.encode()
+        self._handle = lib.rayt_shm_open(self._name, capacity,
+                                         self.DEFAULT_SLOTS)
+        if not self._handle:
+            raise RuntimeError(f"rayt_shm_open({name!r}) failed")
+        # map the same segment for zero-copy python-side reads/writes
+        fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
+        try:
+            total = os.fstat(fd).st_size
+            self._map = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._map)
+        self._arena_off = lib.rayt_shm_arena_offset(self._handle)
+        self._held: dict[Any, int] = {}   # oid -> get-refcount
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- helpers
+    def _payload(self, offset: int, size: int) -> memoryview:
+        start = self._arena_off + offset
+        return self._mv[start:start + size]
+
+    # ----------------------------------------------------- store interface
+    def create_and_seal(self, object_id, value) -> int:
+        from ray_tpu._internal.serialization import serialize, serialized_size
+
+        chunks = serialize(value)
+        size = serialized_size(chunks)
+        self._write_sealed(object_id, chunks, size)
+        return size
+
+    def create_from_bytes(self, object_id, data: bytes) -> int:
+        self._write_sealed(object_id, [data], len(data))
+        return len(data)
+
+    def _write_sealed(self, object_id, chunks, size: int):
+        off = ctypes.c_uint64()
+        rc = self._lib.rayt_shm_create(self._handle, object_id.binary(),
+                                       size, ctypes.byref(off))
+        if rc == -1:
+            return  # already present (duplicate transfer): keep existing
+        if rc != 0:
+            raise MemoryError(
+                f"shm store out of memory for {size} bytes "
+                f"(used {self.used()}/{self.capacity()})")
+        pos = self._arena_off + off.value
+        for c in chunks:
+            n = len(c) if isinstance(c, bytes) else c.nbytes
+            self._mv[pos:pos + n] = bytes(c) if isinstance(c, bytes) else c
+            pos += n
+        self._lib.rayt_shm_seal(self._handle, object_id.binary())
+        self._lib.rayt_shm_release(self._handle, object_id.binary())
+
+    def contains_locally(self, object_id) -> bool:
+        return bool(self._lib.rayt_shm_contains(self._handle,
+                                                object_id.binary()))
+
+    def _get_view(self, object_id, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        sz = ctypes.c_uint64()
+        rc = self._lib.rayt_shm_get(self._handle, object_id.binary(),
+                                    ctypes.byref(off), ctypes.byref(sz))
+        if rc != 0:
+            raise KeyError(f"object {object_id} not in shm store (rc={rc})")
+        with self._lock:
+            self._held[object_id] = self._held.get(object_id, 0) + 1
+        return self._payload(off.value, sz.value)
+
+    def get(self, object_id, size: int):
+        from ray_tpu._internal.serialization import deserialize
+
+        return deserialize(self._get_view(object_id, size))
+
+    def read_bytes(self, object_id, size: int) -> bytes:
+        view = self._get_view(object_id, size)
+        try:
+            return bytes(view)
+        finally:
+            self.release(object_id)
+
+    def release(self, object_id):
+        with self._lock:
+            n = self._held.get(object_id, 0)
+            if n <= 0:
+                return
+            self._held[object_id] = n - 1
+            if self._held[object_id] == 0:
+                del self._held[object_id]
+        self._lib.rayt_shm_release(self._handle, object_id.binary())
+
+    def unlink(self, object_id):
+        self._lib.rayt_shm_delete(self._handle, object_id.binary())
+
+    def used(self) -> int:
+        return self._lib.rayt_shm_used(self._handle)
+
+    def capacity(self) -> int:
+        return self._lib.rayt_shm_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.rayt_shm_num_objects(self._handle)
+
+    def evictions(self) -> int:
+        return self._lib.rayt_shm_evictions(self._handle)
+
+    def close(self):
+        if self._handle:
+            try:
+                self._mv.release()
+                self._map.close()
+            except (BufferError, ValueError):
+                pass  # zero-copy views alive; mapping stays until exit
+            else:
+                self._lib.rayt_shm_close(self._handle)
+                self._handle = None
+
+    def destroy_self(self):
+        """Unlink the arena segment (node-manager only, at shutdown)."""
+        self.close()
+        NativeArenaStore.destroy(self._name.decode())
+
+    @staticmethod
+    def destroy(name: str):
+        lib = load_shm_lib()
+        if lib is not None:
+            lib.rayt_shm_unlink(name.encode())

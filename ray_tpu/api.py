@@ -68,6 +68,12 @@ class RemoteFunction:
             f"Remote function {self._fn.__name__!r} cannot be called "
             "directly; use .remote()")
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG task node instead of submitting."""
+        from ray_tpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
@@ -94,6 +100,12 @@ class ActorMethod:
         if self._num_returns == 1:
             return refs[0]
         return refs
+
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (ref: dag/dag_node.py)."""
+        from ray_tpu.dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
 
 class ActorHandle:

@@ -38,7 +38,7 @@ from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
                                  WorkerInfo)
 from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
 from ray_tpu.core.object_ref import ObjectRef, set_core_worker
-from ray_tpu.core.object_store import MemoryStore, ShmObjectStore
+from ray_tpu.core.object_store import MemoryStore, make_shm_store
 from ray_tpu.core.reference_counter import ReferenceCounter
 
 logger = setup_logger("core_worker")
@@ -85,7 +85,7 @@ class CoreWorker:
         self.server = RpcServer()
         self.server.add_service(self)
         self.memory_store = MemoryStore(self.io.loop)
-        self.shm = ShmObjectStore()
+        self.shm = make_shm_store(node_id)
         self.object_meta: dict[ObjectID, ObjectMeta] = {}
         self._object_events: dict[ObjectID, asyncio.Event] = {}
         self.pending_tasks: dict[TaskID, _PendingTask] = {}

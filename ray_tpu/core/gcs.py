@@ -33,6 +33,10 @@ CH_ACTOR = "actor_events"        # ActorInfo
 CH_ERROR = "error_events"
 CH_LOG = "log_events"
 
+# crash-race dead-worker records older than this can't match any in-flight
+# start_actor reply (scheduling deadline is 300s) — prune them
+_DEAD_WORKER_TTL_S = 600.0
+
 
 class GcsServer:
     def __init__(self):
@@ -45,8 +49,10 @@ class GcsServer:
         self.actors: dict[ActorID, ActorInfo] = {}
         self.actor_specs: dict[ActorID, TaskSpec] = {}
         # worker ids whose death was reported before their start_actor
-        # reply landed (new-incarnation crash race)
-        self._dead_actor_workers: set[WorkerID] = set()
+        # reply landed (new-incarnation crash race); value = report time,
+        # pruned after _DEAD_WORKER_TTL_S so unmatched entries can't
+        # accumulate forever
+        self._dead_actor_workers: dict[WorkerID, float] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
@@ -275,7 +281,7 @@ class GcsServer:
                 return
             if worker_info.worker_id in self._dead_actor_workers:
                 # the fresh worker died before this reply arrived
-                self._dead_actor_workers.discard(worker_info.worker_id)
+                self._dead_actor_workers.pop(worker_info.worker_id, None)
                 await asyncio.sleep(0.1)
                 continue
             if info.state == ActorState.DEAD:
@@ -318,14 +324,18 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info.state == ActorState.DEAD:
             return False
-        if info.state == ActorState.RESTARTING:
-            # The OLD incarnation's death is already accounted (that's what
-            # put us in RESTARTING). A report for a DIFFERENT worker is the
-            # NEW incarnation dying before its start_actor result landed —
+        if info.state != ActorState.ALIVE:
+            # PENDING/RESTARTING: a _schedule_actor is in flight and owns
+            # recovery. A report for an unknown worker is the in-flight
+            # incarnation dying before its start_actor result landed —
             # remember it so _schedule_actor treats the creation as failed
             # instead of marking a dead worker ALIVE.
             if worker_id is not None and worker_id != info.worker_id:
-                self._dead_actor_workers.add(worker_id)
+                ts = now()
+                self._dead_actor_workers[worker_id] = ts
+                for wid, t in list(self._dead_actor_workers.items()):
+                    if ts - t > _DEAD_WORKER_TTL_S:
+                        del self._dead_actor_workers[wid]
             return False
         if (worker_id is not None and info.worker_id is not None
                 and worker_id != info.worker_id):
@@ -338,6 +348,10 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None:
             return False
+        # kill(no_restart=False) on a PENDING/RESTARTING actor is a no-op
+        # by design: there is no live incarnation to kill, and the
+        # in-flight _schedule_actor already delivers the same outcome a
+        # kill+restart would (a fresh instance).
         if no_restart:
             info.max_restarts = 0
         if info.node_id in self.node_conns:
@@ -499,6 +513,12 @@ class GcsServer:
             "num_actors": len(self.actors),
             "num_jobs": len(self.jobs),
             "num_placement_groups": len(self.placement_groups),
+            "placement_groups": [
+                {"placement_group_id": pg_id.hex(),
+                 "bundles": pg.get("bundles"),
+                 "strategy": pg.get("strategy"),
+                 "nodes": [n.hex() for n in pg.get("placement", [])]}
+                for pg_id, pg in self.placement_groups.items()],
         }
 
 

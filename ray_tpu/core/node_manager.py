@@ -28,7 +28,7 @@ from ray_tpu._internal.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import Connection, RpcServer, connect
 from ray_tpu.core.common import Address, NodeInfo, TaskSpec, WorkerInfo
-from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.object_store import make_shm_store
 
 logger = setup_logger("node_manager")
 
@@ -60,7 +60,7 @@ class NodeManager:
         self.workers: dict[WorkerID, _Worker] = {}
         self._unregistered: list[_Worker] = []
         self._doomed: list[_Worker] = []  # terminated, awaiting reap
-        self.shm = ShmObjectStore()
+        self.shm = make_shm_store(node_id)
         # object directory: id -> {"size": int, "owner": WorkerInfo}
         self.object_dir: dict[ObjectID, dict] = {}
         self._pending_leases: list[tuple[dict, asyncio.Future]] = []
@@ -110,6 +110,8 @@ class NodeManager:
                     pass
         for oid in list(self.object_dir):
             self.shm.unlink(oid)
+        if hasattr(self.shm, "destroy_self"):
+            self.shm.destroy_self()  # drop the node's arena segment
         if self.gcs_conn is not None:
             await self.gcs_conn.close()
         await self.server.stop()
@@ -164,6 +166,10 @@ class NodeManager:
         env = child_env(pkg_root)
         env["RAYT_CONFIG_JSON"] = get_config().to_json()
         env["RAYT_NODE_ID"] = self.node_id.hex()
+        # workers must use the same store flavor as this node manager
+        env["RAYT_SHM_MODE"] = (
+            "native" if type(self.shm).__name__ == "NativeArenaStore"
+            else "segments")
         env["RAYT_NODE_ADDR"] = f"{self.address.host}:{self.address.port}"
         env["RAYT_GCS_ADDR"] = f"{self.gcs_address.host}:{self.gcs_address.port}"
         # Workers must not grab the TPU chips unless a task asks for them;
@@ -407,6 +413,21 @@ class NodeManager:
         return False
 
     # ----------------------------------------------------- placement groups
+    def rpc_list_workers(self, conn, arg=None):
+        """State-API surface: worker processes on this node."""
+        out = []
+        for w in self.workers.values():
+            out.append({
+                "worker_id": w.info.worker_id.hex() if w.info else None,
+                "pid": w.proc.pid,
+                "busy": w.busy,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+            })
+        out.extend({"worker_id": None, "pid": w.proc.pid,
+                    "busy": False, "actor_id": None, "starting": True}
+                   for w in self._unregistered)
+        return out
+
     def rpc_pg_prepare(self, conn, arg):
         pg_id, bundle_index, demand = arg
         if not self._try_acquire(demand):

@@ -177,3 +177,44 @@ class ShmObjectStore:
     def close(self):
         for oid in list(self._open):
             self.release(oid)
+
+
+def make_shm_store(node_id):
+    """Node-scoped store factory: the C++ arena store (plasma-equivalent,
+    ray_tpu/_native/shm_store.cpp) when the toolchain can build it, else
+    the per-object-segment fallback. All processes on a node derive the
+    same arena name from the node id."""
+    import os
+
+    from ray_tpu._internal.config import get_config
+    from ray_tpu._internal.logging_utils import setup_logger
+
+    logger = setup_logger("object_store")
+    mode = os.environ.get("RAYT_SHM_MODE", "")
+    if mode != "segments" and not os.environ.get("RAYT_DISABLE_NATIVE_SHM"):
+        try:
+            from ray_tpu._native import NativeArenaStore
+
+            capacity = get_config().object_store_memory
+            if not capacity:
+                try:
+                    import psutil
+
+                    capacity = int(psutil.virtual_memory().total * 0.2)
+                except Exception:
+                    capacity = 2 << 30
+                capacity = min(capacity, 8 << 30)
+            return NativeArenaStore("raytshm_" + node_id.hex()[:16],
+                                    capacity)
+        except Exception as e:
+            if mode == "native":
+                # the node manager opened the arena: a per-segment fallback
+                # here would silently diverge from every other process on
+                # the node — fail loudly instead
+                raise RuntimeError(
+                    f"node uses the native arena store but this process "
+                    f"could not open it: {e!r}") from e
+            logger.warning(
+                "native shm arena unavailable (%r); falling back to "
+                "per-object segments", e)
+    return ShmObjectStore()

@@ -1,0 +1,7 @@
+"""ray_tpu.dag — static dataflow graphs over actors (ref analog:
+python/ray/dag compiled graphs; SURVEY.md §2.2 — the reference's
+accelerator-native fast path)."""
+
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401
+from ray_tpu.dag.node import (ClassMethodNode, DAGNode,  # noqa: F401
+                              FunctionNode, InputNode, MultiOutputNode)

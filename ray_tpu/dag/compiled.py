@@ -1,0 +1,133 @@
+"""Compiled DAG execution (ref analog: python/ray/dag/compiled_dag_node.py:757
+`CompiledDAG`, dag_node_operation.py per-actor schedules).
+
+compile() topologically sorts the graph once and freezes the submission
+plan; execute() replays it with object refs wired producer→consumer, so
+intermediate values move directly worker-to-worker through the object
+store (the driver only submits). The reference's further step —
+pre-negotiated mutable channels bypassing per-call RPC, NCCL/ICI device
+channels (torch_tensor_nccl_channel.py) — is the round-2+ fast path; for
+TPU the device data plane is the mesh (jax collectives inside one jit),
+so DAG edges here carry host values/metadata between SPMD programs.
+
+Pipeline parallelism: execute_async() overlaps successive executions —
+each call submits immediately without waiting for prior results, so
+microbatch k+1's stage-1 runs while microbatch k is in stage 2 (the
+actors' ordered queues form the pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.dag.node import (ClassMethodNode, DAGNode, FunctionNode,
+                              InputAttributeNode, InputNode, MultiOutputNode)
+
+
+class CompiledDAGRef:
+    """Future for one execute(); resolves to the output node's value(s)."""
+
+    def __init__(self, refs, multi: bool):
+        self._refs = refs
+        self._multi = multi
+
+    def get(self, timeout: float | None = None):
+        import ray_tpu as rt
+
+        values = rt.get(self._refs, timeout=timeout)
+        return values if self._multi else values[0]
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode):
+        self.output_node = output_node
+        self.topo = self._topo_sort(output_node)
+        self.input_node = None
+        for node in self.topo:
+            if isinstance(node, InputNode):
+                if self.input_node is not None and \
+                        self.input_node is not node:
+                    raise ValueError("a DAG may have only one InputNode")
+                self.input_node = node
+
+    @staticmethod
+    def _topo_sort(root: DAGNode) -> list[DAGNode]:
+        order: list[DAGNode] = []
+        seen: set[int] = set()
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for up in node._upstream():
+                visit(up)
+            order.append(node)
+
+        visit(root)
+        return order
+
+    # ------------------------------------------------------------- execution
+    def execute_async(self, *args, **kwargs) -> CompiledDAGRef:
+        """Submit one pass through the DAG; returns immediately (pipeline
+        microbatches by calling repeatedly)."""
+        values: dict[int, Any] = {}
+        for node in self.topo:
+            if isinstance(node, InputNode):
+                if len(args) == 1 and not kwargs:
+                    values[id(node)] = args[0]
+                else:
+                    values[id(node)] = (args, kwargs)
+            elif isinstance(node, InputAttributeNode):
+                parent_val = values[id(node.parent)]
+                if isinstance(parent_val, tuple) and len(parent_val) == 2 \
+                        and isinstance(parent_val[1], dict):
+                    a, kw = parent_val
+                    values[id(node)] = (kw[node.key] if node.by_attr
+                                        else a[node.key])
+                elif node.by_attr:
+                    values[id(node)] = getattr(parent_val, node.key)
+                else:
+                    values[id(node)] = parent_val[node.key]
+            elif isinstance(node, ClassMethodNode):
+                call_args = tuple(self._resolve(a, values)
+                                  for a in node.args)
+                call_kwargs = {k: self._resolve(v, values)
+                               for k, v in node.kwargs.items()}
+                method = getattr(node.actor, node.method_name)
+                values[id(node)] = method.remote(*call_args, **call_kwargs)
+            elif isinstance(node, FunctionNode):
+                call_args = tuple(self._resolve(a, values)
+                                  for a in node.args)
+                call_kwargs = {k: self._resolve(v, values)
+                               for k, v in node.kwargs.items()}
+                values[id(node)] = node.remote_fn.remote(*call_args,
+                                                         **call_kwargs)
+            elif isinstance(node, MultiOutputNode):
+                values[id(node)] = [self._to_ref(values[id(o)])
+                                    for o in node.outputs]
+        out = values[id(self.output_node)]
+        if isinstance(self.output_node, MultiOutputNode):
+            return CompiledDAGRef(out, multi=True)
+        return CompiledDAGRef([self._to_ref(out)], multi=False)
+
+    def execute(self, *args, **kwargs):
+        """Submit and return a CompiledDAGRef (call .get() for values)."""
+        return self.execute_async(*args, **kwargs)
+
+    @staticmethod
+    def _resolve(arg: Any, values: dict):
+        if isinstance(arg, DAGNode):
+            return values[id(arg)]
+        return arg
+
+    @staticmethod
+    def _to_ref(value: Any):
+        import ray_tpu as rt
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(value, ObjectRef):
+            return value
+        return rt.put(value)
+
+    def teardown(self):
+        pass  # no persistent channels yet (see module docstring)

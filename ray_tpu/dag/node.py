@@ -1,0 +1,98 @@
+"""DAG node types (ref analogs: python/ray/dag/dag_node.py,
+input_node.py, output_node.py; built by `actor.method.bind(...)`)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    def execute(self, *args, **kwargs):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self).execute(*args, **kwargs)
+
+    def experimental_compile(self) -> "object":
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self)
+
+    def _upstream(self) -> list["DAGNode"]:
+        return []
+
+
+class InputNode(DAGNode):
+    """The DAG's runtime argument (context-manager form mirrors the
+    reference: `with InputNode() as inp: ...`)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class InputAttributeNode(DAGNode):
+    """inp[0] / inp.key — selects part of a (args, kwargs) input."""
+
+    def __init__(self, parent: InputNode, key: Any, by_attr: bool):
+        self.parent = parent
+        self.key = key
+        self.by_attr = by_attr
+
+    def _upstream(self):
+        return [self.parent]
+
+
+def _input_getitem(self: InputNode, key):
+    return InputAttributeNode(self, key, by_attr=False)
+
+
+def _input_getattr(self: InputNode, key: str):
+    if key.startswith("_"):
+        raise AttributeError(key)
+    return InputAttributeNode(self, key, by_attr=True)
+
+
+InputNode.__getitem__ = _input_getitem
+InputNode.__getattr__ = _input_getattr
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call in the graph."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple,
+                 kwargs: dict):
+        self.actor = actor_handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+    def _upstream(self):
+        return [a for a in list(self.args) + list(self.kwargs.values())
+                if isinstance(a, DAGNode)]
+
+    def __repr__(self):
+        return (f"ClassMethodNode({self.actor._class_name}."
+                f"{self.method_name})")
+
+
+class FunctionNode(DAGNode):
+    """A bound remote-function call (task node)."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _upstream(self):
+        return [a for a in list(self.args) + list(self.kwargs.values())
+                if isinstance(a, DAGNode)]
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: list):
+        self.outputs = list(outputs)
+
+    def _upstream(self):
+        return [o for o in self.outputs if isinstance(o, DAGNode)]

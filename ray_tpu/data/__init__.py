@@ -1,0 +1,31 @@
+"""ray_tpu.data — streaming datasets over tasks/actors (ref analog:
+python/ray/data; SURVEY.md §2.3 Data)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.data.dataset import (DataIterator, Dataset,  # noqa: F401
+                                  from_items_rows)
+from ray_tpu.data.datasource import (read_csv, read_json,  # noqa: F401
+                                     read_parquet, read_text, write_parquet)
+from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
+
+
+def from_items(items: list, num_blocks: int = 8) -> Dataset:
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    return from_items_rows(rows, num_blocks)
+
+
+def range(n: int, num_blocks: int = 8) -> Dataset:  # noqa: A001
+    import builtins
+
+    return from_items_rows([{"id": i} for i in builtins.range(n)], num_blocks)
+
+
+def from_numpy(array, num_blocks: int = 8) -> Dataset:
+    return from_items_rows([{"data": row} for row in array], num_blocks)
+
+
+def from_pandas(df, num_blocks: int = 8) -> Dataset:
+    return from_items_rows(df.to_dict("records"), num_blocks)

@@ -1,0 +1,85 @@
+"""Block primitives. A Block is a row-major list of dicts; batch formats
+convert to columnar numpy / pandas on demand (ref analog:
+python/ray/data/_internal/arrow_block.py — the reference is Arrow-first;
+here rows keep the executor simple and numpy is the TPU-adjacent batch
+format fed to jax)."""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+Block = list  # list[dict[str, Any]] | list[Any] for simple datasets
+
+
+def is_record_block(block: Block) -> bool:
+    return bool(block) and isinstance(block[0], dict)
+
+
+def to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format == "rows":
+        return block
+    if not block:
+        return {} if batch_format == "numpy" else None
+    if not is_record_block(block):
+        arr = np.asarray(block)
+        if batch_format == "numpy":
+            return {"item": arr}
+        import pandas as pd
+
+        return pd.DataFrame({"item": arr})
+    keys = block[0].keys()
+    cols = {k: np.asarray([row[k] for row in block]) for k in keys}
+    if batch_format == "numpy":
+        return cols
+    import pandas as pd
+
+    return pd.DataFrame(cols)
+
+
+def from_batch(batch: Any) -> Block:
+    if batch is None:
+        return []
+    if isinstance(batch, list):
+        return batch
+    if isinstance(batch, dict):
+        if not batch:
+            return []
+        keys = list(batch)
+        n = len(batch[keys[0]])
+        return [{k: _item(batch[k][i]) for k in keys} for i in range(n)]
+    # pandas
+    return batch.to_dict("records")
+
+
+def _item(x):
+    if isinstance(x, np.generic):
+        return x.item()
+    return x
+
+
+def batch_iter(block: Block, batch_size: int | None) -> Iterator[Block]:
+    if batch_size is None or batch_size <= 0:
+        yield block
+        return
+    for i in range(0, len(block), batch_size):
+        yield block[i:i + batch_size]
+
+
+def split_block(block: Block, n: int) -> list[Block]:
+    out = []
+    size, rem = divmod(len(block), n)
+    start = 0
+    for i in range(n):
+        end = start + size + (1 if i < rem else 0)
+        out.append(block[start:end])
+        start = end
+    return out
+
+
+def concat_blocks(blocks: Iterable[Block]) -> Block:
+    out: Block = []
+    for b in blocks:
+        out.extend(b)
+    return out

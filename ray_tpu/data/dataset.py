@@ -1,0 +1,265 @@
+"""Dataset — lazy, streaming-executed distributed data (ref analogs:
+python/ray/data/dataset.py API, _internal/plan.py logical plan,
+_internal/iterator/ for iter_batches, output_splitter for
+streaming_split)."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Iterator, Optional
+
+import ray_tpu as rt
+from ray_tpu.data.block import (Block, concat_blocks, split_block, to_batch)
+from ray_tpu.data.executor import (ActorPoolStrategy, MapSpec,
+                                   StreamingExecutor)
+
+
+@dataclasses.dataclass
+class _AllToAll:
+    kind: str      # repartition | shuffle | sort
+    args: dict
+
+
+@dataclasses.dataclass
+class _Limit:
+    n: int
+
+
+class Dataset:
+    """Lazy plan over source block refs. Transforms append stages; the
+    streaming executor runs map stages with bounded in-flight blocks and
+    barriers only at all-to-all stages."""
+
+    def __init__(self, source_refs: list, stages: Optional[list] = None,
+                 executor: Optional[StreamingExecutor] = None):
+        self._source_refs = source_refs
+        self._stages = stages or []
+        self._executor = executor or StreamingExecutor()
+
+    # ----------------------------------------------------------- transforms
+    def _with(self, stage) -> "Dataset":
+        return Dataset(self._source_refs, self._stages + [stage],
+                       self._executor)
+
+    def map(self, fn: Callable, **fn_kwargs) -> "Dataset":
+        return self._with(MapSpec("map", fn, fn_kwargs=fn_kwargs))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with(MapSpec("filter", fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with(MapSpec("flat_map", fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: tuple = (),
+                    **fn_kwargs) -> "Dataset":
+        return self._with(MapSpec(
+            "map_batches", fn, batch_size=batch_size,
+            batch_format=batch_format, compute=compute,
+            fn_constructor_args=tuple(fn_constructor_args),
+            fn_kwargs=fn_kwargs))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(_AllToAll("repartition", {"n": num_blocks}))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(_AllToAll("shuffle", {"seed": seed}))
+
+    def sort(self, key: str | Callable, descending: bool = False) -> "Dataset":
+        key_fn = key if callable(key) else (lambda row, _k=key: row[_k])
+        return self._with(_AllToAll(
+            "sort", {"key": key_fn, "descending": descending}))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(_Limit(n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._iter_block_refs())
+        for o in others:
+            refs.extend(o._iter_block_refs())
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = self.take_all()
+        right = other.take_all()
+        if len(left) != len(right):
+            raise ValueError("zip requires equal row counts "
+                             f"({len(left)} vs {len(right)})")
+        rows = []
+        for a, b in zip(left, right):
+            row = dict(a)
+            for k, v in b.items():
+                row[k if k not in row else f"{k}_1"] = v
+            rows.append(row)
+        return from_items_rows(rows, num_blocks=max(1, len(
+            self._source_refs)))
+
+    def groupby(self, key: str) -> "GroupedData":
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------------ execution
+    def _iter_block_refs(self) -> Iterator:
+        refs: Iterator = iter(self._source_refs)
+        for stage in self._stages:
+            if isinstance(stage, MapSpec):
+                refs = self._executor.stream_map(refs, stage)
+            elif isinstance(stage, _AllToAll):
+                materialized = list(refs)
+                if stage.kind == "repartition":
+                    refs = iter(self._executor.repartition(
+                        materialized, stage.args["n"]))
+                elif stage.kind == "shuffle":
+                    refs = iter(self._executor.random_shuffle(
+                        materialized, stage.args["seed"]))
+                else:
+                    refs = iter(self._executor.sort(
+                        materialized, stage.args["key"],
+                        stage.args["descending"]))
+            elif isinstance(stage, _Limit):
+                refs = self._limit_refs(refs, stage.n)
+        return refs
+
+    def _limit_refs(self, refs: Iterator, n: int) -> Iterator:
+        remaining = n
+        for ref in refs:
+            if remaining <= 0:
+                return
+            block = rt.get(ref)
+            if len(block) > remaining:
+                yield rt.put(block[:remaining])
+                return
+            remaining -= len(block)
+            yield ref
+
+    def materialize(self) -> "Dataset":
+        return Dataset(list(self._iter_block_refs()))
+
+    # ------------------------------------------------------------- consumers
+    def iter_rows(self) -> Iterator[dict]:
+        for ref in self._iter_block_refs():
+            yield from rt.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        buffer: Block = []
+        for ref in self._iter_block_refs():
+            buffer.extend(rt.get(ref))
+            while len(buffer) >= batch_size:
+                yield to_batch(buffer[:batch_size], batch_format)
+                buffer = buffer[batch_size:]
+        if buffer and not drop_last:
+            yield to_batch(buffer, batch_format)
+
+    def take(self, n: int = 20) -> list:
+        out: list = []
+        for ref in self._iter_block_refs():
+            out.extend(rt.get(ref))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(len(rt.get(ref)) for ref in self._iter_block_refs())
+
+    def num_blocks(self) -> int:
+        return len(self._source_refs)
+
+    def schema(self) -> Optional[list[str]]:
+        first = self.take(1)
+        if not first:
+            return None
+        row = first[0]
+        return sorted(row.keys()) if isinstance(row, dict) else ["item"]
+
+    def sum(self, on: str) -> float:
+        return sum(row[on] for row in self.iter_rows())
+
+    def min(self, on: str):
+        return min(row[on] for row in self.iter_rows())
+
+    def max(self, on: str):
+        return max(row[on] for row in self.iter_rows())
+
+    def mean(self, on: str) -> float:
+        total, n = 0.0, 0
+        for row in self.iter_rows():
+            total += row[on]
+            n += 1
+        return total / n if n else float("nan")
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.take_all())
+
+    # ------------------------------------------------- train-ingest surface
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        seed: Optional[int] = None) -> list["DataIterator"]:
+        """Split into n iterators, one per train worker (ref:
+        output_splitter.py streaming_split + train DataConfig)."""
+        refs = list(self._iter_block_refs())
+        shards: list[list] = [[] for _ in range(n)]
+        if equal:
+            rows = concat_blocks([rt.get(r) for r in refs])
+            per = len(rows) // n
+            for i, part in enumerate(split_block(rows[:per * n], n)):
+                shards[i].append(rt.put(part))
+        else:
+            for i, ref in enumerate(refs):
+                shards[i % n].append(ref)
+        return [DataIterator(shard) for shard in shards]
+
+    def split(self, n: int) -> list["Dataset"]:
+        refs = list(self._iter_block_refs())
+        out: list[list] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            out[i % n].append(ref)
+        return [Dataset(refs_i) for refs_i in out]
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._source_refs)}, "
+                f"stages={len(self._stages)})")
+
+
+class DataIterator:
+    """Picklable per-worker shard iterator (resolves block refs lazily in
+    the consuming worker)."""
+
+    def __init__(self, refs: list):
+        self._refs = refs
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref in self._refs:
+            yield from rt.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        buffer: Block = []
+        for ref in self._refs:
+            buffer.extend(rt.get(ref))
+            while len(buffer) >= batch_size:
+                yield to_batch(buffer[:batch_size], batch_format)
+                buffer = buffer[batch_size:]
+        if buffer and not drop_last:
+            yield to_batch(buffer, batch_format)
+
+    def count(self) -> int:
+        return sum(len(rt.get(ref)) for ref in self._refs)
+
+    def __reduce__(self):
+        return (DataIterator, (self._refs,))
+
+
+def from_items_rows(rows: list, num_blocks: int = 8) -> Dataset:
+    num_blocks = max(1, min(num_blocks, max(1, len(rows))))
+    return Dataset([rt.put(b) for b in split_block(rows, num_blocks)])

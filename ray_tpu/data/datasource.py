@@ -1,0 +1,98 @@
+"""Datasources: file reads fan out as tasks, one block per file/shard (ref
+analog: python/ray/data/datasource/ + read_api.py)."""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Optional
+
+import ray_tpu as rt
+
+
+def _expand(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in globlib.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    return out
+
+
+def read_text(paths, *, drop_empty_lines: bool = True):
+    from ray_tpu.data.dataset import Dataset
+
+    def read_file(path: str):
+        with open(path) as f:
+            lines = f.read().splitlines()
+        if drop_empty_lines:
+            lines = [ln for ln in lines if ln]
+        return [{"text": ln} for ln in lines]
+
+    task = rt.remote(num_cpus=1)(read_file)
+    return Dataset([task.remote(p) for p in _expand(paths)])
+
+
+def read_csv(paths):
+    from ray_tpu.data.dataset import Dataset
+
+    def read_file(path: str):
+        import csv
+
+        with open(path, newline="") as f:
+            return [dict(row) for row in csv.DictReader(f)]
+
+    task = rt.remote(num_cpus=1)(read_file)
+    return Dataset([task.remote(p) for p in _expand(paths)])
+
+
+def read_parquet(paths, *, columns: Optional[list[str]] = None):
+    from ray_tpu.data.dataset import Dataset
+
+    def read_file(path: str, columns):
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path, columns=columns)
+        return table.to_pylist()
+
+    task = rt.remote(num_cpus=1)(read_file)
+    return Dataset([task.remote(p, columns) for p in _expand(paths)])
+
+
+def read_json(paths):
+    from ray_tpu.data.dataset import Dataset
+
+    def read_file(path: str):
+        import json
+
+        with open(path) as f:
+            first = f.read(1)
+            f.seek(0)
+            if first == "[":
+                return json.load(f)
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    task = rt.remote(num_cpus=1)(read_file)
+    return Dataset([task.remote(p) for p in _expand(paths)])
+
+
+def write_parquet(dataset, path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    for i, ref in enumerate(dataset._iter_block_refs()):
+        block = rt.get(ref)
+        if not block:
+            continue
+        pq.write_table(pa.Table.from_pylist(block),
+                       os.path.join(path, f"part-{i:05d}.parquet"))

@@ -1,0 +1,170 @@
+"""Streaming executor: pull-based operator pipeline over block refs (ref
+analogs: data/_internal/execution/streaming_executor.py:48,
+streaming_executor_state.py, operators/{map_operator,
+task_pool_map_operator,actor_pool_map_operator}.py).
+
+Map stages stream: at most `max_in_flight` block tasks are outstanding per
+stage, so a long pipeline holds O(window) blocks in memory instead of the
+whole dataset — the reference's backpressure idea without its resource
+budgets. All-to-all stages (shuffle/sort/repartition) are barriers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import random
+from typing import Any, Callable, Iterator, Optional
+
+import ray_tpu as rt
+from ray_tpu.data.block import (Block, concat_blocks, from_batch,
+                                split_block, to_batch)
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    size: int = 2
+
+
+@dataclasses.dataclass
+class MapSpec:
+    kind: str                     # map | map_batches | filter | flat_map
+    fn: Any                       # callable or class (for actor compute)
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    compute: Optional[ActorPoolStrategy] = None
+    fn_constructor_args: tuple = ()
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+def apply_map_spec(spec: MapSpec, fn, block: Block) -> Block:
+    """Run one map stage over one block (inside a task/actor)."""
+    from ray_tpu.data.block import batch_iter
+
+    if spec.kind == "map":
+        return [fn(row, **spec.fn_kwargs) for row in block]
+    if spec.kind == "filter":
+        return [row for row in block if fn(row, **spec.fn_kwargs)]
+    if spec.kind == "flat_map":
+        out: Block = []
+        for row in block:
+            out.extend(fn(row, **spec.fn_kwargs))
+        return out
+    if spec.kind == "map_batches":
+        out = []
+        for sub in batch_iter(block, spec.batch_size):
+            batch = to_batch(sub, spec.batch_format)
+            result = fn(batch, **spec.fn_kwargs)
+            out.extend(from_batch(result))
+        return out
+    raise ValueError(f"unknown map kind {spec.kind!r}")
+
+
+def _map_task(block: Block, spec: MapSpec) -> Block:
+    return apply_map_spec(spec, spec.fn, block)
+
+
+class _MapActor:
+    """Actor-pool compute: constructs the callable once, reuses it per
+    block (ref: actor_pool_map_operator.py)."""
+
+    def __init__(self, spec: MapSpec):
+        self.spec = spec
+        fn = spec.fn
+        if isinstance(fn, type):
+            fn = fn(*spec.fn_constructor_args)
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        return apply_map_spec(self.spec, self.fn, block)
+
+
+class StreamingExecutor:
+    def __init__(self, max_in_flight: int = 8):
+        self.max_in_flight = max_in_flight
+
+    # ------------------------------------------------------------- map stage
+    def stream_map(self, refs: Iterator, spec: MapSpec) -> Iterator:
+        """Yield output block refs as inputs complete; bounded window."""
+        if spec.compute is not None:
+            yield from self._stream_map_actors(refs, spec)
+            return
+        from ray_tpu._internal.serialization import ship_code_by_value
+
+        ship_code_by_value(spec.fn)
+        remote_fn = rt.remote(num_cpus=1)(_map_task)
+        window = collections.deque()
+        for ref in refs:
+            window.append(remote_fn.remote(ref, spec))
+            if len(window) >= self.max_in_flight:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+
+    def _stream_map_actors(self, refs: Iterator, spec: MapSpec) -> Iterator:
+        from ray_tpu._internal.serialization import ship_code_by_value
+
+        ship_code_by_value(spec.fn)
+        n = spec.compute.size
+        actor_cls = rt.remote(num_cpus=1)(_MapActor)
+        actors = [actor_cls.remote(spec) for _ in range(n)]
+        futures: collections.deque = collections.deque()
+        try:
+            # round-robin: per-actor ordered queues serialize execution, the
+            # window bounds blocks in flight
+            for i, ref in enumerate(refs):
+                futures.append(actors[i % n].apply.remote(ref))
+                if len(futures) >= self.max_in_flight:
+                    yield futures.popleft()
+            while futures:
+                yield futures.popleft()
+        finally:
+            for a in actors:
+                try:
+                    rt.kill(a)
+                except Exception:
+                    pass
+
+    # --------------------------------------------------------- all-to-all
+    def repartition(self, refs: list, n: int) -> list:
+        blocks = rt.get(list(refs))
+        all_rows = concat_blocks(blocks)
+        return [rt.put(b) for b in split_block(all_rows, n)]
+
+    def random_shuffle(self, refs: list, seed: Optional[int] = None) -> list:
+        """Distributed shuffle: map each block into N shards, then N
+        reduce tasks concatenate + locally shuffle their shard (ref:
+        data/_internal/planner/exchange/)."""
+        n = max(1, len(refs))
+
+        def shard(block: Block, n: int, seed) -> list[Block]:
+            rng = random.Random(seed)
+            shards: list[Block] = [[] for _ in range(n)]
+            for row in block:
+                shards[rng.randrange(n)].append(row)
+            return shards
+
+        def reduce_shards(seed, *shards: Block) -> Block:
+            out = concat_blocks(shards)
+            random.Random(seed).shuffle(out)
+            return out
+
+        shard_task = rt.remote(num_cpus=1, num_returns=n)(shard)
+        reduce_task = rt.remote(num_cpus=1)(reduce_shards)
+        parts = []
+        for i, ref in enumerate(refs):
+            s = seed + i if seed is not None else None
+            result = shard_task.remote(ref, n, s)
+            parts.append(result if isinstance(result, list) else [result])
+        out = []
+        for j in range(n):
+            s2 = seed + 10_000 + j if seed is not None else None
+            out.append(reduce_task.remote(s2, *[p[j] for p in parts]))
+        return out
+
+    def sort(self, refs: list, key: Callable, descending: bool) -> list:
+        blocks = rt.get(list(refs))
+        rows = concat_blocks(blocks)
+        rows.sort(key=key, reverse=descending)
+        n = max(1, len(refs))
+        return [rt.put(b) for b in split_block(rows, n)]

@@ -1,0 +1,96 @@
+"""GroupedData: hash-shuffle by key then per-partition aggregate (ref
+analog: python/ray/data/grouped_data.py + planner/exchange hash shuffle)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import ray_tpu as rt
+from ray_tpu.data.block import Block, concat_blocks
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._dataset = dataset
+        self._key = key
+
+    def _partitions(self) -> list:
+        """Hash-partition rows by key across tasks, one output per input
+        block count (distributed shuffle, not a driver gather)."""
+        refs = list(self._dataset._iter_block_refs())
+        n = max(1, len(refs))
+        key = self._key
+
+        def shard(block: Block, n: int) -> list[Block]:
+            shards: list[Block] = [[] for _ in range(n)]
+            for row in block:
+                shards[hash(row[key]) % n].append(row)
+            return shards
+
+        def combine(*shards: Block) -> Block:
+            return concat_blocks(shards)
+
+        shard_task = rt.remote(num_cpus=1, num_returns=n)(shard)
+        combine_task = rt.remote(num_cpus=1)(combine)
+        parts = []
+        for ref in refs:
+            result = shard_task.remote(ref, n)
+            parts.append(result if isinstance(result, list) else [result])
+        return [combine_task.remote(*[p[j] for p in parts])
+                for j in range(n)]
+
+    def _grouped_rows(self, ref) -> dict[Any, Block]:
+        groups: dict[Any, Block] = {}
+        for row in rt.get(ref):
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def aggregate(self, **named_aggs: tuple[str, Callable]):
+        """named_aggs: out_col=(in_col, reducer over list of values).
+        Returns a Dataset of one row per group."""
+        from ray_tpu.data.dataset import Dataset
+
+        key = self._key
+
+        def agg_partition(groups: dict[Any, Block]) -> Block:
+            out: Block = []
+            for gkey, rows in groups.items():
+                row = {key: gkey}
+                for out_col, (in_col, reducer) in named_aggs.items():
+                    row[out_col] = reducer([r[in_col] for r in rows])
+                out.append(row)
+            return out
+
+        out_refs = [rt.put(agg_partition(self._grouped_rows(ref)))
+                    for ref in self._partitions()]
+        return Dataset(out_refs)
+
+    def count(self):
+        return self.aggregate(count=(self._key, len))
+
+    def sum(self, on: str):
+        return self.aggregate(**{f"sum({on})": (on, sum)})
+
+    def mean(self, on: str):
+        return self.aggregate(**{
+            f"mean({on})": (on, lambda vs: sum(vs) / len(vs))})
+
+    def min(self, on: str):
+        return self.aggregate(**{f"min({on})": (on, min)})
+
+    def max(self, on: str):
+        return self.aggregate(**{f"max({on})": (on, max)})
+
+    def map_groups(self, fn: Callable):
+        from ray_tpu.data.dataset import Dataset
+
+        def apply(groups: dict[Any, Block]) -> Block:
+            out: Block = []
+            for _, rows in groups.items():
+                result = fn(rows)
+                out.extend(result if isinstance(result, list) else [result])
+            return out
+
+        out_refs = [rt.put(apply(self._grouped_rows(ref)))
+                    for ref in self._partitions()]
+        return Dataset(out_refs)

@@ -1,0 +1,10 @@
+"""ray_tpu.rl — reinforcement learning on the actor substrate (ref
+analog: rllib new API stack; SURVEY.md §2.3/§3.6)."""
+
+from ray_tpu.rl.actor_manager import FaultTolerantActorManager  # noqa: F401
+from ray_tpu.rl.env import (CartPoleVectorEnv, VectorEnv,  # noqa: F401
+                            make_vector_env, register_env)
+from ray_tpu.rl.learner import (JaxLearner, PPOLearnerConfig,  # noqa: F401
+                                compute_gae)
+from ray_tpu.rl.module import MLPModuleConfig  # noqa: F401
+from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401

@@ -1,0 +1,58 @@
+"""FaultTolerantActorManager (ref analog:
+rllib/utils/actor_manager.py:198): async RPC fan-out over a fleet with
+per-actor health tracking — failed calls mark the actor unhealthy and are
+dropped from results; a later successful probe restores it."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional
+
+import ray_tpu as rt
+
+logger = logging.getLogger("ray_tpu.rl")
+
+
+class FaultTolerantActorManager:
+    def __init__(self, actors: list, *, probe_method: str = "ping"):
+        self._actors = list(actors)
+        self._healthy = [True] * len(actors)
+        self._probe_method = probe_method
+
+    @property
+    def num_healthy(self) -> int:
+        return sum(self._healthy)
+
+    def healthy_actors(self) -> list:
+        return [a for a, h in zip(self._actors, self._healthy) if h]
+
+    def foreach(self, fn: Callable, *, timeout: float = 120.0,
+                healthy_only: bool = True) -> list:
+        """fn(actor) -> ObjectRef; returns results from actors that
+        succeeded (failures mark the actor unhealthy)."""
+        targets = [(i, a) for i, (a, h) in enumerate(
+            zip(self._actors, self._healthy)) if h or not healthy_only]
+        refs = [(i, fn(a)) for i, a in targets]
+        out = []
+        for i, ref in refs:
+            try:
+                out.append(rt.get(ref, timeout=timeout))
+                self._healthy[i] = True
+            except Exception as e:
+                logger.warning("actor %d failed: %r", i, e)
+                self._healthy[i] = False
+        return out
+
+    def probe_unhealthy(self, timeout: float = 10.0) -> int:
+        """Try to restore unhealthy actors (restarted actors respond
+        again); returns how many are healthy now."""
+        for i, (a, h) in enumerate(zip(self._actors, self._healthy)):
+            if h:
+                continue
+            try:
+                rt.get(getattr(a, self._probe_method).remote(),
+                       timeout=timeout)
+                self._healthy[i] = True
+            except Exception:
+                pass
+        return self.num_healthy

@@ -1,0 +1,100 @@
+"""Vectorized environments (ref analog: rllib's gymnasium vector envs in
+env/single_agent_env_runner.py:64 — the env API is gymnasium-shaped so
+real gym envs drop in, but CartPole ships built-in so the library has no
+gym dependency)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class VectorEnv:
+    """num_envs independent environments stepped in lockstep with
+    auto-reset (done envs restart immediately, final obs in info)."""
+
+    num_envs: int
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        """-> (obs [n, obs_size], reward [n], terminated [n], truncated [n])"""
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """Classic cart-pole balancing, vectorized in numpy (dynamics match
+    gymnasium's CartPole-v1: max 500 steps, +1 reward per step)."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_size = 4
+        self.num_actions = 2
+        self._rng = np.random.RandomState(seed)
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, (self.num_envs, 4))
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def _reset_envs(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._state[mask] = self._rng.uniform(-0.05, 0.05, (n, 4))
+            self._steps[mask] = 0
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0
+                                  - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        theta = theta + self.DT * theta_dot
+        theta_dot = theta_dot + self.DT * theta_acc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+
+        terminated = ((np.abs(x) > self.X_LIMIT)
+                      | (np.abs(theta) > self.THETA_LIMIT))
+        truncated = self._steps >= self.MAX_STEPS
+        reward = np.ones(self.num_envs, np.float32)
+        self._reset_envs(terminated | truncated)
+        return (self._state.astype(np.float32), reward,
+                terminated, truncated)
+
+
+_ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv}
+
+
+def register_env(name: str, creator):
+    """creator(num_envs, seed) -> VectorEnv (ref analog: tune.register_env)."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_vector_env(name: str, num_envs: int, seed: int = 0) -> VectorEnv:
+    if name not in _ENV_REGISTRY:
+        raise KeyError(f"unknown env {name!r}; register_env() it first")
+    return _ENV_REGISTRY[name](num_envs, seed)

@@ -1,0 +1,82 @@
+"""EnvRunner actor — CPU sampling fleet (ref analog:
+rllib/env/single_agent_env_runner.py:64; episodes stream back as numpy
+trajectory dicts, weights arrive as object-store refs broadcast by the
+algorithm, exactly the reference's weight-sync pattern)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int, seed: int,
+                 module_cfg_blob: bytes):
+        from ray_tpu._internal.spawn import wait_site_ready
+
+        wait_site_ready()
+        import cloudpickle
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # sampling is host-side
+        from ray_tpu.rl.env import make_vector_env
+
+        self.env = make_vector_env(env_name, num_envs, seed)
+        self.module_cfg = cloudpickle.loads(module_cfg_blob)
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = self.env.reset(seed)
+        self._params = None
+        # per-env running episode returns (for metrics)
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._completed: list[float] = []
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def sample(self, num_steps: int) -> dict:
+        """Rollout num_steps per env; returns flat [T, N, ...] arrays plus
+        completed-episode returns for metrics."""
+        import jax
+
+        from ray_tpu.rl import module as rlm
+
+        assert self._params is not None, "set_weights first"
+        T, N = num_steps, self.env.num_envs
+        obs_buf = np.zeros((T, N, self.env.observation_size), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = rlm.sample_actions(
+                self._params, self._obs, sub)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            val_buf[t] = value
+            self._obs, reward, terminated, truncated = self.env.step(action)
+            rew_buf[t] = reward
+            done = terminated | truncated
+            done_buf[t] = done
+            self._ep_return += reward
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        # bootstrap value for the final observation
+        import jax.numpy as jnp
+
+        _, last_value = rlm.forward(self._params, jnp.asarray(self._obs))
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_value": np.asarray(last_value),
+            "episode_returns": completed,
+        }
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,61 @@
+"""RLModule — the jax policy/value network (ref analog:
+rllib/core/rl_module/rl_module.py `RLModule`; torch modules there, pure
+jax pytrees here so the learner jits end-to-end and shards over the
+mesh)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPModuleConfig:
+    observation_size: int
+    num_actions: int
+    hidden: tuple = (64, 64)
+
+
+def init_params(cfg: MLPModuleConfig, key: jax.Array) -> dict:
+    """Shared torso + policy and value heads."""
+    dims = (cfg.observation_size,) + tuple(cfg.hidden)
+    keys = jax.random.split(key, len(dims) + 1)
+    torso = [
+        {"w": (jax.random.normal(k, (a, b))
+               * math.sqrt(2.0 / a)).astype(jnp.float32),
+         "b": jnp.zeros((b,), jnp.float32)}
+        for k, a, b in zip(keys, dims[:-1], dims[1:])
+    ]
+    h = dims[-1]
+    return {
+        "torso": torso,
+        "pi": {"w": (jax.random.normal(keys[-2], (h, cfg.num_actions))
+                     * 0.01).astype(jnp.float32),
+               "b": jnp.zeros((cfg.num_actions,), jnp.float32)},
+        "vf": {"w": (jax.random.normal(keys[-1], (h, 1))
+                     * 1.0 / math.sqrt(h)).astype(jnp.float32),
+               "b": jnp.zeros((1,), jnp.float32)},
+    }
+
+
+def forward(params: dict, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """-> (action logits [B, A], value [B])"""
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value
+
+
+def sample_actions(params: dict, obs: np.ndarray, key: jax.Array):
+    """Host-side sampling helper for env runners (CPU jax)."""
+    logits, value = forward(params, jnp.asarray(obs))
+    action = jax.random.categorical(key, logits, axis=-1)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), action]
+    return (np.asarray(action), np.asarray(logp), np.asarray(value))

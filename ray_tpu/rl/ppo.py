@@ -1,0 +1,176 @@
+"""PPO algorithm (ref analogs: rllib/algorithms/ppo/ppo.py:363,
+training_step:389; dataflow per SURVEY.md §3.6: EnvRunner actors sample →
+GAE → LearnerGroup update → weights broadcast back via the object
+store)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.actor_manager import FaultTolerantActorManager
+from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.learner import JaxLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rl.module import MLPModuleConfig
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_fragment_length: int = 64
+    num_learners: int = 1
+    hidden: tuple = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 256
+    seed: int = 0
+
+    def learner_config(self) -> PPOLearnerConfig:
+        return PPOLearnerConfig(
+            lr=self.lr, gamma=self.gamma, gae_lambda=self.gae_lambda,
+            clip_eps=self.clip_eps, vf_coeff=self.vf_coeff,
+            entropy_coeff=self.entropy_coeff, num_epochs=self.num_epochs,
+            minibatch_size=self.minibatch_size)
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Algorithm driver (ref: Algorithm.train()); iteration =
+    sample → update → broadcast."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        probe = make_vector_env(config.env, 1, config.seed)
+        self.module_cfg = MLPModuleConfig(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, hidden=tuple(config.hidden))
+        module_blob = cloudpickle.dumps(self.module_cfg)
+        learner_blob = cloudpickle.dumps(self.config.learner_config())
+
+        runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
+        self._runners = FaultTolerantActorManager([
+            runner_cls.remote(config.env, config.num_envs_per_runner,
+                              config.seed + i, module_blob)
+            for i in range(config.num_env_runners)])
+
+        n_learn = config.num_learners
+        group = f"ppo-learners-{id(self):x}" if n_learn > 1 else None
+        learner_cls = rt.remote(num_cpus=1)(JaxLearner)
+        self._learners = [
+            learner_cls.remote(module_blob, learner_blob, config.seed,
+                               group, n_learn, rank)
+            for rank in range(n_learn)]
+        self._iteration = 0
+        self._recent_returns: list[float] = []
+        self._weights = rt.get(self._learners[0].get_weights.remote(),
+                               timeout=120)
+
+    # ------------------------------------------------------------------ train
+    def train(self) -> dict:
+        cfg = self.config
+        t0 = time.perf_counter()
+        weights_ref = rt.put(self._weights)
+        self._runners.foreach(
+            lambda a: a.set_weights.remote(weights_ref))
+        samples = self._runners.foreach(
+            lambda a: a.sample.remote(cfg.rollout_fragment_length))
+        if not samples:
+            self._runners.probe_unhealthy()
+            raise RuntimeError("all env runners unhealthy")
+        batch, ep_returns, steps = self._build_batch(samples)
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+
+        shards = self._split_batch(batch, len(self._learners))
+        aux = rt.get([lr.update.remote(s)
+                      for lr, s in zip(self._learners, shards)],
+                     timeout=600)[0]
+        self._weights = rt.get(self._learners[0].get_weights.remote(),
+                               timeout=120)
+        self._runners.probe_unhealthy()
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else 0.0),
+            "num_env_steps_sampled": steps,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{f"learner/{k}": v for k, v in aux.items()},
+        }
+
+    def _build_batch(self, samples: list[dict]):
+        cfg = self.config
+        obs, acts, logps, advs, rets = [], [], [], [], []
+        ep_returns: list[float] = []
+        steps = 0
+        for s in samples:
+            adv, ret = compute_gae(
+                s["rewards"], s["values"], s["dones"], s["last_value"],
+                cfg.gamma, cfg.gae_lambda)
+            T, N = s["rewards"].shape
+            steps += T * N
+            obs.append(s["obs"].reshape(T * N, -1))
+            acts.append(s["actions"].reshape(T * N))
+            logps.append(s["logp"].reshape(T * N))
+            advs.append(adv.reshape(T * N))
+            rets.append(ret.reshape(T * N))
+            ep_returns.extend(s["episode_returns"])
+        batch = {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(acts),
+            "logp_old": np.concatenate(logps),
+            "advantages": np.concatenate(advs).astype(np.float32),
+            "returns": np.concatenate(rets).astype(np.float32),
+        }
+        return batch, ep_returns, steps
+
+    @staticmethod
+    def _split_batch(batch: dict, n: int) -> list[dict]:
+        if n == 1:
+            return [batch]
+        return [{k: v[i::n] for k, v in batch.items()} for i in range(n)]
+
+    # ------------------------------------------------------- checkpointable
+    def save_to_path(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"weights": self._weights,
+                         "iteration": self._iteration,
+                         "config": self.config}, f)
+        return path
+
+    def restore_from_path(self, path: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._weights = state["weights"]
+        self._iteration = state["iteration"]
+        rt.get([lr.set_weights.remote(self._weights)
+                for lr in self._learners], timeout=120)
+
+    def stop(self):
+        for a in self._runners._actors + self._learners:
+            try:
+                rt.kill(a)
+            except Exception:
+                pass

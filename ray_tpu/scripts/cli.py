@@ -1,0 +1,195 @@
+"""CLI (ref analog: python/ray/scripts/scripts.py command set +
+util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
+
+Commands: start, stop, status, summary, list {nodes,actors,jobs,pgs,
+workers}, microbenchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PIDFILE = "/tmp/ray_tpu/head.pid"
+ADDRFILE = "/tmp/ray_tpu/head.addr"
+
+
+def _write_state(pid: int, address: str):
+    os.makedirs(os.path.dirname(PIDFILE), exist_ok=True)
+    with open(PIDFILE, "w") as f:
+        f.write(str(pid))
+    with open(ADDRFILE, "w") as f:
+        f.write(address)
+
+
+def _read_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    if os.environ.get("RAYT_ADDRESS"):
+        return os.environ["RAYT_ADDRESS"]
+    try:
+        with open(ADDRFILE) as f:
+            return f.read().strip()
+    except OSError:
+        raise SystemExit("no running cluster found (start one with "
+                         "`python -m ray_tpu start --head`)")
+
+
+def cmd_start(args):
+    if not args.head:
+        raise SystemExit("only --head is supported in-process; worker nodes "
+                         "join via cluster_utils or `ray_tpu.init(address=)`")
+    from ray_tpu._internal.spawn import child_env, fast_python_argv
+
+    resources = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    resources.setdefault("memory", 8 << 30)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    os.makedirs(os.path.dirname(PIDFILE), exist_ok=True)
+    # head stderr goes to a session log, NOT an inherited pipe (a caller
+    # waiting on this CLI's pipes would otherwise block until the head
+    # daemon exits)
+    log = open(os.path.join(os.path.dirname(PIDFILE), "head.log"), "ab")
+    proc = subprocess.Popen(
+        fast_python_argv("ray_tpu.core.head_main")
+        + ["--resources", json.dumps(resources),
+           "--gcs-port", str(args.port)],
+        stdout=subprocess.PIPE, stderr=log, env=child_env(pkg_root),
+        text=True, start_new_session=True)
+    log.close()
+    line = proc.stdout.readline()
+    if not line:
+        raise SystemExit("head process failed to start")
+    info = json.loads(line)
+    address = f"127.0.0.1:{info['gcs_port']}"
+    _write_state(proc.pid, address)
+    print(f"ray_tpu head started (pid {proc.pid})")
+    print(f"  address: {address}")
+    print(f"  attach:  ray_tpu.init(address='{address}')")
+
+
+def cmd_stop(args):
+    try:
+        with open(PIDFILE) as f:
+            pid = int(f.read().strip())
+    except OSError:
+        print("no pidfile; nothing to stop")
+        return
+    try:
+        os.kill(pid, signal.SIGTERM)
+        for _ in range(50):
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+        print(f"stopped head (pid {pid})")
+    except ProcessLookupError:
+        print("head already gone")
+    for f in (PIDFILE, ADDRFILE):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+
+
+def _attach(args):
+    import ray_tpu as rt
+
+    rt.init(address=_read_address(args))
+    return rt
+
+
+def cmd_status(args):
+    from ray_tpu import state_api
+
+    _attach(args)
+    status = state_api.cluster_status()
+    summary = state_api.summary()
+    print(f"uptime: {status['uptime_s']:.0f}s  nodes: "
+          f"{summary['nodes_alive']}/{summary['nodes_total']}  actors: "
+          f"{status['num_actors']}  placement groups: "
+          f"{status['num_placement_groups']}")
+    print("resources:")
+    for k, total in sorted(summary["resources_total"].items()):
+        avail = summary["resources_available"].get(k, 0.0)
+        if k == "memory":
+            print(f"  {k}: {avail / 1e9:.1f}/{total / 1e9:.1f} GB available")
+        else:
+            print(f"  {k}: {avail:g}/{total:g} available")
+
+
+def cmd_summary(args):
+    from ray_tpu import state_api
+
+    _attach(args)
+    print(json.dumps(state_api.summary(), indent=2, default=str))
+
+
+def cmd_list(args):
+    from ray_tpu import state_api
+
+    _attach(args)
+    kind = args.kind
+    fn = {"nodes": state_api.list_nodes, "actors": state_api.list_actors,
+          "jobs": state_api.list_jobs,
+          "pgs": state_api.list_placement_groups,
+          "workers": state_api.list_workers}[kind]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_microbenchmark(args):
+    import ray_tpu as rt
+    from ray_tpu._internal.perf import run_microbenchmarks
+
+    rt.init(num_cpus=args.num_cpus or None)
+    try:
+        for row in run_microbenchmarks(duration=args.duration):
+            print(f"{row['benchmark']}: {row['rate_per_s']}")
+    finally:
+        rt.shutdown()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=int)
+    sp.add_argument("--num-tpus", type=int)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the head node")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
+                                     "workers"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("microbenchmark", help="core perf suite")
+    sp.add_argument("--duration", type=float, default=2.0)
+    sp.add_argument("--num-cpus", type=int)
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

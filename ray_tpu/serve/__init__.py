@@ -1,0 +1,146 @@
+"""ray_tpu.serve — model serving over replica actors (ref analog:
+python/ray/serve; SURVEY.md §3.5 call stack)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.serve.deployment import (Application, AutoscalingConfig,  # noqa: F401
+                                      Deployment, deployment)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+
+_proxy = None
+_proxy_port: Optional[int] = None
+
+
+def _controller(create: bool = True):
+    import ray_tpu as rt
+    from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+
+    try:
+        return rt.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise
+    controller = rt.remote(ServeController).options(
+        name=CONTROLLER_NAME, num_cpus=0, lifetime="detached").remote()
+    rt.get(controller.ensure_loop.remote(), timeout=60)
+    return controller
+
+
+def _build_specs(app: Application) -> tuple[list[dict], str]:
+    """Flatten the bound graph into deployment specs; bound-node init args
+    become handle markers (composition)."""
+    import cloudpickle
+
+    from ray_tpu._internal.serialization import ship_code_by_value
+    from ray_tpu.serve.replica import _HandleMarker
+
+    nodes = app.walk()
+    specs = []
+    for node in nodes:
+        d = node.deployment
+        ship_code_by_value(d.func_or_class)
+
+        def convert(arg, _app_name):
+            if isinstance(arg, Application):
+                return _HandleMarker(arg.deployment.name, _app_name)
+            return arg
+
+        specs.append({
+            "name": d.name,
+            "callable_blob": cloudpickle.dumps(d.func_or_class),
+            "init_args": tuple(convert(a, "__APP__") for a in node.args),
+            "init_kwargs": {k: convert(v, "__APP__")
+                            for k, v in node.kwargs.items()},
+            "num_replicas": d.num_replicas,
+            "ray_actor_options": d.ray_actor_options,
+            "autoscaling_config": d.autoscaling_config,
+            "max_ongoing_requests": d.max_ongoing_requests,
+            "user_config": d.user_config,
+        })
+    return specs, app.deployment.name
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None, _blocking: bool = True,
+        timeout: float = 120.0) -> DeploymentHandle:
+    """Deploy an application and return the ingress handle (ref:
+    serve/api.py:496)."""
+    import ray_tpu as rt
+
+    controller = _controller()
+    specs, ingress = _build_specs(app)
+    for spec in specs:  # stamp the real app name into handle markers
+        from ray_tpu.serve.replica import _HandleMarker
+
+        for container in (spec["init_args"], spec["init_kwargs"].values()):
+            for arg in container:
+                if isinstance(arg, _HandleMarker):
+                    arg.app_name = name
+    rt.get(controller.deploy_application.remote(name, specs), timeout=60)
+    if _blocking:
+        ok = rt.get(controller.wait_ready.remote(name, timeout),
+                    timeout=timeout + 10)
+        if not ok:
+            raise TimeoutError(f"app {name!r} did not become ready")
+    if _proxy is not None:
+        rt.get(_proxy.register_app.remote(name, ingress), timeout=30)
+    return DeploymentHandle(ingress, name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu as rt
+
+    controller = _controller(create=False)
+    deps = rt.get(controller.get_deployments.remote(name), timeout=30)
+    if not deps:
+        raise ValueError(f"no application {name!r}")
+    # ingress is the last-deployed spec; controller preserves dict order
+    return DeploymentHandle(deps[-1]["name"], name)
+
+
+def delete(name: str = "default"):
+    import ray_tpu as rt
+
+    controller = _controller(create=False)
+    rt.get(controller.delete_application.remote(name), timeout=60)
+    if _proxy is not None:
+        rt.get(_proxy.unregister_app.remote(name), timeout=30)
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 0) -> int:
+    """Start the HTTP ingress proxy; returns the bound port (ref:
+    proxy-per-node in the reference; one proxy here — single-head)."""
+    global _proxy, _proxy_port
+    import ray_tpu as rt
+    from ray_tpu.serve.proxy import ProxyActor
+
+    _controller()
+    if _proxy is None:
+        _proxy = rt.remote(ProxyActor).options(
+            name="serve_proxy", num_cpus=0).remote(http_host, http_port)
+        _proxy_port = rt.get(_proxy.start.remote(), timeout=60)
+    return _proxy_port
+
+
+def shutdown():
+    global _proxy, _proxy_port
+    import ray_tpu as rt
+
+    try:
+        controller = _controller(create=False)
+        for app_name in rt.get(controller.list_applications.remote(),
+                               timeout=30):
+            rt.get(controller.delete_application.remote(app_name),
+                   timeout=60)
+        rt.kill(controller)
+    except Exception:
+        pass
+    if _proxy is not None:
+        try:
+            rt.kill(_proxy)
+        except Exception:
+            pass
+    _proxy = None
+    _proxy_port = None

@@ -1,0 +1,201 @@
+"""ServeController — reconciles target app state onto replica actors (ref
+analogs: python/ray/serve/_private/controller.py:84,
+application_state.py, deployment_state.py, autoscaling_state.py).
+
+A detached named actor. The reconcile loop diffs target replica counts
+(static or autoscaled from ongoing-request stats) against live replicas
+and starts/stops ReplicaActors; handles poll `get_routing_table` (the
+long-poll analog) with a version counter so unchanged tables are cheap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+import cloudpickle
+
+CONTROLLER_NAME = "serve_controller"
+
+
+class ServeController:
+    def __init__(self):
+        self.apps: dict[str, dict] = {}      # app -> {dep_name: spec}
+        self.replicas: dict[tuple, list] = {}  # (app, dep) -> [handle]
+        self.version = 0
+        self._scale_marks: dict[tuple, float] = {}
+        self._loop_task = None  # started via ensure_loop (needs the
+        # actor's asyncio loop, which doesn't exist during __init__)
+
+    async def ensure_loop(self) -> bool:
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+        return True
+
+    # ---------------------------------------------------------- app deploy
+    async def deploy_application(self, app_name: str,
+                                 dep_specs: list[dict]) -> bool:
+        self.apps[app_name] = {spec["name"]: spec for spec in dep_specs}
+        await self._reconcile()
+        return True
+
+    async def delete_application(self, app_name: str) -> bool:
+        import ray_tpu as rt
+
+        specs = self.apps.pop(app_name, None)
+        if specs is None:
+            return False
+        for dep_name in specs:
+            for handle in self.replicas.pop((app_name, dep_name), []):
+                try:
+                    rt.kill(handle)
+                except Exception:
+                    pass
+        self.version += 1
+        return True
+
+    def list_applications(self) -> list[str]:
+        return list(self.apps)
+
+    def get_deployments(self, app_name: str) -> list[dict]:
+        return [
+            {"name": spec["name"],
+             "num_replicas": len(self.replicas.get((app_name, spec["name"]),
+                                                   []))}
+            for spec in self.apps.get(app_name, {}).values()]
+
+    # ------------------------------------------------------------- routing
+    def get_routing_table(self, known_version: int = -1) -> Optional[dict]:
+        """Replica handles per (app, deployment); None = unchanged."""
+        if known_version == self.version:
+            return None
+        table = {}
+        for (app, dep), handles in self.replicas.items():
+            table[f"{app}/{dep}"] = list(handles)
+        return {"version": self.version, "table": table}
+
+    async def wait_ready(self, app_name: str, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            specs = self.apps.get(app_name, {})
+            if specs and all(
+                    len(self.replicas.get((app_name, d), [])) >= 1
+                    for d in specs):
+                return True
+            await asyncio.sleep(0.1)
+        return False
+
+    # ----------------------------------------------------------- reconcile
+    async def _reconcile_loop(self):
+        while True:
+            try:
+                await self._reconcile()
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    async def _reconcile(self):
+        import ray_tpu as rt
+
+        changed = False
+        for app_name, specs in list(self.apps.items()):
+            for dep_name, spec in specs.items():
+                key = (app_name, dep_name)
+                live = [h for h in self.replicas.get(key, [])
+                        if self._alive(h)]
+                if len(live) != len(self.replicas.get(key, [])):
+                    changed = True
+                self.replicas[key] = live
+                target = await self._target_replicas(key, spec, len(live))
+                while len(live) < target:
+                    handle = self._start_replica(app_name, spec)
+                    live.append(handle)
+                    changed = True
+                while len(live) > target:
+                    victim = live.pop()
+                    try:
+                        rt.kill(victim)
+                    except Exception:
+                        pass
+                    changed = True
+        if changed:
+            self.version += 1
+
+    def _alive(self, handle) -> bool:
+        from ray_tpu.core.common import ActorState
+        from ray_tpu.core.object_ref import get_core_worker
+
+        try:
+            cw = get_core_worker()
+            info = cw.io.run(cw.gcs.conn.call(
+                "get_actor_info", handle._actor_id))
+            return info is not None and info.state != ActorState.DEAD
+        except Exception:
+            return True  # assume alive on transient errors
+
+    def _start_replica(self, app_name: str, spec: dict):
+        import ray_tpu as rt
+        from ray_tpu.serve.replica import ReplicaActor
+
+        opts = dict(spec.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0)
+        opts["max_concurrency"] = max(
+            spec.get("max_ongoing_requests", 16), 16)
+        cls = rt.remote(**opts)(ReplicaActor)
+        return cls.remote(spec["name"], app_name, spec["callable_blob"],
+                          spec.get("init_args", ()),
+                          spec.get("init_kwargs", {}),
+                          spec.get("user_config"))
+
+    async def _target_replicas(self, key: tuple, spec: dict,
+                               live: int) -> int:
+        auto = spec.get("autoscaling_config")
+        if auto is None:
+            return spec.get("num_replicas", 1)
+        auto = cloudpickle.loads(auto) if isinstance(auto, bytes) else auto
+        stats = await self._collect_stats(key)
+        if stats is None:
+            return max(live, auto.min_replicas)
+        ongoing = sum(stats)
+        desired = max(
+            auto.min_replicas,
+            min(auto.max_replicas,
+                -(-int(ongoing) // max(1, int(auto.target_ongoing_requests)))
+                if ongoing else auto.min_replicas))
+        now = time.monotonic()
+        mark_key = key
+        if desired > live:
+            first = self._scale_marks.setdefault((mark_key, "up"), now)
+            self._scale_marks.pop((mark_key, "down"), None)
+            if now - first >= auto.upscale_delay_s:
+                self._scale_marks.pop((mark_key, "up"), None)
+                return desired
+            return live
+        if desired < live:
+            first = self._scale_marks.setdefault((mark_key, "down"), now)
+            self._scale_marks.pop((mark_key, "up"), None)
+            if now - first >= auto.downscale_delay_s:
+                self._scale_marks.pop((mark_key, "down"), None)
+                return desired
+            return live
+        self._scale_marks.pop((mark_key, "up"), None)
+        self._scale_marks.pop((mark_key, "down"), None)
+        return live
+
+    async def _collect_stats(self, key: tuple) -> Optional[list[float]]:
+        import ray_tpu as rt
+
+        handles = self.replicas.get(key, [])
+        if not handles:
+            return None
+        out = []
+        for h in handles:
+            try:
+                stats = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda h=h: rt.get(h.get_stats.remote(),
+                                             timeout=5))
+                out.append(float(stats["ongoing"]))
+            except Exception:
+                pass
+        return out or None

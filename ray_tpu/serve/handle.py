@@ -1,0 +1,130 @@
+"""DeploymentHandle + router (ref analogs:
+python/ray/serve/handle.py, _private/router.py:321,
+_private/replica_scheduler/pow_2_scheduler.py:52).
+
+Power-of-two-choices over the handle's LOCAL in-flight counts (the
+reference's router keeps a queue-len cache the same way): pick two random
+replicas, send to the one this handle has fewer outstanding requests on.
+Routing tables refresh from the controller on a short TTL (the long-poll
+analog), keyed by a version counter so unchanged tables cost one RPC.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Optional
+
+
+def _get_controller():
+    import ray_tpu as rt
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    return rt.get_actor(CONTROLLER_NAME)
+
+
+class DeploymentResponse:
+    """Future-like response (ref: serve handle DeploymentResponse)."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        import ray_tpu as rt
+
+        try:
+            return rt.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._on_done()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.method_name = method_name
+        self._lock = threading.Lock()
+        self._table_version = -1
+        self._replicas: list = []
+        self._table_ts = 0.0
+        self._inflight: dict[Any, int] = {}
+        self._controller = None
+
+    # picklable: runtime state rebuilds lazily in the new process
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self.method_name))
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name or self.method_name)
+
+    # ------------------------------------------------------------- routing
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            fresh = now - self._table_ts < 1.0 and self._replicas
+            if fresh and not force:
+                return
+        import ray_tpu as rt
+
+        if self._controller is None:
+            self._controller = _get_controller()
+        known = -1 if force else self._table_version
+        update = rt.get(self._controller.get_routing_table.remote(known),
+                        timeout=30)
+        with self._lock:
+            self._table_ts = now
+            if update is None:
+                return
+            self._table_version = update["version"]
+            key = f"{self.app_name}/{self.deployment_name}"
+            self._replicas = update["table"].get(key, [])
+            live = set(id(r) for r in self._replicas)
+            self._inflight = {r: c for r, c in self._inflight.items()
+                              if id(r) in live}
+
+    def _pick_replica(self):
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self.app_name}/"
+                    f"{self.deployment_name}")
+            time.sleep(0.1)
+            self._refresh(force=True)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            return a if self._inflight.get(a, 0) <= self._inflight.get(
+                b, 0) else b
+
+    # ---------------------------------------------------------------- call
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._pick_replica()
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        ref = replica.handle_request.remote(self.method_name, args, kwargs)
+
+        def done(replica=replica):
+            with self._lock:
+                n = self._inflight.get(replica, 1)
+                self._inflight[replica] = max(0, n - 1)
+
+        return DeploymentResponse(ref, done)

@@ -1,0 +1,92 @@
+"""HTTP ingress proxy (ref analog: python/ray/serve/_private/proxy.py:1135
+— uvicorn in the reference; aiohttp here).
+
+Routes: POST/GET /<app_name> (body JSON becomes the request payload) →
+app ingress handle → JSON response. Runs as an async actor; blocking
+ObjectRef gets ride the default thread executor so the event loop keeps
+accepting connections.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: dict[str, Any] = {}
+        self._ingress: dict[str, str] = {}
+        self._runner = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/-/routes", self._routes_endpoint)
+        app.router.add_route("*", "/-/healthz", self._healthz)
+        app.router.add_route("*", "/{app_name}", self._dispatch)
+        app.router.add_route("*", "/{app_name}/{tail:.*}", self._dispatch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:
+            self.port = s.getsockname()[1]
+            break
+        return self.port
+
+    def register_app(self, app_name: str, ingress_deployment: str) -> bool:
+        self._ingress[app_name] = ingress_deployment
+        self._handles.pop(app_name, None)
+        return True
+
+    def unregister_app(self, app_name: str) -> bool:
+        self._ingress.pop(app_name, None)
+        self._handles.pop(app_name, None)
+        return True
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="ok")
+
+    async def _routes_endpoint(self, request):
+        from aiohttp import web
+
+        return web.json_response(dict(self._ingress))
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+
+        app_name = request.match_info["app_name"]
+        ingress = self._ingress.get(app_name)
+        if ingress is None:
+            return web.json_response(
+                {"error": f"no app {app_name!r}"}, status=404)
+        handle = self._handles.get(app_name)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(ingress, app_name)
+            self._handles[app_name] = handle
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                payload = (await request.read()).decode()
+        else:
+            payload = dict(request.query)
+        loop = asyncio.get_running_loop()
+        try:
+            response = await loop.run_in_executor(
+                None, lambda: handle.remote(payload).result(timeout=60))
+        except Exception as e:
+            return web.json_response({"error": repr(e)}, status=500)
+        if isinstance(response, (dict, list, str, int, float, bool,
+                                 type(None))):
+            return web.json_response({"result": response})
+        return web.Response(body=str(response).encode())

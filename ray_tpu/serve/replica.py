@@ -1,0 +1,88 @@
+"""ReplicaActor — hosts the user callable (ref analog:
+python/ray/serve/_private/replica.py:750,807).
+
+Async actor with high max_concurrency: sync user callables are pushed to
+a thread executor so one slow request doesn't block the replica's event
+loop; ongoing-request count backs both the router's power-of-two choices
+and controller autoscaling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Optional
+
+import cloudpickle
+
+
+class _HandleMarker:
+    """Placeholder in init args for a composed deployment's handle."""
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+
+class ReplicaActor:
+    def __init__(self, deployment_name: str, app_name: str,
+                 callable_blob: bytes, init_args: tuple, init_kwargs: dict,
+                 user_config: Any = None):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._ongoing = 0
+        self._total = 0
+        target = cloudpickle.loads(callable_blob)
+        args = tuple(self._resolve(a) for a in init_args)
+        kwargs = {k: self._resolve(v) for k, v in init_kwargs.items()}
+        if isinstance(target, type):
+            self._callable = target(*args, **kwargs)
+        else:
+            self._callable = target
+        self._user_config = user_config
+        if user_config is not None:
+            reconfigure = getattr(self._callable, "reconfigure", None)
+            if reconfigure is not None:
+                reconfigure(user_config)
+
+    def _resolve(self, arg: Any) -> Any:
+        if isinstance(arg, _HandleMarker):
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            return DeploymentHandle(arg.deployment_name, arg.app_name)
+        return arg
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if method_name == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            coro_fn = fn if inspect.iscoroutinefunction(fn) else getattr(
+                fn, "__call__", None)
+            if inspect.iscoroutinefunction(coro_fn):
+                return await coro_fn(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: fn(*args, **kwargs))
+        finally:
+            self._ongoing -= 1
+
+    def get_stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def reconfigure(self, user_config: Any):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        self._user_config = user_config
+        return True
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True

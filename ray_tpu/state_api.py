@@ -1,0 +1,125 @@
+"""State API — programmatic cluster introspection (ref analogs:
+python/ray/util/state/api.py:110 `StateApiClient`, `ray list` CLI
+state_cli.py; backed directly by GCS tables)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+def _cw():
+    from ray_tpu.core.object_ref import get_core_worker
+    from ray_tpu.core.runtime import get_runtime_context
+
+    cw = get_core_worker()
+    if cw is not None:
+        return cw
+    return get_runtime_context().core_worker
+
+
+def list_nodes() -> list[dict]:
+    cw = _cw()
+    nodes = cw.io.run(cw.gcs.get_all_nodes())
+    view = cw.io.run(cw.gcs.get_cluster_resources())
+    out = []
+    for n in nodes:
+        entry = {
+            "node_id": n.node_id.hex(),
+            "address": f"{n.address.host}:{n.address.port}",
+            "alive": n.alive, "resources": dict(n.resources_total),
+            "labels": dict(n.labels or {}),
+        }
+        v = view.get(n.node_id.hex())
+        if v is not None:
+            entry["alive"] = bool(v.get("alive"))
+            entry["available"] = v.get("available", {})
+        out.append(entry)
+    return out
+
+
+def list_actors(*, state: Optional[str] = None) -> list[dict]:
+    cw = _cw()
+    actors = cw.io.run(cw.gcs.conn.call("get_all_actors"))
+    out = []
+    for a in actors:
+        if state is not None and a.state != state:
+            continue
+        out.append({
+            "actor_id": a.actor_id.hex(),
+            "class_name": a.class_name,
+            "state": a.state,
+            "name": a.name,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "num_restarts": a.num_restarts,
+            "death_cause": a.death_cause,
+        })
+    return out
+
+
+def list_jobs() -> list[dict]:
+    cw = _cw()
+    jobs = cw.io.run(cw.gcs.conn.call("get_all_jobs"))
+    return [{"job_id": job_hex, **(meta if isinstance(meta, dict) else
+                                   {"meta": meta})}
+            for job_hex, meta in jobs.items()]
+
+
+def list_placement_groups() -> list[dict]:
+    cw = _cw()
+    status = cw.io.run(cw.gcs.conn.call("cluster_status"))
+    return status.get("placement_groups", [])
+
+
+def list_workers() -> list[dict]:
+    """Per-node worker processes (pool + actor workers), collected by
+    dialing each node manager."""
+    from ray_tpu._internal.rpc import connect
+
+    cw = _cw()
+    out: list[dict] = []
+    for n in cw.io.run(cw.gcs.get_all_nodes()):
+        async def fetch(n=n):
+            conn = await connect(n.address.host, n.address.port)
+            try:
+                return await conn.call("list_workers", timeout=10)
+            finally:
+                await conn.close()
+        try:
+            workers = cw.io.run(fetch())
+        except Exception:
+            continue
+        for w in workers:
+            w["node_id"] = n.node_id.hex()
+            out.append(w)
+    return out
+
+
+def cluster_status() -> dict:
+    cw = _cw()
+    return cw.io.run(cw.gcs.conn.call("cluster_status"))
+
+
+def summary() -> dict:
+    """`ray summary`-style rollup."""
+    nodes = list_nodes()
+    actors = list_actors()
+    by_state: dict[str, int] = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    total: dict[str, float] = {}
+    avail: dict[str, float] = {}
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        for k, v in n["resources"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in n.get("available", {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_total": len(nodes),
+        "actors_by_state": by_state,
+        "resources_total": total,
+        "resources_available": avail,
+    }

@@ -71,19 +71,21 @@ def save_pytree(state: Any, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     try:
         import orbax.checkpoint as ocp
-
+    except ImportError:
+        ocp = None
+    if ocp is not None:
+        # real save errors (ENOSPC, bad leaf types) must surface, not
+        # silently degrade to the pickle fallback
         ckptr = ocp.PyTreeCheckpointer()
         target = os.path.join(path, "pytree")
         if os.path.exists(target):
             shutil.rmtree(target)
         ckptr.save(target, state)
         return
-    except Exception:
-        pass
     import jax
+    import numpy as np
 
-    host_state = jax.tree.map(
-        lambda x: __import__("numpy").asarray(x), state)
+    host_state = jax.tree.map(lambda x: np.asarray(x), state)
     with open(os.path.join(path, "pytree.pkl"), "wb") as f:
         pickle.dump(host_state, f, protocol=5)
 
@@ -137,15 +139,27 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
         self.latest = checkpoint
+        existing = next((t for t in self._tracked
+                         if t.checkpoint.path == checkpoint.path), None)
+        if existing is not None:
+            # same directory re-reported (e.g. a fixed user path): update
+            # in place instead of tracking duplicates forever
+            existing.metrics = dict(metrics)
+            existing.index = self._index
+            self._index += 1
+            return
         self._tracked.append(
             _TrackedCheckpoint(checkpoint, dict(metrics), self._index))
         self._index += 1
         if self.num_to_keep is None or len(self._tracked) <= self.num_to_keep:
             return
-        evicted = sorted(self._tracked, key=self._rank)[0]
-        self._tracked.remove(evicted)
-        if evicted.checkpoint.path != (self.latest and self.latest.path):
-            shutil.rmtree(evicted.checkpoint.path, ignore_errors=True)
+        # evict the worst NON-latest entry (the latest stays tracked until
+        # superseded, so its directory is never orphaned on disk)
+        for candidate in sorted(self._tracked, key=self._rank):
+            if candidate.checkpoint.path != self.latest.path:
+                self._tracked.remove(candidate)
+                shutil.rmtree(candidate.checkpoint.path, ignore_errors=True)
+                return
 
     def _rank(self, t: _TrackedCheckpoint):
         if self.score_attribute and self.score_attribute in t.metrics:

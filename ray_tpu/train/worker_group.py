@@ -43,8 +43,13 @@ class TrainWorker:
 
     def run(self, fn_blob: bytes, config: Optional[dict]) -> dict:
         fn = cloudpickle.loads(fn_blob)
-        if config is not None or _wants_config(fn):
+        if _wants_config(fn):
             fn(config or {})
+        elif config:
+            raise TypeError(
+                f"train loop {getattr(fn, '__name__', fn)!r} takes no "
+                "config parameter but a non-empty train_loop_config was "
+                "given — it would be silently ignored")
         else:
             fn()
         return {"rank": self._ctx.rank, "status": "finished"}
@@ -68,6 +73,24 @@ class TrainWorker:
             except Exception:
                 pass
         return True
+
+
+def actor_options_from_resources(res: dict, *,
+                                 max_concurrency: int = 2) -> dict:
+    """Map a resources dict ({'CPU': 1, 'TPU': 4, 'memory': ..., custom})
+    to rt.remote actor options. 'memory' is accounted per-node, not
+    scheduled as a custom resource."""
+    opts: dict[str, Any] = {"max_concurrency": max_concurrency,
+                            "num_cpus": res.get("CPU", 1)}
+    if res.get("TPU"):
+        opts["num_tpus"] = res["TPU"]
+    if res.get("memory"):
+        opts["memory"] = res["memory"]
+    extra = {k: v for k, v in res.items()
+             if k not in ("CPU", "TPU", "memory")}
+    if extra:
+        opts["resources"] = extra
+    return opts
 
 
 def _wants_config(fn: Callable) -> bool:
@@ -98,19 +121,11 @@ class WorkerGroup:
         if n > 1:
             self.pg = rt.placement_group(self.scaling.bundles(),
                                          strategy=self.scaling.placement_strategy)
-        opts: dict[str, Any] = {"max_concurrency": 2}
         res = self.scaling.worker_resources()
         group_name = f"train-{self.experiment_name}-{self.group_seq}"
         self.workers = []
         for i in range(n):
-            o = dict(opts)
-            o["num_cpus"] = res.get("CPU", 1)
-            if "TPU" in res:
-                o["num_tpus"] = res["TPU"]
-            extra = {k: v for k, v in res.items()
-                     if k not in ("CPU", "TPU", "memory")}
-            if extra:
-                o["resources"] = extra
+            o = actor_options_from_resources(res)
             if self.pg is not None:
                 o["scheduling_strategy"] = self.pg.bundle_strategy(i)
             self.workers.append(actor_cls.options(**o).remote())

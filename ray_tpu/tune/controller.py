@@ -45,6 +45,7 @@ class TuneController:
         from ray_tpu._internal.serialization import dumps_code
 
         self._fn_blob = dumps_code(trainable)
+        self._dirty = False
 
     # ------------------------------------------------------------------ run
     def run(self) -> list[Trial]:
@@ -53,7 +54,19 @@ class TuneController:
         while pending or running:
             while pending and len(running) < self.max_concurrent:
                 trial = pending.pop(0)
-                self._launch(trial)
+                try:
+                    self._launch(trial)
+                except Exception as e:
+                    self._stop_trial_actor(trial)
+                    trial.num_failures += 1
+                    if trial.num_failures <= self.max_failures:
+                        trial.status = TrialStatus.PENDING
+                        pending.append(trial)
+                    else:
+                        trial.status = TrialStatus.ERROR
+                        trial.error = repr(e)
+                    self._dirty = True
+                    continue
                 running.append(trial)
             if not running:
                 break
@@ -66,7 +79,8 @@ class TuneController:
                     self._finish(trial, pending)
                 if trial.status != TrialStatus.RUNNING:
                     running.remove(trial)
-            self._save_state()
+            if self._dirty:
+                self._save_state()
         self._save_state()
         return self.trials
 
@@ -75,22 +89,18 @@ class TuneController:
         return os.path.join(self.experiment_path, trial.trial_id)
 
     def _launch(self, trial: Trial, from_checkpoint: Optional[str] = None):
-        opts = {"max_concurrency": 2,
-                "num_cpus": self.resources.get("CPU", 1)}
-        if self.resources.get("TPU"):
-            opts["num_tpus"] = self.resources["TPU"]
-        extra = {k: v for k, v in self.resources.items()
-                 if k not in ("CPU", "TPU")}
-        if extra:
-            opts["resources"] = extra
+        from ray_tpu.train.worker_group import actor_options_from_resources
+
+        opts = actor_options_from_resources(self.resources)
         actor = rt.remote(TrainWorker).options(**opts).remote()
+        trial.actor = actor  # set early: _stop_trial_actor reaps on failure
         ckpt = from_checkpoint or trial.checkpoint_dir
         rt.get(actor.setup.remote(
             0, 1, self._trial_dir(trial), self.experiment_name, ckpt,
             None, f"tune-{trial.trial_id}"), timeout=120)
-        trial.actor = actor
         trial.run_ref = actor.run.remote(self._fn_blob, trial.config)
         trial.status = TrialStatus.RUNNING
+        self._dirty = True
 
     def _stop_trial_actor(self, trial: Trial):
         if trial.actor is not None:
@@ -118,6 +128,7 @@ class TuneController:
                     break
 
     def _on_result(self, trial: Trial, entry: dict, pending: list[Trial]):
+        self._dirty = True
         metrics = dict(entry["metrics"])
         trial.iteration += 1
         metrics.setdefault("training_iteration", trial.iteration)
@@ -141,6 +152,7 @@ class TuneController:
             pending.append(trial)
 
     def _finish(self, trial: Trial, pending: list[Trial]):
+        self._dirty = True
         try:
             rt.get(trial.run_ref)
             trial.status = TrialStatus.TERMINATED
@@ -168,6 +180,7 @@ class TuneController:
             json.dump(state, f)
         os.replace(tmp, os.path.join(self.experiment_path,
                                      "tuner_state.json"))
+        self._dirty = False
 
 
 def new_trial_id() -> str:

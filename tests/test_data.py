@@ -1,0 +1,158 @@
+"""Data library tests (ref analogs: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+def test_map_filter_count(local_cluster):
+    ds = rd.range(100, num_blocks=4)
+    out = (ds.map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+             .filter(lambda r: r["sq"] % 2 == 0))
+    assert out.count() == 50
+    rows = out.take(3)
+    assert rows[0] == {"id": 0, "sq": 0}
+
+
+def test_map_batches_numpy(local_cluster):
+    ds = rd.range(32, num_blocks=4)
+
+    def add_col(batch):
+        batch["double"] = batch["id"] * 2
+        return batch
+
+    out = ds.map_batches(add_col, batch_size=8)
+    rows = out.take_all()
+    assert len(rows) == 32
+    assert all(r["double"] == 2 * r["id"] for r in rows)
+
+
+def test_map_batches_actor_pool(local_cluster):
+    ds = rd.range(24, num_blocks=4)
+
+    class AddOffset:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, batch):
+            batch["plus"] = batch["id"] + self.offset
+            return batch
+
+    out = ds.map_batches(AddOffset, compute=rd.ActorPoolStrategy(size=2),
+                         fn_constructor_args=(100,))
+    rows = sorted(out.take_all(), key=lambda r: r["id"])
+    assert [r["plus"] for r in rows] == [i + 100 for i in range(24)]
+
+
+def test_flat_map_repartition(local_cluster):
+    ds = rd.from_items([1, 2, 3], num_blocks=2)
+    out = ds.flat_map(lambda r: [{"v": r["item"]}] * r["item"])
+    assert out.count() == 6
+    rep = out.repartition(3)
+    assert rep.materialize().num_blocks() == 3
+    assert rep.count() == 6
+
+
+def test_random_shuffle_preserves_rows(local_cluster):
+    ds = rd.range(60, num_blocks=4)
+    shuffled = ds.random_shuffle(seed=7)
+    ids = [r["id"] for r in shuffled.take_all()]
+    assert sorted(ids) == list(range(60))
+    assert ids != list(range(60))
+
+
+def test_sort_limit_take(local_cluster):
+    ds = rd.from_items([5, 3, 9, 1, 7], num_blocks=2)
+    out = ds.sort(key=lambda r: r["item"])
+    assert [r["item"] for r in out.take_all()] == [1, 3, 5, 7, 9]
+    assert [r["item"] for r in out.limit(2).take_all()] == [1, 3]
+
+
+def test_union_zip(local_cluster):
+    a = rd.from_items([1, 2], num_blocks=1)
+    b = rd.from_items([3], num_blocks=1)
+    assert a.union(b).count() == 3
+    za = rd.from_items([{"x": 1}, {"x": 2}], num_blocks=1)
+    zb = rd.from_items([{"y": 10}, {"y": 20}], num_blocks=1)
+    assert za.zip(zb).take_all() == [{"x": 1, "y": 10}, {"x": 2, "y": 20}]
+
+
+def test_groupby_aggregate(local_cluster):
+    rows = [{"k": i % 3, "v": i} for i in range(12)]
+    ds = rd.from_items(rows, num_blocks=3)
+    agg = ds.groupby("k").sum("v").take_all()
+    by_key = {r["k"]: r["sum(v)"] for r in agg}
+    assert by_key == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    counts = {r["k"]: r["count"] for r in
+              ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+
+
+def test_iter_batches_shapes(local_cluster):
+    ds = rd.range(10, num_blocks=3)
+    batches = list(ds.iter_batches(batch_size=4))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [4, 4, 2]
+    assert isinstance(batches[0]["id"], np.ndarray)
+    full = np.concatenate([b["id"] for b in batches])
+    assert sorted(full.tolist()) == list(range(10))
+
+
+def test_aggregates(local_cluster):
+    ds = rd.from_items([{"v": float(i)} for i in range(5)], num_blocks=2)
+    assert ds.sum("v") == 10.0
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 4.0
+    assert ds.mean("v") == 2.0
+
+
+def test_streaming_split(local_cluster):
+    ds = rd.range(20, num_blocks=4)
+    shards = ds.streaming_split(2, equal=True)
+    counts = [s.count() for s in shards]
+    assert counts == [10, 10]
+    all_ids = sorted(r["id"] for s in shards for r in s.iter_rows())
+    assert all_ids == list(range(20))
+
+
+def test_streaming_split_usable_in_workers(local_cluster):
+    import ray_tpu as rt
+
+    ds = rd.range(16, num_blocks=4)
+    shards = ds.streaming_split(2, equal=True)
+
+    @rt.remote
+    def consume(it):
+        return sum(r["id"] for r in it.iter_rows())
+
+    totals = rt.get([consume.remote(s) for s in shards])
+    assert sum(totals) == sum(range(16))
+
+
+def test_read_text_csv_parquet_json(local_cluster, tmp_path):
+    (tmp_path / "a.txt").write_text("hello\nworld\n")
+    ds = rd.read_text(str(tmp_path / "a.txt"))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+    (tmp_path / "b.csv").write_text("x,y\n1,2\n3,4\n")
+    rows = rd.read_csv(str(tmp_path / "b.csv")).take_all()
+    assert rows == [{"x": "1", "y": "2"}, {"x": "3", "y": "4"}]
+
+    (tmp_path / "c.json").write_text('[{"a": 1}, {"a": 2}]')
+    assert rd.read_json(str(tmp_path / "c.json")).count() == 2
+
+    src = rd.from_items([{"n": i} for i in range(6)], num_blocks=2)
+    rd.write_parquet(src, str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert sorted(r["n"] for r in back.take_all()) == list(range(6))
+
+
+def test_pipeline_streams(local_cluster):
+    """Chained map stages run streamingly over many blocks."""
+    ds = rd.range(200, num_blocks=16)
+    out = (ds.map(lambda r: {"v": r["id"] * 2})
+             .filter(lambda r: r["v"] % 4 == 0)
+             .map_batches(lambda b: {"v": b["v"] + 1}, batch_size=None))
+    vals = sorted(r["v"] for r in out.take_all())
+    assert vals == [4 * i + 1 for i in range(100)]

@@ -1,0 +1,141 @@
+"""RL library tests: env physics, GAE, fault-tolerant fleet, PPO learning
+(ref analogs: rllib tests + tuned_examples learning assertions)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.env import CartPoleVectorEnv
+from ray_tpu.rl.learner import compute_gae
+
+
+def test_cartpole_env_basics():
+    env = CartPoleVectorEnv(num_envs=4, seed=0)
+    obs = env.reset(0)
+    assert obs.shape == (4, 4)
+    total_done = 0
+    for _ in range(300):
+        obs, rew, term, trunc = env.step(np.random.randint(0, 2, 4))
+        assert obs.shape == (4, 4) and rew.shape == (4,)
+        total_done += int((term | trunc).sum())
+    # random policy falls over well before 300 steps
+    assert total_done > 0
+
+
+def test_cartpole_balancing_vs_random():
+    """A crude hand policy (push toward the pole lean) survives longer
+    than random — sanity-checks the dynamics' sign conventions."""
+    def run(policy):
+        env = CartPoleVectorEnv(num_envs=8, seed=1)
+        obs = env.reset(1)
+        lengths = []
+        steps = np.zeros(8)
+        for _ in range(200):
+            acts = policy(obs)
+            obs, _, term, trunc = env.step(acts)
+            done = term | trunc
+            steps += 1
+            for i in np.nonzero(done)[0]:
+                lengths.append(steps[i])
+                steps[i] = 0
+        return np.mean(lengths) if lengths else 200.0
+
+    rng = np.random.RandomState(0)
+    random_len = run(lambda obs: rng.randint(0, 2, len(obs)))
+    lean_len = run(lambda obs: (obs[:, 2] > 0).astype(int))
+    assert lean_len > random_len
+
+
+def test_gae_matches_naive():
+    T, N = 5, 2
+    rng = np.random.RandomState(0)
+    rewards = rng.randn(T, N).astype(np.float32)
+    values = rng.randn(T, N).astype(np.float32)
+    dones = np.zeros((T, N), bool)
+    dones[2, 0] = True
+    last = rng.randn(N).astype(np.float32)
+    gamma, lam = 0.9, 0.8
+    adv, ret = compute_gae(rewards, values, dones, last, gamma, lam)
+
+    # naive per-env recursion
+    for n in range(N):
+        gae = 0.0
+        next_v = last[n]
+        expect = np.zeros(T)
+        for t in range(T - 1, -1, -1):
+            nonterm = 0.0 if dones[t, n] else 1.0
+            delta = rewards[t, n] + gamma * next_v * nonterm - values[t, n]
+            gae = delta + gamma * lam * nonterm * gae
+            expect[t] = gae
+            next_v = values[t, n]
+        np.testing.assert_allclose(adv[:, n], expect, rtol=1e-5)
+    np.testing.assert_allclose(ret, adv + values, rtol=1e-6)
+
+
+def test_fault_tolerant_actor_manager(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu.rl.actor_manager import FaultTolerantActorManager
+
+    @rt.remote
+    class W:
+        def __init__(self):
+            self.n = 0
+
+        def work(self):
+            self.n += 1
+            return self.n
+
+        def ping(self):
+            return True
+
+    actors = [W.remote() for _ in range(3)]
+    mgr = FaultTolerantActorManager(actors)
+    assert mgr.foreach(lambda a: a.work.remote()) == [1, 1, 1]
+    rt.kill(actors[1])
+    results = mgr.foreach(lambda a: a.work.remote(), timeout=30)
+    assert len(results) == 2  # dead actor dropped, marked unhealthy
+    assert mgr.num_healthy == 2
+    results = mgr.foreach(lambda a: a.work.remote())
+    assert len(results) == 2
+
+
+def test_ppo_learns_cartpole(local_cluster):
+    from ray_tpu.rl import PPOConfig
+
+    algo = PPOConfig(
+        num_env_runners=2, num_envs_per_runner=8,
+        rollout_fragment_length=64, lr=1e-3, entropy_coeff=0.0,
+        minibatch_size=256, num_epochs=6, seed=3).build()
+    first = None
+    best = 0.0
+    for i in range(25):
+        result = algo.train()
+        ret = result["episode_return_mean"]
+        if first is None and ret > 0:
+            first = ret
+        best = max(best, ret)
+        if best >= 80.0 and i >= 4:
+            break
+    algo.stop()
+    assert first is not None, "no episodes completed"
+    assert best >= 80.0, f"PPO failed to learn: first={first} best={best}"
+    assert best > 2 * min(first, 40.0)
+
+
+def test_ppo_checkpoint_roundtrip(local_cluster, tmp_path):
+    from ray_tpu.rl import PPOConfig
+
+    algo = PPOConfig(num_env_runners=1, num_envs_per_runner=4,
+                     rollout_fragment_length=16, seed=0).build()
+    algo.train()
+    path = algo.save_to_path(str(tmp_path / "ck"))
+    it = algo._iteration
+    algo.stop()
+
+    algo2 = PPOConfig(num_env_runners=1, num_envs_per_runner=4,
+                      rollout_fragment_length=16, seed=0).build()
+    algo2.restore_from_path(path)
+    assert algo2._iteration == it
+    w1 = algo2._weights["pi"]["w"]
+    result = algo2.train()
+    assert result["training_iteration"] == it + 1
+    algo2.stop()

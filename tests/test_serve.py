@@ -1,0 +1,143 @@
+"""Serve tests: deployments, composition, autoscaling, HTTP proxy (ref
+analogs: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(local_cluster):
+    yield local_cluster
+    serve.shutdown()
+
+
+def test_basic_class_deployment(serve_cluster):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+    handle = serve.run(Greeter.bind("Hello"), name="greet")
+    assert handle.remote("TPU").result(timeout=30) == "Hello, TPU!"
+
+
+def test_function_deployment_and_methods(serve_cluster):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="double")
+    assert handle.remote(21).result(timeout=30) == 42
+
+    @serve.deployment
+    class Calc:
+        def add(self, a, b):
+            return a + b
+
+        async def sub(self, a, b):
+            return a - b
+
+    h = serve.run(Calc.bind(), name="calc")
+    assert h.options(method_name="add").remote(2, 3).result(timeout=30) == 5
+    assert h.options(method_name="sub").remote(9, 4).result(timeout=30) == 5
+
+
+def test_composition(serve_cluster):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout=30)
+            return y * 10
+
+    handle = serve.run(Model.bind(Preprocess.bind()), name="composed")
+    assert handle.remote(4).result(timeout=30) == 50
+
+
+def test_multiple_replicas_spread_load(serve_cluster):
+    @serve.deployment(num_replicas=3)
+    class Who:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Who.bind(), name="who")
+    pids = {handle.remote(None).result(timeout=30) for _ in range(24)}
+    assert len(pids) >= 2  # p2c spreads across replicas
+
+
+def test_http_proxy(serve_cluster):
+    port = serve.start(http_port=0)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), name="echo")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo",
+        data=json.dumps({"msg": "hi"}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body == {"result": {"echo": {"msg": "hi"}}}
+
+    health = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/-/healthz", timeout=10).read()
+    assert health == b"ok"
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1, "upscale_delay_s": 0.5})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.5)
+            return "done"
+
+    handle = serve.run(Slow.bind(), name="slow")
+    controller = serve._controller(create=False)
+
+    responses = [handle.remote(None) for _ in range(8)]
+    deadline = time.monotonic() + 30
+    peak = 1
+    while time.monotonic() < deadline:
+        deps = rt.get(controller.get_deployments.remote("slow"), timeout=10)
+        peak = max(peak, deps[0]["num_replicas"])
+        if peak >= 2:
+            break
+        time.sleep(0.5)
+    assert peak >= 2, "autoscaler never scaled up"
+    for r in responses:
+        assert r.result(timeout=60) == "done"
+
+
+def test_delete_app(serve_cluster):
+    @serve.deployment
+    def noop(x):
+        return x
+
+    serve.run(noop.bind(), name="tmp")
+    controller = serve._controller(create=False)
+    assert "tmp" in rt.get(controller.list_applications.remote(), timeout=10)
+    serve.delete("tmp")
+    assert "tmp" not in rt.get(controller.list_applications.remote(),
+                               timeout=10)

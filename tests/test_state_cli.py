@@ -1,0 +1,92 @@
+"""State API + CLI tests (ref analogs: python/ray/tests/test_state_api.py,
+`ray status/list/microbenchmark`)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def test_state_api_lists(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    rt.get(a.ping.remote())
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert nodes[0]["resources"]["TPU"] == 8.0
+
+    actors = state_api.list_actors()
+    assert any(x["class_name"] == "A" and x["state"] == "ALIVE"
+               for x in actors)
+
+    workers = state_api.list_workers()
+    assert any(w.get("actor_id") for w in workers)
+
+    jobs = state_api.list_jobs()
+    assert len(jobs) >= 1
+
+    s = state_api.summary()
+    assert s["nodes_alive"] == 1
+    assert s["actors_by_state"].get("ALIVE", 0) >= 1
+    rt.kill(a)
+
+
+def test_state_api_placement_groups(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    pg = rt.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    pgs = state_api.list_placement_groups()
+    assert len(pgs) == 1
+    assert pgs[0]["strategy"] == "PACK"
+    rt.remove_placement_group(pg)
+    assert state_api.list_placement_groups() == []
+
+
+def test_cli_start_status_stop(tmp_path):
+    env = dict(__import__("os").environ)
+    env["PYTHONPATH"] = "/root/repo"
+
+    def cli(*args, timeout=90):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, env=env, timeout=timeout)
+
+    r = cli("start", "--head", "--num-cpus", "2")
+    try:
+        assert r.returncode == 0, r.stderr
+        assert "address:" in r.stdout
+        address = [ln.split()[-1] for ln in r.stdout.splitlines()
+                   if "address:" in ln][0]
+
+        r = cli("status", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "nodes: 1/1" in r.stdout
+
+        r = cli("list", "nodes", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)[0]["alive"] is True
+    finally:
+        r = cli("stop")
+        assert r.returncode == 0, r.stderr
+
+
+def test_cli_microbenchmark():
+    env = dict(__import__("os").environ)
+    env["PYTHONPATH"] = "/root/repo"
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "microbenchmark",
+         "--duration", "0.3", "--num-cpus", "4"],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "tasks_per_second" in r.stdout
+    assert "put_get_gigabytes_per_second" in r.stdout

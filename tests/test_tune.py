@@ -80,12 +80,14 @@ def test_tuner_asha_stops_bad_trials(local_cluster, tmp_path):
     from ray_tpu import tune
     from ray_tpu.train.config import RunConfig
 
+    # good trials run first (wave 1) so their rung records deterministically
+    # stop the bad trials in wave 2 at the first rung
     tuner = tune.Tuner(
         _trainable,
-        param_space={"lr": tune.grid_search([0.01, 0.02, 0.8, 0.9]),
-                     "iters": 12, "sleep": 0.08},
+        param_space={"lr": tune.grid_search([0.9, 0.8, 0.02, 0.01]),
+                     "iters": 12},
         tune_config=tune.TuneConfig(
-            metric="loss", mode="min",
+            metric="loss", mode="min", max_concurrent_trials=2,
             scheduler=tune.ASHAScheduler(
                 metric="loss", mode="min", time_attr="training_iteration",
                 grace_period=2, reduction_factor=2, max_t=12)),
@@ -93,9 +95,9 @@ def test_tuner_asha_stops_bad_trials(local_cluster, tmp_path):
     grid = tuner.fit()
     best = grid.get_best_result()
     assert best.config["lr"] in (0.8, 0.9)
-    # at least one slow trial stopped early
-    iters = [t.iteration for t in grid._trials]
-    assert min(iters) < 12
+    by_lr = {t.config["lr"]: t.iteration for t in grid._trials}
+    assert by_lr[0.01] < 12 and by_lr[0.02] < 12  # stopped early
+    assert by_lr[0.9] == 12  # survivors ran to completion
 
 
 def test_tuner_restore(local_cluster, tmp_path):
