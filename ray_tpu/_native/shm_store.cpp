@@ -218,38 +218,28 @@ extern "C" {
 
 void* rayt_shm_open(const char* name, uint64_t capacity,
                     uint64_t table_slots) {
-  uint64_t table_bytes = align_up(table_slots * sizeof(Entry), kAlign);
   uint64_t hdr_bytes = align_up(sizeof(Header), kAlign);
-  uint64_t total = hdr_bytes + table_bytes + capacity;
 
-  bool creator = false;
   int fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0666);
   if (fd >= 0) {
-    creator = true;
-    if (ftruncate(fd, (off_t)total) != 0) { close(fd); shm_unlink(name); return nullptr; }
-  } else {
-    fd = shm_open(name, O_RDWR, 0666);
-    if (fd < 0) return nullptr;
-    // wait for the creator to finish ftruncate
-    struct stat st;
-    for (int i = 0; i < 10000; i++) {
-      if (fstat(fd, &st) == 0 && (uint64_t)st.st_size >= total) break;
-      usleep(1000);
+    // ----- creator: size from caller-supplied capacity/table_slots -----
+    uint64_t table_bytes = align_up(table_slots * sizeof(Entry), kAlign);
+    uint64_t total = hdr_bytes + table_bytes + capacity;
+    if (ftruncate(fd, (off_t)total) != 0) {
+      close(fd); shm_unlink(name); return nullptr;
     }
-  }
-  uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
-                                 MAP_SHARED, fd, 0);
-  if (base == MAP_FAILED) { close(fd); return nullptr; }
+    uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                                   MAP_SHARED, fd, 0);
+    if (base == MAP_FAILED) { close(fd); return nullptr; }
 
-  Store* s = new Store();
-  s->fd = fd;
-  s->base = base;
-  s->total_size = total;
-  s->hdr = reinterpret_cast<Header*>(base);
-  s->table = reinterpret_cast<Entry*>(base + hdr_bytes);
-  s->arena = base + hdr_bytes + table_bytes;
+    Store* s = new Store();
+    s->fd = fd;
+    s->base = base;
+    s->total_size = total;
+    s->hdr = reinterpret_cast<Header*>(base);
+    s->table = reinterpret_cast<Entry*>(base + hdr_bytes);
+    s->arena = base + hdr_bytes + table_bytes;
 
-  if (creator) {
     memset(base, 0, hdr_bytes + table_bytes);
     s->hdr->capacity = capacity;
     s->hdr->table_slots = table_slots;
@@ -263,15 +253,62 @@ void* rayt_shm_open(const char* name, uint64_t capacity,
     b->prev_size = 0;
     b->used = 0;
     __atomic_store_n(&s->hdr->magic, kMagic, __ATOMIC_RELEASE);
-  } else {
-    for (int i = 0; i < 10000; i++) {
-      if (__atomic_load_n(&s->hdr->magic, __ATOMIC_ACQUIRE) == kMagic) break;
-      usleep(1000);
-    }
-    if (s->hdr->magic != kMagic) {
-      munmap(base, total); close(fd); delete s; return nullptr;
-    }
+    return s;
   }
+
+  // ----- attach: size the mapping from the EXISTING segment, never from
+  // the caller's (possibly divergent) capacity config. Mapping fewer
+  // bytes than the creator's arena would SIGBUS on first deep read.
+  fd = shm_open(name, O_RDWR, 0666);
+  if (fd < 0) return nullptr;
+
+  // 1) wait for the creator's ftruncate (single call: size goes 0 -> total)
+  struct stat st;
+  st.st_size = 0;
+  for (int i = 0; i < 10000; i++) {
+    if (fstat(fd, &st) == 0 && (uint64_t)st.st_size >= hdr_bytes) break;
+    usleep(1000);
+  }
+  if ((uint64_t)st.st_size < hdr_bytes) { close(fd); return nullptr; }
+  uint64_t total = (uint64_t)st.st_size;
+
+  uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); return nullptr; }
+  Header* hdr = reinterpret_cast<Header*>(base);
+
+  // 2) wait for the creator to finish initializing (magic is the release)
+  bool ready = false;
+  for (int i = 0; i < 10000; i++) {
+    if (__atomic_load_n(&hdr->magic, __ATOMIC_ACQUIRE) == kMagic) {
+      ready = true;
+      break;
+    }
+    usleep(1000);
+  }
+  // 3) validate geometry recorded in the header against the real size
+  uint64_t table_bytes =
+      ready ? align_up(hdr->table_slots * sizeof(Entry), kAlign) : 0;
+  if (!ready || hdr_bytes + table_bytes + hdr->capacity > total) {
+    fprintf(stderr,
+            "rayt_shm_open(%s): attach failed (ready=%d capacity=%llu "
+            "table_slots=%llu segment=%llu)\n",
+            name, (int)ready,
+            ready ? (unsigned long long)hdr->capacity : 0ULL,
+            ready ? (unsigned long long)hdr->table_slots : 0ULL,
+            (unsigned long long)total);
+    munmap(base, total);
+    close(fd);
+    return nullptr;
+  }
+
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = base;
+  s->total_size = total;
+  s->hdr = hdr;
+  s->table = reinterpret_cast<Entry*>(base + hdr_bytes);
+  s->arena = base + hdr_bytes + table_bytes;
   return s;
 }
 

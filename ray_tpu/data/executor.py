@@ -109,16 +109,28 @@ class StreamingExecutor:
         actor_cls = rt.remote(num_cpus=1)(_MapActor)
         actors = [actor_cls.remote(spec) for _ in range(n)]
         futures: collections.deque = collections.deque()
+        dispatched: list = []
         try:
             # round-robin: per-actor ordered queues serialize execution, the
             # window bounds blocks in flight
             for i, ref in enumerate(refs):
-                futures.append(actors[i % n].apply.remote(ref))
+                fut = actors[i % n].apply.remote(ref)
+                futures.append(fut)
+                dispatched.append(fut)
                 if len(futures) >= self.max_in_flight:
                     yield futures.popleft()
             while futures:
                 yield futures.popleft()
         finally:
+            # Consumers may drain the yielded refs without resolving them
+            # (materialize / all-to-all stages do list(refs) first); killing
+            # the pool while tasks are still queued would fail later gets
+            # with ActorDiedError. Wait for every dispatched block first.
+            try:
+                rt.wait(dispatched, num_returns=len(dispatched),
+                        timeout=60.0)
+            except Exception:
+                pass
             for a in actors:
                 try:
                     rt.kill(a)

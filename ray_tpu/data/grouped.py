@@ -3,10 +3,27 @@ analog: python/ray/data/grouped_data.py + planner/exchange hash shuffle)."""
 
 from __future__ import annotations
 
+import pickle
+import zlib
 from typing import Any, Callable
 
 import ray_tpu as rt
 from ray_tpu.data.block import Block, concat_blocks
+
+
+def _stable_hash(value: Any) -> int:
+    """Process-stable key hash: builtin hash() of str/bytes is randomized
+    per process (PYTHONHASHSEED), so two workers would route the same key
+    to different partitions. crc32 over a canonical pickle is stable."""
+    if isinstance(value, bytes):
+        data = value
+    elif isinstance(value, str):
+        data = value.encode()
+    elif isinstance(value, int):
+        return value & 0x7FFFFFFF
+    else:
+        data = pickle.dumps(value, protocol=4)
+    return zlib.crc32(data)
 
 
 class GroupedData:
@@ -24,7 +41,7 @@ class GroupedData:
         def shard(block: Block, n: int) -> list[Block]:
             shards: list[Block] = [[] for _ in range(n)]
             for row in block:
-                shards[hash(row[key]) % n].append(row)
+                shards[_stable_hash(row[key]) % n].append(row)
             return shards
 
         def combine(*shards: Block) -> Block:

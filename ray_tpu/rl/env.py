@@ -20,7 +20,12 @@ class VectorEnv:
         raise NotImplementedError
 
     def step(self, actions: np.ndarray):
-        """-> (obs [n, obs_size], reward [n], terminated [n], truncated [n])"""
+        """-> (obs [n, obs_size], reward [n], terminated [n], truncated [n],
+        final_obs [n, obs_size]).
+
+        `obs` is post-auto-reset; `final_obs` is the pre-reset observation
+        of each env (== obs where not done) so truncated episodes can be
+        bootstrapped with the critic's value of the true final state."""
         raise NotImplementedError
 
 
@@ -79,11 +84,12 @@ class CartPoleVectorEnv(VectorEnv):
 
         terminated = ((np.abs(x) > self.X_LIMIT)
                       | (np.abs(theta) > self.THETA_LIMIT))
-        truncated = self._steps >= self.MAX_STEPS
+        truncated = (self._steps >= self.MAX_STEPS) & ~terminated
         reward = np.ones(self.num_envs, np.float32)
+        final_obs = self._state.astype(np.float32)
         self._reset_envs(terminated | truncated)
         return (self._state.astype(np.float32), reward,
-                terminated, truncated)
+                terminated, truncated, final_obs)
 
 
 _ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv}

@@ -50,6 +50,8 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.bool_)
+        trunc_val_buf = np.zeros((T, N), np.float32)
+        pending_trunc: list[tuple] = []  # (t, env idxs, final obs rows)
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             action, logp, value = rlm.sample_actions(
@@ -58,22 +60,39 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = logp
             val_buf[t] = value
-            self._obs, reward, terminated, truncated = self.env.step(action)
+            (self._obs, reward, terminated, truncated,
+             final_obs) = self.env.step(action)
             rew_buf[t] = reward
+            truncated = truncated & ~terminated
             done = terminated | truncated
             done_buf[t] = done
+            if truncated.any():
+                idxs = np.nonzero(truncated)[0]
+                pending_trunc.append((t, idxs, final_obs[idxs]))
             self._ep_return += reward
             for i in np.nonzero(done)[0]:
                 self._completed.append(float(self._ep_return[i]))
                 self._ep_return[i] = 0.0
-        # bootstrap value for the final observation
         import jax.numpy as jnp
 
+        # bootstrap value for the final observation
         _, last_value = rlm.forward(self._params, jnp.asarray(self._obs))
+        # truncated (not terminated) episodes bootstrap with V(final_obs)
+        # rather than 0 — rllib's truncation semantics (ref:
+        # rllib postprocessing of truncated episodes)
+        if pending_trunc:
+            cat = np.concatenate([rows for _, _, rows in pending_trunc])
+            _, vals = rlm.forward(self._params, jnp.asarray(cat))
+            vals = np.asarray(vals)
+            i = 0
+            for t, idxs, rows in pending_trunc:
+                trunc_val_buf[t, idxs] = vals[i:i + len(idxs)]
+                i += len(idxs)
         completed, self._completed = self._completed, []
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "trunc_values": trunc_val_buf,
             "last_value": np.asarray(last_value),
             "episode_returns": completed,
         }

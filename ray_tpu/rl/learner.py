@@ -28,15 +28,25 @@ class PPOLearnerConfig:
     max_grad_norm: float = 0.5
 
 
-def compute_gae(rewards, values, dones, last_value, gamma, lam):
-    """[T, N] arrays -> (advantages, returns), numpy (host side)."""
+def compute_gae(rewards, values, dones, last_value, gamma, lam,
+                trunc_values=None):
+    """[T, N] arrays -> (advantages, returns), numpy (host side).
+
+    `trunc_values[t, i]` is V(final_obs) where env i was *truncated*
+    (time-limit cut, not a true terminal) at step t, 0 elsewhere: the GAE
+    recursion still cuts at those steps, but the bootstrap target is the
+    critic's value of the final state instead of 0.
+    """
     T = rewards.shape[0]
     adv = np.zeros_like(rewards)
     gae = np.zeros(rewards.shape[1], rewards.dtype)
     next_value = last_value
     for t in range(T - 1, -1, -1):
         nonterminal = 1.0 - dones[t].astype(rewards.dtype)
-        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        boot = next_value * nonterminal
+        if trunc_values is not None:
+            boot = boot + trunc_values[t]
+        delta = rewards[t] + gamma * boot - values[t]
         gae = delta + gamma * lam * nonterminal * gae
         adv[t] = gae
         next_value = values[t]

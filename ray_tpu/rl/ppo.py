@@ -121,7 +121,7 @@ class PPO:
         for s in samples:
             adv, ret = compute_gae(
                 s["rewards"], s["values"], s["dones"], s["last_value"],
-                cfg.gamma, cfg.gae_lambda)
+                cfg.gamma, cfg.gae_lambda, s.get("trunc_values"))
             T, N = s["rewards"].shape
             steps += T * N
             obs.append(s["obs"].reshape(T * N, -1))

@@ -34,9 +34,39 @@ class ServeController:
         return True
 
     # ---------------------------------------------------------- app deploy
+    @staticmethod
+    def _spec_version(spec: dict) -> str:
+        """Content hash of the parts of a spec that require a replica
+        restart to take effect (code + construction args)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(spec.get("callable_blob") or b"")
+        h.update(repr((spec.get("init_args"), spec.get("init_kwargs"),
+                       spec.get("user_config"))).encode())
+        return h.hexdigest()
+
     async def deploy_application(self, app_name: str,
                                  dep_specs: list[dict]) -> bool:
-        self.apps[app_name] = {spec["name"]: spec for spec in dep_specs}
+        import ray_tpu as rt
+
+        new = {spec["name"]: spec for spec in dep_specs}
+        old = self.apps.get(app_name, {})
+        # Drop replicas of deployments removed from the new spec, and of
+        # deployments whose code/args changed (version replace) — otherwise
+        # stale replicas keep serving the old callable forever.
+        stale = set(old) - set(new)
+        stale |= {d for d in set(old) & set(new)
+                  if self._spec_version(old[d]) != self._spec_version(new[d])}
+        for dep_name in stale:
+            for handle in self.replicas.pop((app_name, dep_name), []):
+                try:
+                    rt.kill(handle)
+                except Exception:
+                    pass
+        if stale:
+            self.version += 1
+        self.apps[app_name] = new
         await self._reconcile()
         return True
 

@@ -28,7 +28,28 @@ try:
 except Exception:
     pass
 
+import signal  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test wall-clock cap (the reference sets 3 min in pytest.ini:14).
+# pytest-timeout isn't in the image, so use SIGALRM directly.
+TEST_TIMEOUT_S = int(os.environ.get("RAYT_TEST_TIMEOUT_S", "180"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    def _on_timeout(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {TEST_TIMEOUT_S}s (RAYT_TEST_TIMEOUT_S)")
+
+    old = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.alarm(TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(scope="session")

@@ -14,7 +14,7 @@ def test_cartpole_env_basics():
     assert obs.shape == (4, 4)
     total_done = 0
     for _ in range(300):
-        obs, rew, term, trunc = env.step(np.random.randint(0, 2, 4))
+        obs, rew, term, trunc, _ = env.step(np.random.randint(0, 2, 4))
         assert obs.shape == (4, 4) and rew.shape == (4,)
         total_done += int((term | trunc).sum())
     # random policy falls over well before 300 steps
@@ -31,7 +31,7 @@ def test_cartpole_balancing_vs_random():
         steps = np.zeros(8)
         for _ in range(200):
             acts = policy(obs)
-            obs, _, term, trunc = env.step(acts)
+            obs, _, term, trunc, _ = env.step(acts)
             done = term | trunc
             steps += 1
             for i in np.nonzero(done)[0]:
