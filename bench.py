@@ -65,11 +65,13 @@ def _run(on_tpu: bool) -> dict:
     from ray_tpu.parallel.mesh import build_mesh
     from ray_tpu.parallel.spmd import build_train_step, shard_batch
     if on_tpu:
-        preset, batch, seq, steps = "160m", 8, 2048, 20
+        # best single-v5e config from the on-chip sweep: 410m params fills
+        # the MXU better than 160m while params+adamw+activations fit HBM
+        preset, batch, seq, steps = "410m", 8, 2048, 20
     else:
         preset, batch, seq, steps = "debug", 4, 128, 5
 
-    cfg = llama.config_for(preset, max_seq_len=seq,
+    cfg = llama.config_for(preset, max_seq_len=seq, remat=on_tpu,
                            attn_impl="flash" if on_tpu else "xla")
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -34,6 +34,13 @@ class Config:
     gcs_health_check_period_s: float = 1.0
     gcs_health_check_timeout_s: float = 5.0
     gcs_health_check_failure_threshold: int = 5
+    # Snapshot path for GCS table persistence ("" = in-memory only). With
+    # a path set, a restarted head reloads cluster state and nodes
+    # re-register (ref analog: gcs/store_client/redis_store_client.h).
+    gcs_persist_path: str = ""
+    # Mark a node dead after this many seconds without a heartbeat (used
+    # after head restart, when the death-detecting connection is gone).
+    node_death_timeout_s: float = 10.0
     # ---- scheduler ----
     lease_timeout_s: float = 30.0
     worker_startup_timeout_s: float = 60.0
@@ -53,6 +60,11 @@ class Config:
     object_store_memory: int = 0
     # Seconds a get() waits between liveness re-checks of the owner.
     get_poll_interval_s: float = 0.2
+
+    # ---- streaming generators ----
+    # Max yielded-but-unconsumed items buffered at the owner before the
+    # producing worker blocks (ref: generator_backpressure_num_objects).
+    generator_backpressure_num_objects: int = 16
 
     # ---- tasks / actors ----
     default_max_retries: int = 3

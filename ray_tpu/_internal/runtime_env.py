@@ -1,0 +1,141 @@
+"""Runtime environments: per-task/actor env materialization (ref analog:
+python/ray/_private/runtime_env/plugin.py + the runtime-env agent;
+working_dir/py_modules URI packaging mirrors
+_private/runtime_env/packaging.py's content-addressed zips in GCS KV).
+
+Supported keys (anything else raises — silently dropping a
+correctness-relevant option is worse than rejecting it):
+
+* ``env_vars``:   {str: str} set in the worker before execution.
+* ``working_dir``: local directory, zipped + content-addressed into GCS
+  KV at submission; workers extract to a cache dir, chdir into it, and
+  put it on sys.path.
+* ``py_modules``: list of local module directories/files shipped the same
+  way and prepended to sys.path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
+KV_NAMESPACE = "runtime_env"
+_CACHE_ROOT = "/tmp/rayt_runtime_env"
+# skip bulky junk when zipping (ref: packaging.py excludes)
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+def validate(renv: dict) -> None:
+    if not isinstance(renv, dict):
+        raise TypeError(f"runtime_env must be a dict, got {type(renv)}")
+    unsupported = set(renv) - SUPPORTED_KEYS
+    if unsupported:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unsupported)}; "
+            f"supported: {sorted(SUPPORTED_KEYS)}")
+    env_vars = renv.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in env_vars.items()):
+            raise TypeError("runtime_env['env_vars'] must be {str: str}")
+    wd = renv.get("working_dir")
+    if wd is not None and not os.path.isdir(wd):
+        raise ValueError(f"runtime_env['working_dir'] {wd!r} is not a "
+                         "directory")
+    for m in renv.get("py_modules") or []:
+        if not os.path.exists(m):
+            raise ValueError(f"runtime_env['py_modules'] entry {m!r} does "
+                             "not exist")
+
+
+def _zip_path(path: str) -> bytes:
+    buf = io.BytesIO()
+    path = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            zf.write(path, os.path.basename(path))
+        else:
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+                for f in files:
+                    full = os.path.join(root, f)
+                    rel = os.path.relpath(full, path)
+                    zf.write(full, rel)
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES})")
+    return data
+
+
+def package(renv: dict, kv_put) -> dict:
+    """Driver side: upload working_dir/py_modules zips, return the spec
+    shipped inside TaskSpecs. `kv_put(key, value_bytes)` stores to GCS KV.
+
+    Content-addressed keys -> repeat submissions with the same code are
+    deduplicated, and workers can cache extractions forever.
+    """
+    validate(renv)
+    spec: dict = {}
+    if renv.get("env_vars"):
+        spec["env_vars"] = dict(renv["env_vars"])
+    if renv.get("working_dir"):
+        data = _zip_path(renv["working_dir"])
+        key = "wd_" + hashlib.sha256(data).hexdigest()[:32]
+        kv_put(key, data)
+        spec["working_dir"] = key
+    mods = []
+    for m in renv.get("py_modules") or []:
+        data = _zip_path(m)
+        key = "mod_" + hashlib.sha256(data).hexdigest()[:32]
+        kv_put(key, data)
+        # single .py files extract flat; packages extract into a dir named
+        # after the module so `import <name>` works
+        name = os.path.basename(os.path.abspath(m))
+        mods.append((key, name, os.path.isdir(m)))
+    if mods:
+        spec["py_modules"] = mods
+    return spec
+
+
+def _extract(key: str, data: bytes, subdir: str | None) -> str:
+    dest = os.path.join(_CACHE_ROOT, key)
+    target = os.path.join(dest, subdir) if subdir else dest
+    marker = os.path.join(dest, ".complete")
+    if not os.path.exists(marker):
+        os.makedirs(target, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(target)
+        with open(marker, "w") as f:
+            f.write("ok")
+    return dest
+
+
+def materialize(spec: dict, kv_get) -> None:
+    """Worker side: apply a packaged runtime env to this process.
+    `kv_get(key)` fetches from GCS KV."""
+    for k, v in (spec.get("env_vars") or {}).items():
+        os.environ[k] = v
+    for key, name, is_dir in spec.get("py_modules") or []:
+        data = kv_get(key)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {key} missing from GCS")
+        root = _extract(key, data, name if is_dir else None)
+        if root not in sys.path:
+            sys.path.insert(0, root)
+    wd_key = spec.get("working_dir")
+    if wd_key:
+        data = kv_get(wd_key)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {wd_key} missing")
+        root = _extract(wd_key, data, None)
+        os.chdir(root)
+        if root not in sys.path:
+            sys.path.insert(0, root)

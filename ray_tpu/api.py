@@ -45,6 +45,10 @@ class RemoteFunction:
 
     def _task_options(self) -> TaskOptions:
         o = self._opts
+        nr = o.get("num_returns", 1)
+        if nr == "streaming":
+            nr = -1  # streaming-generator sentinel (ObjectRefGenerator)
+        o = dict(o, num_returns=nr)
         return TaskOptions(
             resources=_make_resources(
                 o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
@@ -57,9 +61,11 @@ class RemoteFunction:
             runtime_env=o.get("runtime_env"))
 
     def remote(self, *args, **kwargs):
-        refs = _core_worker().submit_task(
-            self._fn, args, kwargs, self._task_options())
-        if self._task_options().num_returns == 1:
+        opts = self._task_options()
+        refs = _core_worker().submit_task(self._fn, args, kwargs, opts)
+        if opts.num_returns == -1:
+            return refs  # ObjectRefGenerator
+        if opts.num_returns == 1:
             return refs[0]
         return refs
 
@@ -91,13 +97,18 @@ class ActorMethod:
             self._max_retries if max_retries is None else max_retries)
 
     def remote(self, *args, **kwargs):
-        opts = TaskOptions(num_returns=self._num_returns,
+        nr = self._num_returns
+        if nr == "streaming":
+            nr = -1
+        opts = TaskOptions(num_returns=nr,
                            max_retries=(self._handle._max_task_retries
                                         if self._max_retries < 0
                                         else self._max_retries))
         refs = _core_worker().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs, opts)
-        if self._num_returns == 1:
+        if nr == -1:
+            return refs  # ObjectRefGenerator
+        if nr == 1:
             return refs[0]
         return refs
 

@@ -95,6 +95,9 @@ class TaskSpec:
     is_actor_creation: bool = False
     actor_options: ActorOptions | None = None
     scheduling_strategy: Any = None
+    # Packaged runtime env (see _internal/runtime_env.py), applied by the
+    # executing worker before the function/actor-ctor runs.
+    runtime_env: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -90,6 +90,8 @@ class CoreWorker:
         self._object_events: dict[ObjectID, asyncio.Event] = {}
         self.pending_tasks: dict[TaskID, _PendingTask] = {}
         self._return_to_task: dict[ObjectID, TaskID] = {}
+        # streaming-generator tasks we own (ref: generator_waiter.cc)
+        self._streams: dict[TaskID, Any] = {}
         self.reference_counter = ReferenceCounter(
             is_owner=self._owns, free_fn=self._free_object,
             notify_owner_fn=self._notify_owner_refcount)
@@ -100,6 +102,7 @@ class CoreWorker:
         self._conns: dict[str, Connection] = {}
         self._conn_locks: dict[str, asyncio.Lock] = {}
         self._node_addrs: dict[NodeID, Address] = {}
+        self._dead_nodes: set[NodeID] = set()
         self._lease_cache: dict[tuple, list] = {}
         self._actor_submitters: dict[ActorID, _ActorTaskSubmitter] = {}
         # worker-mode execution state
@@ -134,6 +137,17 @@ class CoreWorker:
             info = msg["node"]
             if msg["event"] == "added":
                 self._node_addrs[info.node_id] = info.address
+                self._dead_nodes.discard(info.node_id)
+            elif msg["event"] == "removed":
+                # Prune the dead node from location metadata so gets stop
+                # trying to pull from it; objects whose only copies lived
+                # there become candidates for lineage reconstruction (ref:
+                # object_recovery_manager.h:38).
+                self._dead_nodes.add(info.node_id)
+                self._node_addrs.pop(info.node_id, None)
+                for meta in self.object_meta.values():
+                    if info.node_id in meta.node_ids:
+                        meta.node_ids.remove(info.node_id)
 
         await self.gcs.subscribe(CH_NODE, on_node_event)
 
@@ -299,6 +313,7 @@ class CoreWorker:
 
     async def _async_get(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
+        pull_failures = 0
         while True:
             # 1. owner-local inline
             obj = self.memory_store.get_if_exists(oid)
@@ -307,9 +322,20 @@ class CoreWorker:
             meta = self.object_meta.get(oid)
             if meta is not None and meta.error is not None:
                 return (meta.error, "exc")
-            # 2. node-local shm
+            # 2. shm object we own: read locally, pull cross-node, or
+            # reconstruct via lineage (ref: object_recovery_manager.h:38)
             if meta is not None and meta.in_shm:
-                return (self.shm.read_bytes(oid, meta.size), "blob")
+                if self.shm.contains_locally(oid):
+                    return (self.shm.read_bytes(oid, meta.size), "blob")
+                if await self._pull_object(oid, meta.size, meta.node_ids,
+                                           ref.owner or self.worker_info):
+                    if self.node_id not in meta.node_ids:
+                        meta.node_ids.append(self.node_id)
+                    return (self.shm.read_bytes(oid, meta.size), "blob")
+                if self._owns(oid) and self._maybe_recover_object(oid):
+                    continue
+                raise ObjectLostError(
+                    f"{oid}: all copies lost and not reconstructable")
             if self.shm.contains_locally(oid):
                 info = await self.node_conn.call("object_lookup", oid)
                 if info is not None:
@@ -332,24 +358,72 @@ class CoreWorker:
             if kind == "shm":
                 _, size, locations = res
                 if not self.shm.contains_locally(oid):
-                    pulled = False
-                    for nid, addr in locations:
-                        if nid == self.node_id:
-                            continue
-                        ok = await self.node_conn.call(
-                            "store_remote_object",
-                            (oid, size, ref.owner, addr), timeout=300)
-                        if ok:
-                            pulled = True
-                            break
-                    if not pulled and not self.shm.contains_locally(oid):
-                        raise ObjectLostError(f"could not pull {oid}")
+                    if not await self._pull_object(
+                            oid, size, [nid for nid, _ in locations],
+                            ref.owner, addrs=dict(locations)):
+                        # a location may have died between the owner's
+                        # answer and our pull; re-ask the owner (it prunes
+                        # dead nodes and may lineage-reconstruct)
+                        pull_failures += 1
+                        if pull_failures >= 3:
+                            raise ObjectLostError(f"could not pull {oid}")
+                        await asyncio.sleep(0.1)
+                        continue
                 return (self.shm.read_bytes(oid, size), "blob")
             if kind == "pending":
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(f"get({oid}) timed out")
                 continue
             raise ObjectLostError(f"{oid}: owner reports {kind}")
+
+    async def _pull_object(self, oid: ObjectID, size: int,
+                           node_ids: list[NodeID], owner,
+                           addrs: dict | None = None) -> bool:
+        """Pull a shm object from any live holder into the local node's
+        store (ref: pull_manager.h:52 owner-directed pull)."""
+        for nid in list(node_ids):
+            if nid == self.node_id or nid in self._dead_nodes:
+                continue
+            addr = (addrs or {}).get(nid) or self._node_addrs.get(nid)
+            if addr is None:
+                continue
+            try:
+                ok = await self.node_conn.call(
+                    "store_remote_object", (oid, size, owner, addr),
+                    timeout=300)
+            except Exception:
+                ok = False
+            if ok:
+                return True
+        return self.shm.contains_locally(oid)
+
+    def _maybe_recover_object(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the task that produced `oid`
+        (ref: object_recovery_manager.h:38 + task_manager.h:212 lineage
+        resubmission). Returns True if a re-execution is (now) in flight.
+        Runs on the IO loop, so state flips are race-free."""
+        tid = self._return_to_task.get(oid)
+        if tid is None:
+            return False
+        pt = self.pending_tasks.get(tid)
+        if pt is None or pt.spec.actor_id is not None:
+            return False  # puts and actor tasks are not reconstructable
+        if not pt.done:
+            return True  # a resubmission is already in flight
+        if pt.retries_left <= 0:
+            return False
+        pt.retries_left -= 1
+        pt.done = False
+        for i in range(pt.spec.num_returns):
+            roid = ObjectID.for_return(tid, i)
+            self.object_meta.pop(roid, None)
+            self.memory_store.delete(roid)
+        for aid in pt.pinned:
+            self.reference_counter.add_task_pin(aid)
+        logger.warning("reconstructing %s by re-executing task %s",
+                       oid, pt.spec.name)
+        asyncio.ensure_future(self._run_normal_task(pt.spec))
+        return True
 
     def _poll_budget(self, deadline: float | None) -> float:
         if deadline is None:
@@ -404,7 +478,13 @@ class CoreWorker:
             if meta is not None and meta.in_shm:
                 locs = [(nid, self._node_addrs.get(nid)) for nid in meta.node_ids
                         if self._node_addrs.get(nid) is not None]
-                return ("shm", meta.size, locs)
+                if locs or self.shm.contains_locally(oid):
+                    return ("shm", meta.size, locs)
+                # every copy died with its node: reconstruct, then serve
+                # the borrower from the fresh copy (transitive recovery)
+                if self._maybe_recover_object(oid):
+                    continue
+                return ("unknown",)
             if self._is_pending(oid):
                 if time.monotonic() >= deadline:
                     return ("pending",)
@@ -460,6 +540,9 @@ class CoreWorker:
         max_retries = options.max_retries
         if max_retries < 0:
             max_retries = cfg.default_max_retries
+        if options.num_returns == -1:
+            # retrying a partially-consumed stream would replay items
+            max_retries = 0
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=options.name or getattr(function, "__name__", "task"),
@@ -469,10 +552,68 @@ class CoreWorker:
             resources=self._demand_for(options),
             owner=self.worker_info, max_retries=max_retries,
             retry_exceptions=options.retry_exceptions,
-            scheduling_strategy=options.scheduling_strategy)
+            scheduling_strategy=options.scheduling_strategy,
+            runtime_env=self._package_runtime_env(options.runtime_env))
         refs = self._register_task(spec, pinned + pinned_kw)
         self.io.spawn(self._run_normal_task(spec))
+        if spec.num_returns == -1:
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(self, spec.task_id)
         return refs
+
+    def _package_runtime_env(self, renv: dict | None) -> dict | None:
+        """Validate + upload a runtime_env at submission time (ref:
+        _private/runtime_env/packaging.py). Raises on unsupported keys —
+        never silently drops the option."""
+        if not renv:
+            return None
+        from ray_tpu._internal import runtime_env as renv_mod
+
+        def kv_put(key: str, data: bytes):
+            self.io.run(self.gcs.kv_put(
+                key, data, namespace=renv_mod.KV_NAMESPACE))
+
+        return renv_mod.package(renv, kv_put)
+
+    def _apply_runtime_env(self, spec: TaskSpec):
+        """Worker side: materialize the packaged env before execution.
+
+        Returns a restore callable. Normal tasks run on POOLED workers, so
+        the caller must revert (env vars / cwd / sys.path leak into the
+        next task otherwise); actor creation keeps the env for the actor's
+        lifetime — its worker is dedicated (ref: the reference dedicates
+        workers per runtime-env hash)."""
+        if not spec.runtime_env:
+            return None
+        import sys
+
+        from ray_tpu._internal import runtime_env as renv_mod
+
+        saved_env = {k: os.environ.get(k)
+                     for k in (spec.runtime_env.get("env_vars") or {})}
+        saved_cwd = os.getcwd()
+        saved_path = list(sys.path)
+
+        def kv_get(key: str):
+            return self.io.run(self.gcs.kv_get(
+                key, namespace=renv_mod.KV_NAMESPACE))
+
+        renv_mod.materialize(spec.runtime_env, kv_get)
+
+        def restore():
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+            sys.path[:] = saved_path
+
+        return restore
 
     def _demand_for(self, options) -> dict[str, float]:
         from ray_tpu.core.common import PlacementGroupSchedulingStrategy
@@ -513,6 +654,12 @@ class CoreWorker:
         pt = _PendingTask(spec=spec, retries_left=spec.max_retries,
                           pinned=pinned)
         self.pending_tasks[spec.task_id] = pt
+        if spec.num_returns == -1:  # streaming generator
+            from ray_tpu.core.streaming import _StreamState
+
+            self._streams[spec.task_id] = _StreamState(
+                spec.task_id, get_config().generator_backpressure_num_objects)
+            return []
         refs = []
         for i in range(spec.num_returns):
             oid = ObjectID.for_return(spec.task_id, i)
@@ -532,11 +679,24 @@ class CoreWorker:
             return winfo, token, nm_addr
         nm_addr = Address(self.node_address.host, self.node_address.port)
         allow_spill = True
-        for _hop in range(4):
-            conn = (self.node_conn if nm_addr.key() == self.node_address.key()
-                    else await self._conn_to(nm_addr))
-            res = await conn.call("request_lease", (demand, allow_spill),
-                                  timeout=_TASK_PUSH_TIMEOUT)
+        for _hop in range(8):
+            try:
+                conn = (self.node_conn
+                        if nm_addr.key() == self.node_address.key()
+                        else await self._conn_to(nm_addr))
+                res = await conn.call("request_lease", (demand, allow_spill),
+                                      timeout=_TASK_PUSH_TIMEOUT)
+            except (ConnectionLost, RpcError, OSError):
+                if nm_addr.key() == self.node_address.key():
+                    raise  # our own node manager is gone — unrecoverable
+                # spillback target died (stale cluster view); fall back to
+                # the local manager, whose view refreshes via heartbeat
+                self._conns.pop(nm_addr.key(), None)
+                nm_addr = Address(self.node_address.host,
+                                  self.node_address.port)
+                allow_spill = True
+                await asyncio.sleep(0.3)
+                continue
             if res[0] == "granted":
                 return res[1], res[2], nm_addr
             if res[0] == "spillback":
@@ -596,6 +756,13 @@ class CoreWorker:
     def _complete_task(self, spec: TaskSpec, results: list, winfo: WorkerInfo):
         pt = self.pending_tasks.get(spec.task_id)
         for i, entry in enumerate(results):
+            if entry[0] == "stream_done":
+                # all generator_item RPCs were acked before this reply was
+                # sent, so the buffer is complete — close the stream
+                stream = self._streams.get(spec.task_id)
+                if stream is not None:
+                    stream.finish(entry[1])
+                continue
             oid = ObjectID.for_return(spec.task_id, i)
             if entry[0] == "inline":
                 _, blob, is_exc = entry
@@ -618,7 +785,10 @@ class CoreWorker:
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
         pt = self.pending_tasks.get(spec.task_id)
-        for i in range(spec.num_returns):
+        stream = self._streams.get(spec.task_id)
+        if stream is not None:
+            stream.abort(error)
+        for i in range(max(spec.num_returns, 0)):
             oid = ObjectID.for_return(spec.task_id, i)
             self.memory_store.put(oid, error, is_exception=True)
             meta = self.object_meta.setdefault(oid, ObjectMeta(oid))
@@ -644,7 +814,8 @@ class CoreWorker:
             resources=self._demand_for(options),
             owner=self.worker_info, actor_id=actor_id,
             is_actor_creation=True, actor_options=options,
-            scheduling_strategy=options.scheduling_strategy)
+            scheduling_strategy=options.scheduling_strategy,
+            runtime_env=self._package_runtime_env(options.runtime_env))
         self.io.run(self.gcs.register_actor(spec))
         return actor_id
 
@@ -660,23 +831,107 @@ class CoreWorker:
         task_id = TaskID.for_actor_task(actor_id)
         spec_args, pinned = self._prepare_args(args)
         spec_kwargs, pinned_kw = self._prepare_args(kwargs)
+        max_retries = options.max_retries if options.max_retries >= 0 else 0
+        if options.num_returns == -1:
+            # retrying a partially-consumed stream would replay items
+            max_retries = 0
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=f"{method_name}", function_blob=None,
             args=spec_args, kwargs=spec_kwargs,
             num_returns=options.num_returns,
             resources={}, owner=self.worker_info,
-            max_retries=options.max_retries if options.max_retries >= 0 else 0,
+            max_retries=max_retries,
             actor_id=actor_id, method_name=method_name)
         refs = self._register_task(spec, pinned + pinned_kw)
         sub = self.get_actor_submitter(actor_id)
         self.io.spawn(sub.submit(spec))
+        if spec.num_returns == -1:
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(self, spec.task_id)
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.io.run(self.gcs.kill_actor(actor_id, no_restart))
 
+    # --------------------------------------------------- streaming (owner)
+    async def rpc_generator_item(self, conn, arg):
+        """One yielded item from a streaming task we own (ref:
+        CoreWorker::ReportGeneratorItemReturns). The ack is delayed while
+        the unconsumed buffer exceeds the backpressure threshold, which
+        blocks the producer."""
+        task_id, index, entry = arg
+        stream = self._streams.get(task_id)
+        if stream is None:
+            return False  # consumer gone; producer may stop
+        oid = ObjectID.for_return(task_id, index)
+        if entry[0] == "inline":
+            _, blob, is_exc = entry
+            try:
+                value = deserialize(blob)
+            except Exception as e:
+                value, is_exc = TaskError(e, "stream item", ""), True
+            self.memory_store.put(oid, value, is_exc)
+            self.object_meta[oid] = ObjectMeta(oid, size=len(blob),
+                                               inline=True)
+        else:  # ("shm", size, node_id)
+            _, size, node_id = entry
+            self.object_meta[oid] = ObjectMeta(
+                oid, size=size, in_shm=True, node_ids=[node_id])
+        await stream.wait_capacity()
+        if stream.dropped:
+            return False
+        stream.push(index, oid)
+        return True
+
     # ------------------------------------------------- worker-side execution
+    async def _report_stream_item(self, spec: TaskSpec, index: int, item):
+        """Serialize + push one yielded item to the owner; resolves to the
+        owner's ack (False = consumer dropped the stream)."""
+        cfg = get_config()
+        oid = ObjectID.for_return(spec.task_id, index)
+        try:
+            blob = serialize_to_bytes(item)
+        except Exception as e:
+            entry = ("inline", serialize_to_bytes(
+                TaskError(e, spec.name, traceback.format_exc())), True)
+        else:
+            if len(blob) > cfg.max_direct_call_object_size:
+                self.shm.create_from_bytes(oid, blob)
+                await self.node_conn.call(
+                    "object_created", (oid, len(blob), spec.owner))
+                entry = ("shm", len(blob), self.node_id)
+            else:
+                entry = ("inline", blob, False)
+        conn = await self._conn_to(spec.owner.address)
+        return await conn.call(
+            "generator_item", (spec.task_id, index, entry),
+            timeout=_TASK_PUSH_TIMEOUT)
+
+    def _stream_returns(self, spec: TaskSpec, gen) -> tuple:
+        """Drive a (sync) generator, pushing each item to the owner as
+        produced. Runs on an executor thread; each report blocks on the
+        owner's ack (the backpressure point)."""
+        count = 0
+        for item in gen:
+            alive = self.io.run(self._report_stream_item(spec, count, item))
+            count += 1
+            if alive is False:
+                break  # consumer dropped the stream
+        return ("ok", [("stream_done", count)])
+
+    async def _stream_returns_async(self, spec: TaskSpec, agen) -> tuple:
+        """Async-generator variant (async actors / Serve streaming)."""
+        count = 0
+        async for item in agen:
+            fut = self.io.spawn(self._report_stream_item(spec, count, item))
+            alive = await asyncio.wrap_future(fut)
+            count += 1
+            if alive is False:
+                break
+        return ("ok", [("stream_done", count)])
+
     async def rpc_push_task(self, conn, spec: TaskSpec):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
@@ -684,15 +939,24 @@ class CoreWorker:
 
     def _execute_task(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
+        restore_env = None
         try:
+            restore_env = self._apply_runtime_env(spec)
             fn = cloudpickle.loads(spec.function_blob)
             args = self._resolve_args(spec.args)
             kwargs = self._resolve_args(spec.kwargs)
             result = fn(*args, **kwargs)
+            if spec.num_returns == -1:
+                return self._stream_returns(spec, result)
             return self._package_returns(spec, result)
         except Exception as e:
             return ("task_error", serialize_to_bytes(e), traceback.format_exc())
         finally:
+            if restore_env is not None:
+                try:
+                    restore_env()
+                except Exception:
+                    pass
             self._exec_ctx.task_id = None
 
     def _resolve_args(self, args):
@@ -748,13 +1012,18 @@ class CoreWorker:
     def _instantiate_actor(self, spec: TaskSpec) -> str | None:
         self._exec_ctx.task_id = spec.task_id
         try:
+            self._apply_runtime_env(spec)
             cls = cloudpickle.loads(spec.function_blob)
             args = self._resolve_args(spec.args)
             kwargs = self._resolve_args(spec.kwargs)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = spec.actor_id
-            # async actors: methods that are coroutines run on their own loop
+            # async actors: methods that are coroutines (or async gens)
+            # run on their own loop
+            import inspect
+
             if any(asyncio.iscoroutinefunction(getattr(cls, m, None))
+                   or inspect.isasyncgenfunction(getattr(cls, m, None))
                    for m in dir(cls) if not m.startswith("__")):
                 self._actor_async_loop = EventLoopThread("rayt-actor-async")
             return None
@@ -782,9 +1051,12 @@ class CoreWorker:
             if st["next"] == spec.seq_no:
                 st["next"] = spec.seq_no + 1
                 st["cond"].notify_all()
+        import inspect
+
         loop = asyncio.get_running_loop()
         method = getattr(self.actor_instance, spec.method_name, None)
-        if asyncio.iscoroutinefunction(method):
+        if asyncio.iscoroutinefunction(method) or \
+                inspect.isasyncgenfunction(method):
             # async actor: runs concurrently on the actor's asyncio loop
             cfut = asyncio.run_coroutine_threadsafe(
                 self._run_async_method(spec), self._actor_async_loop.loop)
@@ -795,12 +1067,19 @@ class CoreWorker:
             self.executor, self._execute_actor_task, spec)
 
     async def _run_async_method(self, spec: TaskSpec):
+        import inspect
+
         self._exec_ctx.task_id = spec.task_id
         try:
             method = getattr(self.actor_instance, spec.method_name)
             args = self._resolve_args_async(spec.args)
             kwargs = self._resolve_args_async(spec.kwargs)
+            if spec.num_returns == -1 and inspect.isasyncgenfunction(method):
+                return await self._stream_returns_async(
+                    spec, method(*args, **kwargs))
             result = await method(*args, **kwargs)
+            if spec.num_returns == -1:
+                return await self._stream_returns_async(spec, result)
             return self._package_returns(spec, result)
         except Exception as e:
             return ("task_error", serialize_to_bytes(e), traceback.format_exc())
@@ -825,6 +1104,8 @@ class CoreWorker:
             args = self._resolve_args(spec.args)
             kwargs = self._resolve_args(spec.kwargs)
             result = method(*args, **kwargs)
+            if spec.num_returns == -1:
+                return self._stream_returns(spec, result)
             return self._package_returns(spec, result)
         except Exception as e:
             return ("task_error", serialize_to_bytes(e), traceback.format_exc())

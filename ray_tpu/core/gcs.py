@@ -39,7 +39,9 @@ _DEAD_WORKER_TTL_S = 600.0
 
 
 class GcsServer:
-    def __init__(self):
+    def __init__(self, persist_path: str | None = None):
+        from ray_tpu._internal.config import get_config
+
         self.server = RpcServer()
         self.kv: dict[str, dict[str, bytes]] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
@@ -60,17 +62,127 @@ class GcsServer:
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
         self._started = now()
+        # --- persistence (ref analog: redis_store_client.h — snapshot
+        # instead of Redis: tables pickle to a file, dirty-flag debounced) ---
+        self.persist_path = (persist_path if persist_path is not None
+                             else get_config().gcs_persist_path) or None
+        self._dirty = False
+        self._bg: list[asyncio.Task] = []
+        if self.persist_path:
+            self._load_snapshot()
+
+    # ------------------------------------------------------- persistence
+    def mark_dirty(self):
+        self._dirty = True
+
+    def _snapshot_state(self) -> dict:
+        return {
+            "kv": self.kv,
+            "nodes": self.nodes,
+            "node_last_heartbeat": self.node_last_heartbeat,
+            "actors": self.actors,
+            "actor_specs": self.actor_specs,
+            "named_actors": self.named_actors,
+            "jobs": self.jobs,
+            "placement_groups": self.placement_groups,
+        }
+
+    def _write_snapshot(self):
+        import pickle
+
+        # serialize on the caller (event-loop) thread — the tables are
+        # mutated by handlers on that loop, so pickling from an executor
+        # thread would race ("dict changed size during iteration")
+        data = pickle.dumps(self._snapshot_state(), protocol=4)
+        self._write_snapshot_bytes(data)
+
+    def _write_snapshot_bytes(self, data: bytes):
+        import os
+
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self.persist_path)
+
+    def _load_snapshot(self):
+        import os
+        import pickle
+
+        if not os.path.exists(self.persist_path):
+            return
+        try:
+            with open(self.persist_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:
+            logger.exception("GCS snapshot load failed; starting empty")
+            return
+        self.kv = state.get("kv", {})
+        self.nodes = state.get("nodes", {})
+        self.actors = state.get("actors", {})
+        self.actor_specs = state.get("actor_specs", {})
+        self.named_actors = state.get("named_actors", {})
+        self.jobs = state.get("jobs", {})
+        self.placement_groups = state.get("placement_groups", {})
+        # nodes must re-register (their conns died with the old process);
+        # give them a heartbeat grace window before declaring them dead
+        for nid in self.nodes:
+            self.node_last_heartbeat[nid] = now()
+        logger.info("GCS snapshot loaded: %d nodes, %d actors, %d jobs",
+                    len(self.nodes), len(self.actors), len(self.jobs))
+
+    async def _flush_loop(self):
+        import pickle
+
+        while True:
+            await asyncio.sleep(0.1)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    # pickle on the loop (consistent view), write off-loop
+                    data = pickle.dumps(self._snapshot_state(), protocol=4)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._write_snapshot_bytes, data)
+                except Exception:
+                    self._dirty = True  # don't lose the mutation
+                    logger.exception("GCS snapshot write failed")
+
+    async def _node_timeout_loop(self):
+        """Death detection by heartbeat staleness — needed after a head
+        restart, when the connection-close signal no longer exists (ref:
+        gcs_health_check_manager.h:45)."""
+        from ray_tpu._internal.config import get_config
+
+        timeout = get_config().node_death_timeout_s
+        while True:
+            await asyncio.sleep(1.0)
+            t = now()
+            for nid, info in list(self.nodes.items()):
+                if info.alive and nid not in self.node_conns and \
+                        t - self.node_last_heartbeat.get(nid, t) > timeout:
+                    await self._on_node_lost(nid)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         port = await self.server.start(host, port)
+        if self.persist_path:
+            self._bg.append(asyncio.ensure_future(self._flush_loop()))
+            self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
         logger.info("GCS listening on %s:%s", host, port)
         return port
 
     async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        if self.persist_path and self._dirty:
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
         await self.server.stop()
 
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, message: Any):
+        if channel == CH_ACTOR:
+            self.mark_dirty()  # every actor event is a table mutation
         dead = []
         for conn in self.subscribers.get(channel, ()):  # push-based pubsub
             if conn.closed:
@@ -101,6 +213,7 @@ class GcsServer:
         if not overwrite and key in table:
             return False
         table[key] = value
+        self.mark_dirty()
         return True
 
     def rpc_kv_get(self, conn, arg):
@@ -114,7 +227,10 @@ class GcsServer:
 
     def rpc_kv_del(self, conn, arg):
         ns, key = arg
-        return self.kv.get(ns, {}).pop(key, None) is not None
+        existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed:
+            self.mark_dirty()
+        return existed
 
     def rpc_kv_keys(self, conn, arg):
         ns, prefix = arg
@@ -132,6 +248,7 @@ class GcsServer:
         self.node_last_heartbeat[info.node_id] = now()
         conn.on_close.append(lambda c: asyncio.ensure_future(
             self._on_node_lost(info.node_id)))
+        self.mark_dirty()
         await self.publish(CH_NODE, {"event": "added", "node": info})
         logger.info("node %s registered (%s)", info.node_id, info.resources_total)
         return True
@@ -143,6 +260,7 @@ class GcsServer:
         info.alive = False
         self.node_conns.pop(node_id, None)
         self.node_resources_available.pop(node_id, None)
+        self.mark_dirty()
         logger.warning("node %s lost", node_id)
         await self.publish(CH_NODE, {"event": "removed", "node": info})
         # Fail over actors on this node (restart if budget remains).
@@ -185,12 +303,14 @@ class GcsServer:
         job_id, metadata = arg
         self.jobs[job_id] = {"metadata": metadata, "start_time": now(),
                              "status": "RUNNING"}
+        self.mark_dirty()
         return True
 
     def rpc_finish_job(self, conn, job_id: JobID):
         if job_id in self.jobs:
             self.jobs[job_id]["status"] = "FINISHED"
             self.jobs[job_id]["end_time"] = now()
+            self.mark_dirty()
         return True
 
     def rpc_get_all_jobs(self, conn, arg=None):
@@ -217,6 +337,7 @@ class GcsServer:
             class_name=spec.name)
         self.actors[spec.actor_id] = info
         self.actor_specs[spec.actor_id] = spec
+        self.mark_dirty()
         await self.publish(CH_ACTOR, info)
         asyncio.ensure_future(self._schedule_actor(spec.actor_id))
         return True
@@ -405,6 +526,7 @@ class GcsServer:
         placement = await self._schedule_pg(pg_id, bundles, strategy)
         if placement is None:
             return None
+        self.mark_dirty()
         self.placement_groups[pg_id] = {
             "bundles": bundles, "strategy": strategy,
             "placement": placement, "state": "CREATED",
@@ -493,6 +615,7 @@ class GcsServer:
         pg = self.placement_groups.pop(pg_id, None)
         if pg is None:
             return False
+        self.mark_dirty()
         for i, nid in enumerate(pg["placement"]):
             c = self.node_conns.get(nid)
             if c is not None:
@@ -523,33 +646,97 @@ class GcsServer:
 
 
 class GcsClient:
-    """Typed async client for the GCS (ref analog: gcs_client/ accessors)."""
+    """Typed async client for the GCS (ref analog: gcs_client/ accessors).
 
-    def __init__(self, conn: Connection):
+    Auto-reconnects when the GCS restarts (persistence-backed head): the
+    connection's close event schedules a redial loop that also replays
+    channel subscriptions, so pubsub-driven flows (actor resolution)
+    survive a head restart."""
+
+    def __init__(self, conn: Connection, address: Address | None = None):
         self.conn = conn
+        self.address = address
         self._subs: dict[str, list] = {}
+        self._closing = False
+        if address is not None:
+            conn.on_close.append(self._schedule_reconnect)
 
     @classmethod
     async def connect(cls, address: Address) -> "GcsClient":
         conn = await connect(address.host, address.port)
-        return cls(conn)
+        return cls(conn, address=address)
+
+    # ------------------------------------------------------- reconnection
+    def _schedule_reconnect(self, _conn):
+        if self._closing:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        asyncio.ensure_future(self._reconnect())
+
+    async def _reconnect(self):
+        for _ in range(120):
+            if self._closing:
+                return
+            try:
+                conn = await connect(self.address.host, self.address.port,
+                                     retries=1)
+            except Exception:
+                await asyncio.sleep(0.5)
+                continue
+            conn.on_close.append(self._schedule_reconnect)
+            self.conn = conn
+            for ch in list(self._subs):
+                def dispatch(msg, _ch=ch):
+                    for cb in self._subs.get(_ch, []):
+                        cb(msg)
+                conn.on_notify("pubsub:" + ch, dispatch)
+                try:
+                    await conn.call("subscribe", ch)
+                except Exception:
+                    pass
+            logger.info("GCS client reconnected")
+            return
+
+    async def call(self, method: str, arg: Any = None,
+                   timeout: float | None = None) -> Any:
+        """Call with one transparent retry across a GCS restart.
+
+        ONLY ConnectionLost retries: RemoteError (handler raised) and
+        timeouts may have executed the handler, and GCS mutations are not
+        idempotent (kv_put overwrite=False, register_actor)."""
+        from ray_tpu._internal.rpc import ConnectionLost
+
+        try:
+            return await self.conn.call(method, arg, timeout=timeout)
+        except ConnectionLost:
+            if self._closing or self.address is None:
+                raise
+            # wait for the background reconnect to land, then retry once
+            for _ in range(100):
+                if not self.conn.closed:
+                    break
+                await asyncio.sleep(0.1)
+            return await self.conn.call(method, arg, timeout=timeout)
 
     # KV
     async def kv_put(self, key: str, value: bytes, *, namespace: str = "default",
                      overwrite: bool = True) -> bool:
-        return await self.conn.call("kv_put", (namespace, key, value, overwrite))
+        return await self.call("kv_put", (namespace, key, value, overwrite))
 
     async def kv_get(self, key: str, *, namespace: str = "default"):
-        return await self.conn.call("kv_get", (namespace, key))
+        return await self.call("kv_get", (namespace, key))
 
     async def kv_del(self, key: str, *, namespace: str = "default") -> bool:
-        return await self.conn.call("kv_del", (namespace, key))
+        return await self.call("kv_del", (namespace, key))
 
     async def kv_keys(self, prefix: str = "", *, namespace: str = "default"):
-        return await self.conn.call("kv_keys", (namespace, prefix))
+        return await self.call("kv_keys", (namespace, prefix))
 
     async def kv_exists(self, key: str, *, namespace: str = "default") -> bool:
-        return await self.conn.call("kv_exists", (namespace, key))
+        return await self.call("kv_exists", (namespace, key))
 
     # pubsub
     async def subscribe(self, channel: str, callback):
@@ -559,33 +746,34 @@ class GcsClient:
                 for cb in self._subs.get(_ch, []):
                     cb(msg)
             self.conn.on_notify("pubsub:" + channel, dispatch)
-            await self.conn.call("subscribe", channel)
+            await self.call("subscribe", channel)
 
     async def publish(self, channel: str, message: Any):
-        await self.conn.call("publish", (channel, message))
+        await self.call("publish", (channel, message))
 
     # nodes / cluster
     async def get_all_nodes(self) -> list[NodeInfo]:
-        return await self.conn.call("get_all_nodes")
+        return await self.call("get_all_nodes")
 
     async def get_cluster_resources(self):
-        return await self.conn.call("get_cluster_resources")
+        return await self.call("get_cluster_resources")
 
     # actors
     async def register_actor(self, spec: TaskSpec):
-        return await self.conn.call("register_actor", spec)
+        return await self.call("register_actor", spec)
 
     async def actor_handle_state(self, actor_id: ActorID):
-        return await self.conn.call("actor_handle_state", actor_id)
+        return await self.call("actor_handle_state", actor_id)
 
     async def get_named_actor(self, name: str, namespace: str = ""):
-        return await self.conn.call("get_named_actor", (namespace, name))
+        return await self.call("get_named_actor", (namespace, name))
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool):
-        return await self.conn.call("kill_actor", (actor_id, no_restart))
+        return await self.call("kill_actor", (actor_id, no_restart))
 
     async def get_all_actors(self):
-        return await self.conn.call("get_all_actors")
+        return await self.call("get_all_actors")
 
     async def close(self):
+        self._closing = True  # suppress the reconnect loop
         await self.conn.close()

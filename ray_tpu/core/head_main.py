@@ -21,16 +21,21 @@ async def run(args):
     from ray_tpu.core.gcs import GcsServer
     from ray_tpu.core.node_manager import NodeManager
 
-    gcs = GcsServer()
+    gcs = GcsServer(persist_path=args.persist_path or None)
     gcs_port = await gcs.start(port=args.gcs_port)
-    resources = json.loads(args.resources)
-    nm = NodeManager(
-        node_id=NodeID.random(), resources=resources,
-        gcs_address=Address("127.0.0.1", gcs_port),
-        labels={"head": "1"})
-    addr = await nm.start()
-    print(json.dumps({"gcs_port": gcs_port, "nm_port": addr.port,
-                      "node_id": nm.node_id.hex()}), flush=True)
+    nm = None
+    if args.gcs_only:
+        print(json.dumps({"gcs_port": gcs_port, "nm_port": -1,
+                          "node_id": None}), flush=True)
+    else:
+        resources = json.loads(args.resources)
+        nm = NodeManager(
+            node_id=NodeID.random(), resources=resources,
+            gcs_address=Address("127.0.0.1", gcs_port),
+            labels={"head": "1"})
+        addr = await nm.start()
+        print(json.dumps({"gcs_port": gcs_port, "nm_port": addr.port,
+                          "node_id": nm.node_id.hex()}), flush=True)
     # SIGTERM must run the shutdown path (terminate pool workers) — the
     # default handler would kill this process and orphan every worker.
     import signal
@@ -45,7 +50,8 @@ async def run(args):
     try:
         await stop.wait()
     finally:
-        await nm.stop()
+        if nm is not None:
+            await nm.stop()
         await gcs.stop()
 
 
@@ -53,6 +59,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--gcs-port", type=int, default=0)
     p.add_argument("--resources", type=str, default="{}")
+    p.add_argument("--persist-path", type=str, default="")
+    p.add_argument("--gcs-only", action="store_true")
     args = p.parse_args()
     try:
         asyncio.run(run(args))

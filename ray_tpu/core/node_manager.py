@@ -124,8 +124,28 @@ class NodeManager:
                 self._cluster_view = await self.gcs_conn.call(
                     "get_cluster_resources")
             except Exception:
-                pass
+                if self.gcs_conn is not None and self.gcs_conn.closed \
+                        and not self._stopping:
+                    await self._reconnect_gcs()
             await asyncio.sleep(get_config().gcs_health_check_period_s)
+
+    async def _reconnect_gcs(self):
+        """The GCS died (head restart). Reconnect and re-register this
+        node so a persistence-backed head rebuilds its live view (ref:
+        python/ray/tests/test_gcs_fault_tolerance.py semantics)."""
+        try:
+            self.gcs_conn = await connect(self.gcs_address.host,
+                                          self.gcs_address.port,
+                                          handlers=self.server.handlers,
+                                          retries=2)
+            info = NodeInfo(
+                node_id=self.node_id, address=self.address,
+                resources_total=dict(self.resources_total),
+                labels=dict(self.labels))
+            await self.gcs_conn.call("register_node", info)
+            logger.info("re-registered with restarted GCS")
+        except Exception:
+            pass
 
     async def _reap_loop(self):
         """Detect worker process deaths (ref: raylet worker death watch)."""
@@ -290,6 +310,20 @@ class NodeManager:
                     return addr
         return None
 
+    async def _pick_spillback_fresh(self, demand) -> Address | None:
+        """Spillback against the heartbeat view; on a miss, refresh the view
+        once from the GCS — a just-registered node may not have reached the
+        periodic sync yet."""
+        target = self._pick_spillback(demand)
+        if target is not None:
+            return target
+        try:
+            self._cluster_view = await self.gcs_conn.call(
+                "get_cluster_resources")
+        except Exception:
+            return None
+        return self._pick_spillback(demand)
+
     # --------------------------------------------------------------- leases
     async def rpc_request_lease(self, conn, arg):
         """Grant a leased worker for `demand`, spill, or queue.
@@ -301,14 +335,14 @@ class NodeManager:
         # PG-bundle demands translate to reserved-resource keys upstream.
         if not self._can_ever_satisfy(demand):
             if allow_spill:
-                target = self._pick_spillback(demand)
+                target = await self._pick_spillback_fresh(demand)
                 if target is not None:
                     return ("spillback", target)
             return ("infeasible",
                     f"node cannot ever satisfy {demand} (total={self.resources_total})")
         if not self._try_acquire(demand):
             if allow_spill:
-                target = self._pick_spillback(demand)
+                target = await self._pick_spillback_fresh(demand)
                 if target is not None:
                     return ("spillback", target)
             fut = asyncio.get_running_loop().create_future()
@@ -435,7 +469,7 @@ class NodeManager:
         self._pg_prepared[(pg_id, bundle_index)] = dict(demand)
         return True
 
-    def rpc_pg_commit(self, conn, arg):
+    async def rpc_pg_commit(self, conn, arg):
         pg_id, bundle_index = arg
         demand = self._pg_prepared.pop((pg_id, bundle_index), None)
         if demand is None:
@@ -448,9 +482,20 @@ class NodeManager:
             self.resources_total[key] = self.resources_total.get(key, 0.0) + amt
             self.resources_available[key] = (
                 self.resources_available.get(key, 0.0) + amt)
+        await self._push_heartbeat()
         return True
 
-    def rpc_pg_return(self, conn, arg):
+    async def _push_heartbeat(self):
+        """Sync the GCS resource view immediately (instead of waiting for
+        the periodic heartbeat) so just-committed bundle resources are
+        visible to spillback/scheduling decisions made right after."""
+        try:
+            await self.gcs_conn.call(
+                "heartbeat", (self.node_id, dict(self.resources_available)))
+        except Exception:
+            pass
+
+    async def rpc_pg_return(self, conn, arg):
         pg_id, bundle_index = arg
         demand = self._pg_prepared.pop((pg_id, bundle_index), None)
         if demand is not None:
@@ -465,6 +510,7 @@ class NodeManager:
             self.resources_available.pop(key, None)
         self._release_resources(demand)
         self._maybe_grant_pending()
+        await self._push_heartbeat()
         return True
 
     # ------------------------------------------------------ object directory

@@ -90,6 +90,8 @@ PRESETS: dict[str, dict] = {
                   n_kv_heads=2, hidden_dim=128, max_seq_len=128),
     "160m": dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
                  n_kv_heads=12, hidden_dim=2048, max_seq_len=2048),
+    "410m": dict(vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
+                 n_kv_heads=16, hidden_dim=2816, max_seq_len=2048),
     "1b": dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
                n_kv_heads=8, hidden_dim=5632, max_seq_len=2048),
     "llama2-7b": dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
@@ -201,9 +203,9 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     return x
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            positions: jax.Array | None = None) -> jax.Array:
-    """tokens: [b, s] int32 -> logits [b, s, vocab] (f32).
+def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+             positions: jax.Array | None = None) -> jax.Array:
+    """tokens: [b, s] int32 -> final hidden states [b, s, d] (cfg.dtype).
 
     The layer stack is one lax.scan over stacked weights; each step is
     optionally rematerialized.
@@ -219,16 +221,32 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         step = jax.checkpoint(
             step, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     x, _ = jax.lax.scan(step, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings
-            else params["lm_head"]).astype(dt)
-    return (x @ head).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def _head_matrix(params: dict, cfg: LlamaConfig) -> jax.Array:
+    return (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            positions: jax.Array | None = None) -> jax.Array:
+    """tokens: [b, s] int32 -> logits [b, s, vocab] (f32)."""
+    x = backbone(params, tokens, cfg, positions)
+    return (x @ _head_matrix(params, cfg)).astype(jnp.float32)
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig):
-    """batch: {"tokens": [b, s], "targets": [b, s]} -> (loss, aux)."""
-    logits = forward(params, batch["tokens"], cfg)
-    loss, n_tok = softmax_cross_entropy(logits, batch["targets"])
+    """batch: {"tokens": [b, s], "targets": [b, s]} -> (loss, aux).
+
+    Uses the fused lm-head + cross entropy (ops/cross_entropy.py) so the
+    [b*s, vocab] f32 logits tensor is never materialized.
+    """
+    from ray_tpu.ops.cross_entropy import fused_lm_head_cross_entropy
+
+    x = backbone(params, batch["tokens"], cfg)
+    loss, n_tok = fused_lm_head_cross_entropy(
+        x, _head_matrix(params, cfg), batch["targets"])
     return loss, {"loss": loss, "tokens": n_tok}
 
 

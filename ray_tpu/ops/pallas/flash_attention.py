@@ -1,4 +1,4 @@
-"""Flash attention forward kernel (Pallas/TPU).
+"""Flash attention forward + backward kernels (Pallas/TPU).
 
 Blockwise online-softmax attention: O(seq) memory, causal block skipping,
 GQA via block-index mapping (no KV repeat materialization). Grid is
@@ -7,9 +7,14 @@ accumulator lives in VMEM scratch across k steps (see
 /opt/skills/guides/pallas_guide.md, double-buffering pattern — pallas
 pipelines the HBM->VMEM block copies automatically).
 
-Backward: custom VJP that recomputes attention with the XLA path —
-correct and simple; a Pallas backward kernel is a planned optimization
-(the forward is where decode/prefill serving time goes).
+Backward is the standard two-kernel flash bwd (Dao 2023): the forward
+saves only (q, k, v, out, lse); `delta = rowsum(dO * O)` is an XLA
+prologue; one kernel accumulates dQ with k innermost, a second
+accumulates dK/dV with q innermost, so no O(s^2) tensor is ever
+materialized (the previous fallback re-ran dense XLA attention).
+
+The reference framework has no attention kernels of its own (torch
+supplies them); this is TPU-native core-op territory.
 """
 
 from __future__ import annotations
@@ -22,10 +27,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# lse sentinel for fully-masked rows: exp(s - BIG) == 0 for any finite s
+_MASKED_LSE = 1e30
 _LANES = 128
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+def _interpret() -> bool:
+    """Pallas interpret mode off-TPU so CPU CI exercises the kernels."""
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------- forward
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scratch, l_scratch, acc_scratch, *,
                       scale: float, causal: bool,
                       block_q: int, block_k: int, num_k_blocks: int):
@@ -78,14 +91,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
+        m = m_scratch[:, 0:1]
         l = l_scratch[:, 0:1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0.0, m + jnp.log(l_safe), _MASKED_LSE)
+        lse_ref[0, 0] = lse
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    causal: bool, scale: float | None,
-                   block_q: int, block_k: int) -> jax.Array:
+                   block_q: int, block_k: int):
+    """Returns (out [b, sq, h, d], lse [b, h, sq])."""
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     n_rep = h // hk
@@ -105,7 +122,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -116,9 +133,16 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -127,31 +151,238 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
+        interpret=_interpret(),
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
 
 
+# -------------------------------------------------------------- backward
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scratch, *,
+                         scale: float, causal: bool,
+                         block_q: int, block_k: int, num_k_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        lse = lse_ref[0, 0]                           # [bq, 1]
+        delta = delta_ref[0, 0]                       # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, d]
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+                          scale: float, causal: bool,
+                          block_q: int, block_k: int, num_q_blocks: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        lse = lse_ref[0, 0]                           # [bq, 1]
+        delta = delta_ref[0, 0]                       # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
+                    scale: float | None, block_q: int, block_k: int):
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    n_rep = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    num_q_blocks = sq // block_q
+    num_k_blocks = sk // block_k
+
+    qt = q.transpose(0, 2, 1, 3)                      # [b, h, sq, d]
+    kt = k.transpose(0, 2, 1, 3)                      # [b, hk, sk, d]
+    vt = v.transpose(0, 2, 1, 3)
+    do_t = g.transpose(0, 2, 1, 3)                    # [b, h, sq, d]
+    # delta_i = rowsum(dO * O): cheap bandwidth-bound XLA prologue
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       out.astype(jnp.float32))[..., None]  # [b, h, sq, 1]
+
+    interp = _interpret()
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks),
+        grid=(b, h, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interp,
+    )(qt, kt, vt, do_t, lse, delta)
+
+    # dk/dv are accumulated per *query* head, then reduced over the GQA
+    # group outside the kernel (grid programs may not share an output).
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks),
+        grid=(b, h, num_k_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interp,
+    )(qt, kt, vt, do_t, lse, delta)
+
+    dq = dq.transpose(0, 2, 1, 3)
+    if n_rep > 1:
+        dk_h = dk_h.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+        dv_h = dv_h.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+    dk = dk_h.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_h.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------ public op
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
                     block_q: int = 512, block_k: int = 512):
-    return _flash_forward(q, k, v, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k)
+    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
+                            block_q=block_q, block_k=block_k)
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _flash_forward(q, k, v, causal=causal, scale=scale,
-                         block_q=block_q, block_k=block_k)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    from ray_tpu.ops.attention import xla_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal,
-                                         scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k)
 
 
 flash_attention.defvjp(_fwd, _bwd)

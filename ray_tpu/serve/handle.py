@@ -47,12 +47,78 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to receive items as the replica yields
+    them (ref: serve DeploymentResponseGenerator over ObjectRefGenerator)."""
+
+    def __init__(self, ref_gen, on_done):
+        self._gen = ref_gen
+        self._on_done = on_done
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def close(self):
+        """Cancel an abandoned stream (client disconnect): stops the
+        producing replica and releases the in-flight routing count."""
+        try:
+            self._gen.close()
+        except Exception:
+            pass
+        self._finish()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu as rt
+
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        except Exception:
+            self._finish()
+            raise
+        return rt.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        import ray_tpu as rt
+
+        loop = asyncio.get_running_loop()
+        try:
+            ref = await self._gen.__anext__()
+        except StopAsyncIteration:
+            self._finish()
+            raise
+        except Exception:
+            self._finish()
+            raise
+        return await loop.run_in_executor(None, rt.get, ref)
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
+        self.stream = stream
         self._lock = threading.Lock()
         self._table_version = -1
         self._replicas: list = []
@@ -63,11 +129,14 @@ class DeploymentHandle:
     # picklable: runtime state rebuilds lazily in the new process
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self.method_name))
+                (self.deployment_name, self.app_name, self.method_name,
+                 self.stream))
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(self.deployment_name, self.app_name,
-                                method_name or self.method_name)
+                                method_name or self.method_name,
+                                self.stream if stream is None else stream)
 
     # ------------------------------------------------------------- routing
     def _refresh(self, force: bool = False):
@@ -116,15 +185,20 @@ class DeploymentHandle:
                 b, 0) else b
 
     # ---------------------------------------------------------------- call
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         replica = self._pick_replica()
         with self._lock:
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
-        ref = replica.handle_request.remote(self.method_name, args, kwargs)
 
         def done(replica=replica):
             with self._lock:
                 n = self._inflight.get(replica, 1)
                 self._inflight[replica] = max(0, n - 1)
 
+        if self.stream:
+            ref_gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                self.method_name, args, kwargs)
+            return DeploymentResponseGenerator(ref_gen, done)
+        ref = replica.handle_request.remote(self.method_name, args, kwargs)
         return DeploymentResponse(ref, done)

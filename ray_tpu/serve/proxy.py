@@ -80,7 +80,44 @@ class ProxyActor:
                 payload = (await request.read()).decode()
         else:
             payload = dict(request.query)
+        # streaming: ?stream=1 or Accept: text/event-stream gets an SSE
+        # response fed by the replica's generator (ref: serve response
+        # streaming through the proxy)
+        wants_stream = (request.query.get("stream") == "1"
+                        or "text/event-stream" in
+                        request.headers.get("Accept", ""))
         loop = asyncio.get_running_loop()
+        if wants_stream:
+            if isinstance(payload, dict):
+                payload.pop("stream", None)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            gen = None
+            try:
+                gen = await loop.run_in_executor(
+                    None, lambda: handle.options(stream=True).remote(payload))
+                async for item in gen:
+                    await resp.write(
+                        f"data: {json.dumps(item, default=str)}\n\n".encode())
+            except (ConnectionResetError, ConnectionError):
+                pass  # client went away; gen.close() stops the replica
+            except Exception as e:
+                try:
+                    await resp.write(
+                        f"event: error\ndata: "
+                        f"{json.dumps(repr(e))}\n\n".encode())
+                except Exception:
+                    pass
+            finally:
+                if gen is not None:
+                    gen.close()
+            try:
+                await resp.write_eof()
+            except Exception:
+                pass
+            return resp
         try:
             response = await loop.run_in_executor(
                 None, lambda: handle.remote(payload).result(timeout=60))

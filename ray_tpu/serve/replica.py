@@ -71,6 +71,39 @@ class ReplicaActor:
         finally:
             self._ongoing -= 1
 
+    async def handle_request_streaming(self, method_name: str, args: tuple,
+                                       kwargs: dict):
+        """Async-generator entrypoint: the user callable may be a sync
+        generator, an async generator, or return either; every produced
+        item streams to the caller via the core streaming-return path
+        (ref: serve response streaming over ObjectRefGenerator)."""
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if method_name == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                loop = asyncio.get_running_loop()
+                sentinel = object()
+                while True:
+                    item = await loop.run_in_executor(
+                        None, next, result, sentinel)
+                    if item is sentinel:
+                        break
+                    yield item
+            else:
+                yield result
+        finally:
+            self._ongoing -= 1
+
     def get_stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total}
 

@@ -1,0 +1,73 @@
+"""GCS persistence + head restart (ref analog:
+python/ray/tests/test_gcs_fault_tolerance.py with the Redis-backed store;
+here the store is a snapshot file and the head is restarted on the same
+port — nodes re-register, clients reconnect, actor records survive)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def restartable_cluster(tmp_path):
+    cluster = Cluster(gcs_only_head=True,
+                      persist_path=str(tmp_path / "gcs.snap"))
+    cluster.add_node(num_cpus=4, labels={"head": "1"})
+    cluster.connect()
+    try:
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+def test_kv_and_actors_survive_head_restart(restartable_cluster):
+    cluster = restartable_cluster
+
+    @rt.remote(num_cpus=0, max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert rt.get(c.bump.remote(), timeout=60) == 1
+
+    # stash something in the KV through the public collective store path
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    cw.io.run(cw.gcs.kv_put("ft_key", b"ft_value"))
+    time.sleep(0.5)  # let the snapshot flush (100ms debounce)
+
+    cluster.kill_head(graceful=False)
+    cluster.restart_head()
+    time.sleep(2.0)  # node re-register + client reconnect window
+
+    # KV survived
+    assert cw.io.run(cw.gcs.kv_get("ft_key"), timeout=30) == b"ft_value"
+    # the actor's record survived and direct calls still work
+    assert rt.get(c.bump.remote(), timeout=60) == 2
+    # new work (requiring GCS scheduling) succeeds after restart
+    c2 = Counter.remote()
+    assert rt.get(c2.bump.remote(), timeout=60) == 1
+
+
+def test_node_registration_survives_restart(restartable_cluster):
+    cluster = restartable_cluster
+    cluster.kill_head(graceful=False)
+    cluster.restart_head()
+    time.sleep(2.5)
+
+    @rt.remote(num_cpus=1)
+    def ping():
+        return "ok"
+
+    assert rt.get(ping.remote(), timeout=60) == "ok"
+    view = cluster._cluster_view()
+    assert any(v.get("alive") for v in view.values())

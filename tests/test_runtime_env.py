@@ -1,0 +1,78 @@
+"""Runtime env materialization (ref analog:
+python/ray/_private/runtime_env/plugin.py + packaging.py; tests mirror
+tests/test_runtime_env_env_vars.py / test_runtime_env_working_dir.py)."""
+
+import os
+import textwrap
+
+import pytest
+
+import ray_tpu as rt
+
+
+def test_env_vars_visible_in_task(local_cluster):
+    @rt.remote(runtime_env={"env_vars": {"RAYT_TEST_FLAG": "hello42"}})
+    def read_env():
+        return os.environ.get("RAYT_TEST_FLAG")
+
+    assert rt.get(read_env.remote(), timeout=60) == "hello42"
+
+
+def test_env_vars_visible_in_actor(local_cluster):
+    @rt.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "on"}})
+    class A:
+        def read(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    a = A.remote()
+    assert rt.get(a.read.remote(), timeout=60) == "on"
+
+
+def test_py_modules_shipped(local_cluster, tmp_path):
+    pkg = tmp_path / "shipped_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 1234\n")
+    (pkg / "helper.py").write_text(textwrap.dedent("""
+        def triple(x):
+            return 3 * x
+    """))
+
+    @rt.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_module():
+        import shipped_pkg
+        from shipped_pkg.helper import triple
+
+        return shipped_pkg.MAGIC, triple(7)
+
+    assert rt.get(use_module.remote(), timeout=60) == (1234, 21)
+
+
+def test_working_dir_shipped(local_cluster, tmp_path):
+    wd = tmp_path / "wdir"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload!")
+
+    @rt.remote(runtime_env={"working_dir": str(wd)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert rt.get(read_file.remote(), timeout=60) == "payload!"
+
+
+def test_unsupported_key_raises(local_cluster):
+    @rt.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        f.remote()
+
+
+def test_bad_env_vars_type_raises(local_cluster):
+    @rt.remote(runtime_env={"env_vars": {"A": 1}})
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f.remote()
