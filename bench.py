@@ -39,21 +39,33 @@ def _peak_flops(device) -> float:
 
 
 def _probe_backend() -> str:
-    """Return the default backend, degrading to CPU if plugin init fails.
+    """Return the default backend, degrading to CPU if plugin init fails
+    OR HANGS.
 
-    A registered TPU plugin can raise (or hang) during backend setup in an
-    environment with no reachable chip; the bench must still emit its JSON
-    line (ref discipline: python/ray/_private/ray_perf.py:93 always prints).
+    A registered TPU plugin can raise — or block forever on a wedged
+    tunnel — during backend setup; the bench must still emit its JSON
+    line (ref discipline: python/ray/_private/ray_perf.py:93 always
+    prints). The probe therefore runs in a subprocess with a hard
+    timeout; only on success does this process initialize the TPU.
     """
+    import subprocess
+
     import jax
 
     try:
-        return jax.default_backend()
-    except Exception as exc:  # noqa: BLE001 - plugin init can raise anything
-        print(f"bench: backend init failed ({exc!r}); forcing CPU",
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=180)
+        backend = r.stdout.strip().splitlines()[-1] if r.stdout else ""
+    except Exception as exc:  # noqa: BLE001
+        print(f"bench: backend probe failed ({exc!r}); forcing CPU",
               file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-        return jax.default_backend()
+        backend = ""
+    if backend == "tpu":
+        return jax.default_backend()  # safe: subprocess proved it works
+    jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend()
 
 
 def _run(on_tpu: bool) -> dict:

@@ -68,6 +68,9 @@ class Config:
 
     # ---- tasks / actors ----
     default_max_retries: int = 3
+    # Max retained reconstructable-task specs (lineage) per owner; beyond
+    # this, freed objects lose reconstructability (ref: RAY_max_lineage...).
+    max_lineage_entries: int = 10000
     default_actor_max_restarts: int = 0
     actor_death_cache_size: int = 1024
 

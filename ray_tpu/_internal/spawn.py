@@ -47,8 +47,16 @@ _site_thread: threading.Thread | None = None
 
 
 def import_site_background():
-    """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path."""
+    """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path.
+
+    Skipped entirely when the process is explicitly CPU-pinned: the TPU
+    plugin isn't needed then, and importing it can block forever on an
+    unreachable TPU tunnel WHILE HOLDING the import lock — which would
+    deadlock every later `import jax` in this process."""
     global _site_thread
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return
 
     def _go():
         try:

@@ -1,0 +1,7 @@
+"""Autoscaler: slice-granular scale-up/down driven by GCS demand (ref:
+python/ray/autoscaler/ — v2 reconciler architecture, fake multi-node
+provider for tests)."""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeTpuSliceProvider, NodeProvider, NodeTypeConfig)

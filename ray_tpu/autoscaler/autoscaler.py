@@ -1,0 +1,175 @@
+"""Autoscaler v2-lite: an event-free reconciler loop (ref analogs:
+autoscaler/v2/autoscaler.py:42 `Autoscaler` + instance_manager/
+reconciler.py — read demand from the GCS, diff against launched
+instances, converge; and _private/autoscaler.py:171 for idle
+termination).
+
+Slice-granular by design: TPU demand is satisfied by whole pod slices
+(NodeTypeConfig.hosts node processes at once), and idle scale-down only
+retires a slice when EVERY host in it has been idle past the timeout —
+you cannot shrink a slice by one host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ray_tpu._internal.logging_utils import setup_logger
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeTypeConfig
+
+logger = setup_logger("autoscaler")
+
+
+class Autoscaler:
+    def __init__(self, gcs_server, provider: NodeProvider,
+                 node_types: list[NodeTypeConfig],
+                 idle_timeout_s: float = 60.0,
+                 reconcile_interval_s: float = 1.0):
+        self.gcs = gcs_server            # in-process (monitor-in-head)
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.reconcile_interval_s = reconcile_interval_s
+        self._idle_since: dict[str, float] = {}   # slice_id -> ts
+        self._task: Optional[asyncio.Task] = None
+        self.num_scale_ups = 0
+        self.num_scale_downs = 0
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+        shutdown = getattr(self.provider, "shutdown", None)
+        if shutdown is not None:
+            shutdown()
+
+    async def _loop(self):
+        while True:
+            try:
+                await self.reconcile()
+            except Exception:
+                logger.exception("reconcile failed")
+            await asyncio.sleep(self.reconcile_interval_s)
+
+    # ------------------------------------------------------------ reconcile
+    async def reconcile(self):
+        demand = self._unmet_demand()
+        if demand:
+            await self._scale_up(demand)
+        self._scale_down_idle()
+
+    def _unmet_demand(self) -> list[dict]:
+        """Bundle-shaped demands not satisfiable by current ALIVE nodes.
+
+        STRICT_PACK PGs collapse to one summed bundle (must fit on one
+        host); other strategies contribute their bundles individually.
+        Pending actors contribute their resource demand.
+        """
+        pending = self.gcs.rpc_get_pending_demand(None)
+        demands: list[dict] = []
+        for pg in pending["placement_groups"]:
+            if pg["strategy"] == "STRICT_PACK":
+                total: dict = {}
+                for b in pg["bundles"]:
+                    for r, amt in b.items():
+                        total[r] = total.get(r, 0.0) + amt
+                demands.append(total)
+            else:
+                demands.extend(dict(b) for b in pg["bundles"])
+        demands.extend(pending["actors"])
+        demands.extend(pending.get("tasks", []))
+        # filter out demands some live node could already satisfy in full
+        unmet = []
+        for d in demands:
+            if not self._fits_on_alive_node(d):
+                unmet.append(d)
+        return unmet
+
+    def _fits_on_alive_node(self, demand: dict) -> bool:
+        for nid, info in self.gcs.nodes.items():
+            if not info.alive:
+                continue
+            avail = self.gcs.node_resources_available.get(nid, {})
+            if all(avail.get(r, 0.0) >= amt for r, amt in demand.items()):
+                return True
+        return False
+
+    async def _scale_up(self, demands: list[dict]):
+        """Pick the smallest node type whose per-host resources cover each
+        demand; launch one slice per distinct uncovered demand per tick
+        (conservative — the next tick re-evaluates)."""
+        launched_types: set[str] = set()
+        for demand in demands:
+            t = self._pick_node_type(demand)
+            if t is None:
+                logger.warning("no node type covers demand %s", demand)
+                continue
+            if t.name in launched_types:
+                continue  # one slice per type per tick
+            live = sum(1 for e in self.provider.non_terminated_slices()
+                       .values() if e["node_type"] == t.name)
+            if live >= t.max_slices:
+                continue
+            launched_types.add(t.name)
+            logger.info("scaling up: slice of %s for demand %s",
+                        t.name, demand)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, self.provider.create_slice, t)
+            self.num_scale_ups += 1
+
+    def _pick_node_type(self, demand: dict) -> Optional[NodeTypeConfig]:
+        candidates = []
+        for t in self.node_types.values():
+            res = dict(t.resources_per_host)
+            res.setdefault("CPU", 1.0)
+            res[t.head_resource()] = 1.0
+            if all(res.get(r, 0.0) >= amt for r, amt in demand.items()):
+                candidates.append(t)
+        if not candidates:
+            return None
+        # smallest adequate host (by total resource volume)
+        return min(candidates,
+                   key=lambda t: sum(t.resources_per_host.values()))
+
+    def _scale_down_idle(self):
+        """Terminate slices whose EVERY host has been fully idle (all
+        resources available == total) past the idle timeout."""
+        now = time.monotonic()
+        id_to_info = {nid.hex(): info for nid, info in self.gcs.nodes.items()}
+        for slice_id, entry in list(
+                self.provider.non_terminated_slices().items()):
+            idle = True
+            for nid_hex in entry["node_ids"]:
+                info = id_to_info.get(nid_hex)
+                if info is None or not info.alive:
+                    continue  # dead host doesn't block scale-down
+                from ray_tpu._internal.ids import NodeID
+
+                avail = self.gcs.node_resources_available.get(
+                    NodeID.from_hex(nid_hex), {})
+                if any(avail.get(r, 0.0) < amt - 1e-9
+                       for r, amt in info.resources_total.items()
+                       if r != "memory"):
+                    idle = False
+                    break
+            if not idle:
+                self._idle_since.pop(slice_id, None)
+                continue
+            first = self._idle_since.setdefault(slice_id, now)
+            if now - first >= self.idle_timeout_s:
+                logger.info("scaling down idle slice %s", slice_id)
+                self._idle_since.pop(slice_id, None)
+                self.provider.terminate_slice(slice_id)
+                self.num_scale_downs += 1
+
+    def stats(self) -> dict:
+        return {
+            "slices": self.provider.non_terminated_slices(),
+            "num_scale_ups": self.num_scale_ups,
+            "num_scale_downs": self.num_scale_downs,
+        }

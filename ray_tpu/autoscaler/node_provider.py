@@ -1,0 +1,126 @@
+"""NodeProvider plugin API + the fake TPU-slice provider (ref analogs:
+python/ray/autoscaler/node_provider.py:13 — the cloud-provider plugin
+surface — and autoscaler/_private/fake_multi_node/node_provider.py, which
+"launches" nodes as local processes so autoscaling is testable without a
+cloud; the TPU slice modeling mirrors _private/accelerators/tpu.py:197
+slice-head resources + autoscaler/gcp/tpu.yaml node types).
+
+A node type describes ONE slice: `hosts` host processes, each advertising
+`resources_per_host`; host 0 of a slice additionally advertises the
+`<type>-head: 1` resource so a whole slice can be gang-targeted the way
+the reference targets `TPU-v4-16-head`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+from typing import Optional
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    name: str
+    resources_per_host: dict
+    hosts: int = 1                  # hosts per slice (slice granularity)
+    max_slices: int = 10
+
+    def head_resource(self) -> str:
+        return f"{self.name}-head"
+
+
+class NodeProvider:
+    """Provider plugin API (ref: autoscaler/node_provider.py:13).
+    Slice-granular: create/terminate whole slices, never single hosts —
+    TPU slices are all-or-nothing."""
+
+    def create_slice(self, node_type: NodeTypeConfig) -> str:
+        """Launch all hosts of one slice; returns a slice id."""
+        raise NotImplementedError
+
+    def terminate_slice(self, slice_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_slices(self) -> dict[str, dict]:
+        """slice_id -> {"node_type": name, "node_ids": [hex, ...]}"""
+        raise NotImplementedError
+
+
+class FakeTpuSliceProvider(NodeProvider):
+    """Slices are groups of local node-manager subprocesses (ref:
+    fake_multi_node/node_provider.py). Used by tests and the local
+    autoscaler harness."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._slices: dict[str, dict] = {}
+        self._counter = 0
+
+    def create_slice(self, node_type: NodeTypeConfig) -> str:
+        from ray_tpu._internal.config import get_config
+        from ray_tpu._internal.spawn import child_env, fast_python_argv
+
+        self._counter += 1
+        slice_id = f"{node_type.name}-{self._counter}"
+        procs, node_ids = [], []
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        for host_idx in range(node_type.hosts):
+            resources = dict(node_type.resources_per_host)
+            resources.setdefault("CPU", 1.0)
+            resources.setdefault("memory", float(1 << 30))
+            if host_idx == 0:
+                resources[node_type.head_resource()] = 1.0
+            labels = {"slice": slice_id, "slice_worker_index": str(host_idx),
+                      "node_type": node_type.name, "autoscaled": "1"}
+            env = child_env(pkg_root)
+            env["RAYT_CONFIG_JSON"] = get_config().to_json()
+            proc = subprocess.Popen(
+                fast_python_argv("ray_tpu.core.node_main")
+                + ["--gcs-address", self.gcs_address,
+                   "--resources", json.dumps(resources),
+                   "--labels", json.dumps(labels)],
+                stdout=subprocess.PIPE, env=env, text=True)
+            line = proc.stdout.readline()
+            if not line:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(f"slice host {host_idx} failed to boot")
+            info = json.loads(line)
+            procs.append(proc)
+            node_ids.append(info["node_id"])
+        self._slices[slice_id] = {
+            "node_type": node_type.name, "procs": procs,
+            "node_ids": node_ids,
+        }
+        return slice_id
+
+    def terminate_slice(self, slice_id: str) -> None:
+        entry = self._slices.pop(slice_id, None)
+        if entry is None:
+            return
+        for proc in entry["procs"]:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in entry["procs"]:
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+
+    def non_terminated_slices(self) -> dict[str, dict]:
+        return {sid: {"node_type": e["node_type"],
+                      "node_ids": list(e["node_ids"])}
+                for sid, e in self._slices.items()}
+
+    def shutdown(self):
+        for sid in list(self._slices):
+            self.terminate_slice(sid)

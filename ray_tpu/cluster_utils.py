@@ -40,14 +40,19 @@ class Cluster:
     def __init__(self, head_resources: dict | None = None,
                  initialize_head: bool = True,
                  gcs_only_head: bool = False,
-                 persist_path: str | None = None):
+                 persist_path: str | None = None,
+                 autoscaler_config: dict | None = None,
+                 dashboard_port: int | None = None):
         self.head_proc: subprocess.Popen | None = None
         self.gcs_port: int | None = None
+        self.dashboard_port: int | None = None
         self.head_node: NodeHandle | None = None
         self.worker_nodes: list[NodeHandle] = []
         self._connected = False
         self._gcs_only = gcs_only_head
         self._persist_path = persist_path
+        self._autoscaler_config = autoscaler_config
+        self._dashboard_port = dashboard_port
         if initialize_head:
             self._start_head(head_resources or {"CPU": 2.0})
 
@@ -67,6 +72,11 @@ class Cluster:
             argv += ["--persist-path", self._persist_path]
         if self._gcs_only:
             argv += ["--gcs-only"]
+        if self._autoscaler_config:
+            argv += ["--autoscaler-config",
+                     json.dumps(self._autoscaler_config)]
+        if self._dashboard_port is not None:
+            argv += ["--dashboard-port", str(self._dashboard_port)]
         self.head_proc = subprocess.Popen(
             argv, stdout=subprocess.PIPE, env=env, text=True)
         line = self.head_proc.stdout.readline()
@@ -74,6 +84,7 @@ class Cluster:
             raise RuntimeError("head process failed to start")
         info = json.loads(line)
         self.gcs_port = info["gcs_port"]
+        self.dashboard_port = info.get("dashboard_port", -1)
         if not self._gcs_only:
             self.head_node = NodeHandle(
                 proc=self.head_proc, node_id_hex=info["node_id"],

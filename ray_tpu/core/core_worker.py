@@ -207,11 +207,25 @@ class CoreWorker:
     def _free_object(self, oid: ObjectID):
         self.memory_store.delete(oid)
         meta = self.object_meta.pop(oid, None)
-        tid = self._return_to_task.pop(oid, None)
+        # Lineage retention (ref: task_manager.h:212 lineage pinning): the
+        # VALUE is freed, but a reconstructable task's spec is kept so a
+        # downstream task that lost its own output can transitively
+        # re-execute this producer. Bounded by max_lineage_entries.
+        tid = self._return_to_task.get(oid)
+        keep_lineage = False
         if tid is not None:
             pt = self.pending_tasks.get(tid)
-            if pt is not None and pt.done:
-                self.pending_tasks.pop(tid, None)
+            keep_lineage = (
+                pt is not None and pt.spec.actor_id is None
+                and pt.spec.max_retries > 0
+                and len(self.pending_tasks)
+                < get_config().max_lineage_entries)
+        if not keep_lineage:
+            self._return_to_task.pop(oid, None)
+            if tid is not None:
+                pt = self.pending_tasks.get(tid)
+                if pt is not None and pt.done:
+                    self.pending_tasks.pop(tid, None)
         if meta is not None and meta.in_shm:
             async def _free():
                 try:
@@ -341,6 +355,15 @@ class CoreWorker:
                 if info is not None:
                     return (self.shm.read_bytes(oid, info["size"]), "blob")
             if self._owns(oid):
+                tid = self._return_to_task.get(oid)
+                pt = self.pending_tasks.get(tid) if tid is not None else None
+                if (pt is not None and pt.done and meta is None
+                        and not self.memory_store.contains(oid)):
+                    # freed value with retained lineage: re-execute
+                    if not self._maybe_recover_object(oid):
+                        raise ObjectLostError(
+                            f"{oid}: freed and not reconstructable")
+                    continue
                 # pending task return: wait for completion signal
                 ok = await self._wait_object_event(oid, deadline)
                 if not ok:
@@ -491,6 +514,9 @@ class CoreWorker:
                 ok = await self._wait_object_event(oid, deadline)
                 if not ok:
                     return ("pending",)
+                continue
+            # freed value with retained lineage: reconstruct, then serve
+            if self._maybe_recover_object(oid):
                 continue
             return ("unknown",)
 
@@ -679,7 +705,10 @@ class CoreWorker:
             return winfo, token, nm_addr
         nm_addr = Address(self.node_address.host, self.node_address.port)
         allow_spill = True
-        for _hop in range(8):
+        infeasible_deadline: float | None = None
+        hop = 0
+        while hop < 1000:
+            hop += 1
             try:
                 conn = (self.node_conn
                         if nm_addr.key() == self.node_address.key()
@@ -703,7 +732,26 @@ class CoreWorker:
                 nm_addr = res[1]
                 allow_spill = False
                 continue
-            raise RuntimeError(f"infeasible task: {res[1]}")
+            # infeasible NOW: publish the unmet demand so an autoscaler can
+            # act on it (ref: raylets feeding resource_demands to the
+            # autoscaler), and keep retrying until lease_timeout_s —
+            # capacity may be on its way
+            if infeasible_deadline is None:
+                infeasible_deadline = (time.monotonic()
+                                       + get_config().lease_timeout_s)
+            if time.monotonic() >= infeasible_deadline:
+                raise RuntimeError(f"infeasible task: {res[1]}")
+            try:
+                autoscaler_listening = await self.gcs.call(
+                    "report_task_demand", demand)
+            except Exception:
+                autoscaler_listening = False
+            if not autoscaler_listening:
+                # nothing will ever grow the cluster — fail fast
+                raise RuntimeError(f"infeasible task: {res[1]}")
+            nm_addr = Address(self.node_address.host, self.node_address.port)
+            allow_spill = True
+            await asyncio.sleep(0.5)
         raise RuntimeError("lease spillback loop exceeded")
 
     async def _release_lease(self, winfo, token, nm_addr,
@@ -881,6 +929,9 @@ class CoreWorker:
                 oid, size=size, in_shm=True, node_ids=[node_id])
         await stream.wait_capacity()
         if stream.dropped:
+            # consumer went away while we waited: free the stored item
+            self.memory_store.delete(oid)
+            self.object_meta.pop(oid, None)
             return False
         stream.push(index, oid)
         return True

@@ -56,6 +56,9 @@ class GcsServer:
         # accumulate forever
         self._dead_actor_workers: dict[WorkerID, float] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
+        # PENDING actors whose creation is already in flight on a node —
+        # they are NOT autoscaler demand (placed, just booting)
+        self._actors_placing: set[ActorID] = set()
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
         # channel -> set of subscribed connections
@@ -75,9 +78,39 @@ class GcsServer:
     def mark_dirty(self):
         self._dirty = True
 
+    # KV values above this size snapshot as content-addressed side files
+    # (runtime_env packages reach 100MB; re-pickling them on every dirty
+    # tick would stall the event loop)
+    _BLOB_THRESHOLD = 256 * 1024
+
+    def _externalize_blob(self, value: bytes) -> tuple:
+        import hashlib
+        import os
+
+        digest = hashlib.sha256(value).hexdigest()
+        blob_dir = self.persist_path + ".blobs"
+        os.makedirs(blob_dir, exist_ok=True)
+        path = os.path.join(blob_dir, digest)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(value)
+            os.replace(tmp, path)
+        return ("__rayt_blob__", digest)
+
     def _snapshot_state(self) -> dict:
+        kv_out: dict = {}
+        for ns, table in self.kv.items():
+            out_table = {}
+            for key, value in table.items():
+                if isinstance(value, (bytes, bytearray)) and \
+                        len(value) > self._BLOB_THRESHOLD:
+                    out_table[key] = self._externalize_blob(bytes(value))
+                else:
+                    out_table[key] = value
+            kv_out[ns] = out_table
         return {
-            "kv": self.kv,
+            "kv": kv_out,
             "nodes": self.nodes,
             "node_last_heartbeat": self.node_last_heartbeat,
             "actors": self.actors,
@@ -116,7 +149,24 @@ class GcsServer:
         except Exception:
             logger.exception("GCS snapshot load failed; starting empty")
             return
-        self.kv = state.get("kv", {})
+        blob_dir = self.persist_path + ".blobs"
+        kv: dict = {}
+        for ns, table in state.get("kv", {}).items():
+            out = {}
+            for key, value in table.items():
+                if isinstance(value, tuple) and len(value) == 2 and \
+                        value[0] == "__rayt_blob__":
+                    try:
+                        with open(os.path.join(blob_dir, value[1]),
+                                  "rb") as f:
+                            out[key] = f.read()
+                    except OSError:
+                        logger.warning("missing snapshot blob for %s/%s",
+                                       ns, key)
+                else:
+                    out[key] = value
+            kv[ns] = out
+        self.kv = kv
         self.nodes = state.get("nodes", {})
         self.actors = state.get("actors", {})
         self.actor_specs = state.get("actor_specs", {})
@@ -166,6 +216,12 @@ class GcsServer:
         if self.persist_path:
             self._bg.append(asyncio.ensure_future(self._flush_loop()))
             self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
+            # actors restored mid-placement must resume scheduling — their
+            # pre-crash _schedule_actor coroutine died with the old process
+            for aid, info in self.actors.items():
+                if info.state in (ActorState.PENDING, ActorState.RESTARTING) \
+                        and aid in self.actor_specs:
+                    asyncio.ensure_future(self._schedule_actor(aid))
         logger.info("GCS listening on %s:%s", host, port)
         return port
 
@@ -183,6 +239,8 @@ class GcsServer:
     async def publish(self, channel: str, message: Any):
         if channel == CH_ACTOR:
             self.mark_dirty()  # every actor event is a table mutation
+        if channel == "metrics":
+            self._aggregate_metric(message)
         dead = []
         for conn in self.subscribers.get(channel, ()):  # push-based pubsub
             if conn.closed:
@@ -380,6 +438,7 @@ class GcsServer:
                 await asyncio.sleep(0.2)
                 continue
             conn = self.node_conns[node_id]
+            self._actors_placing.add(actor_id)
             try:
                 # Must exceed the node-side create_actor push timeout (300s,
                 # node_manager rpc_start_actor): timing out first would make
@@ -390,6 +449,8 @@ class GcsServer:
                 logger.warning("start_actor on %s failed: %s", node_id, e)
                 await asyncio.sleep(0.2)
                 continue
+            finally:
+                self._actors_placing.discard(actor_id)
             if result is None:
                 await asyncio.sleep(0.1)
                 continue
@@ -523,10 +584,22 @@ class GcsServer:
         nodes (ref: gcs_placement_group_manager + 2-phase commit on
         raylets; here prepare/commit RPCs against node managers)."""
         pg_id, bundles, strategy = arg
+        existing = self.placement_groups.get(pg_id)
+        if existing is not None and existing.get("state") == "PENDING":
+            existing["last_poll"] = now()
         placement = await self._schedule_pg(pg_id, bundles, strategy)
-        if placement is None:
-            return None
         self.mark_dirty()
+        if placement is None:
+            # record the unplaced PG: the autoscaler reads PENDING PGs as
+            # resource demand (ref: gcs_autoscaler_state_manager feeding
+            # autoscaler v2's Reconciler); the client keeps polling and a
+            # later attempt succeeds once capacity arrives
+            self.placement_groups[pg_id] = {
+                "bundles": bundles, "strategy": strategy,
+                "placement": None, "state": "PENDING",
+                "last_poll": now(),
+            }
+            return None
         self.placement_groups[pg_id] = {
             "bundles": bundles, "strategy": strategy,
             "placement": placement, "state": "CREATED",
@@ -616,7 +689,7 @@ class GcsServer:
         if pg is None:
             return False
         self.mark_dirty()
-        for i, nid in enumerate(pg["placement"]):
+        for i, nid in enumerate(pg.get("placement") or []):
             c = self.node_conns.get(nid)
             if c is not None:
                 try:
@@ -627,6 +700,80 @@ class GcsServer:
 
     def rpc_get_placement_group(self, conn, pg_id):
         return self.placement_groups.get(pg_id)
+
+    # ------------------------------------------------------------ metrics
+    def _aggregate_metric(self, msg: dict):
+        """Cluster-wide metric aggregation (ref analog:
+        _private/metrics_agent.py:483 aggregating per-node metrics for
+        Prometheus): counters accumulate, gauges last-write-wins,
+        histograms keep count+sum."""
+        if not hasattr(self, "metrics_store"):
+            self.metrics_store: dict = {}
+        try:
+            key = (msg["name"], msg["kind"],
+                   tuple(sorted((msg.get("tags") or {}).items())))
+            entry = self.metrics_store.setdefault(
+                key, {"value": 0.0, "count": 0, "sum": 0.0})
+            if msg["kind"] == "counter":
+                entry["value"] += float(msg["value"])
+            elif msg["kind"] == "gauge":
+                entry["value"] = float(msg["value"])
+            else:  # histogram observation
+                entry["count"] += 1
+                entry["sum"] += float(msg["value"])
+        except Exception:
+            pass
+
+    def rpc_metrics_snapshot(self, conn, arg=None):
+        store = getattr(self, "metrics_store", {})
+        return [
+            {"name": name, "kind": kind, "tags": dict(tags), **entry}
+            for (name, kind, tags), entry in store.items()
+        ]
+
+    def rpc_report_task_demand(self, conn, demand: dict):
+        """A driver's task found no feasible node: remember the demand
+        briefly (TTL) so the autoscaler sees it (ref: raylet
+        resource_demands in autoscaler state)."""
+        if not hasattr(self, "task_demands"):
+            self.task_demands = []
+        t = now()
+        self.task_demands = [(d, ts) for d, ts in self.task_demands
+                             if t - ts < 10.0]
+        self.task_demands.append((dict(demand), t))
+        return getattr(self, "autoscaler_active", False)
+
+    def rpc_get_pending_demand(self, conn, arg=None):
+        """Aggregate unmet resource demand for the autoscaler (ref:
+        gcs_autoscaler_state_manager): PENDING placement groups (bundle
+        lists + strategy), PENDING actors, and recently-reported
+        infeasible task demands."""
+        # prune PENDING PGs whose client stopped polling (gave up/died) —
+        # otherwise they'd read as unmet demand forever and the autoscaler
+        # would thrash launch/idle-terminate cycles
+        t = now()
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg.get("state") == "PENDING" and \
+                    t - pg.get("last_poll", t) > 15.0:
+                del self.placement_groups[pg_id]
+                self.mark_dirty()
+        pgs = [
+            {"pg_id": pg_id, "bundles": pg["bundles"],
+             "strategy": pg["strategy"]}
+            for pg_id, pg in self.placement_groups.items()
+            if pg.get("state") == "PENDING"
+        ]
+        actors = []
+        for aid, info in self.actors.items():
+            if info.state in (ActorState.PENDING, ActorState.RESTARTING) \
+                    and aid not in self._actors_placing:
+                spec = self.actor_specs.get(aid)
+                demand = dict(spec.resources) if spec is not None else {}
+                actors.append(demand or {"CPU": 1.0})
+        t = now()
+        tasks = [d for d, ts in getattr(self, "task_demands", [])
+                 if t - ts < 10.0]
+        return {"placement_groups": pgs, "actors": actors, "tasks": tasks}
 
     # ---------------------------------------------------------- debugging
     def rpc_cluster_status(self, conn, arg=None):
@@ -640,7 +787,7 @@ class GcsServer:
                 {"placement_group_id": pg_id.hex(),
                  "bundles": pg.get("bundles"),
                  "strategy": pg.get("strategy"),
-                 "nodes": [n.hex() for n in pg.get("placement", [])]}
+                 "nodes": [n.hex() for n in pg.get("placement") or []]}
                 for pg_id, pg in self.placement_groups.items()],
         }
 

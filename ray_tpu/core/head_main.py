@@ -23,10 +23,32 @@ async def run(args):
 
     gcs = GcsServer(persist_path=args.persist_path or None)
     gcs_port = await gcs.start(port=args.gcs_port)
+    dashboard = None
+    dashboard_port = -1
+    if args.dashboard_port >= 0:
+        from ray_tpu.dashboard import DashboardHead
+
+        dashboard = DashboardHead(gcs, f"127.0.0.1:{gcs_port}")
+        dashboard_port = await dashboard.start(port=args.dashboard_port)
+    autoscaler = None
+    if args.autoscaler_config:
+        from ray_tpu.autoscaler import (Autoscaler, FakeTpuSliceProvider,
+                                        NodeTypeConfig)
+
+        as_cfg = json.loads(args.autoscaler_config)
+        provider = FakeTpuSliceProvider(f"127.0.0.1:{gcs_port}")
+        types = [NodeTypeConfig(**t) for t in as_cfg["node_types"]]
+        gcs.autoscaler_active = True  # infeasible tasks wait for capacity
+        autoscaler = Autoscaler(
+            gcs, provider, types,
+            idle_timeout_s=as_cfg.get("idle_timeout_s", 60.0),
+            reconcile_interval_s=as_cfg.get("reconcile_interval_s", 1.0))
+        autoscaler.start()
     nm = None
     if args.gcs_only:
         print(json.dumps({"gcs_port": gcs_port, "nm_port": -1,
-                          "node_id": None}), flush=True)
+                          "node_id": None,
+                          "dashboard_port": dashboard_port}), flush=True)
     else:
         resources = json.loads(args.resources)
         nm = NodeManager(
@@ -35,7 +57,8 @@ async def run(args):
             labels={"head": "1"})
         addr = await nm.start()
         print(json.dumps({"gcs_port": gcs_port, "nm_port": addr.port,
-                          "node_id": nm.node_id.hex()}), flush=True)
+                          "node_id": nm.node_id.hex(),
+                          "dashboard_port": dashboard_port}), flush=True)
     # SIGTERM must run the shutdown path (terminate pool workers) — the
     # default handler would kill this process and orphan every worker.
     import signal
@@ -50,6 +73,10 @@ async def run(args):
     try:
         await stop.wait()
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if dashboard is not None:
+            await dashboard.stop()
         if nm is not None:
             await nm.stop()
         await gcs.stop()
@@ -61,6 +88,9 @@ def main():
     p.add_argument("--resources", type=str, default="{}")
     p.add_argument("--persist-path", type=str, default="")
     p.add_argument("--gcs-only", action="store_true")
+    p.add_argument("--autoscaler-config", type=str, default="")
+    # -1 = disabled, 0 = ephemeral port, >0 = fixed port
+    p.add_argument("--dashboard-port", type=int, default=-1)
     args = p.parse_args()
     try:
         asyncio.run(run(args))

@@ -93,13 +93,19 @@ class ObjectRefGenerator:
 
     def close(self):
         """Abandon the stream: the producer's next report is nacked and it
-        stops; the buffered state is released."""
-        stream = self._cw._streams.pop(self._task_id, None)
+        stops; buffered (unconsumed) items are freed from the owner's
+        stores — a disconnected consumer must not leak item values."""
+        cw = self._cw
+        stream = cw._streams.pop(self._task_id, None)
         if stream is not None:
             def _drop():
                 stream.drop()
+                for oid in stream.items.values():
+                    cw.memory_store.delete(oid)
+                    cw.object_meta.pop(oid, None)
+                stream.items.clear()
             try:
-                self._cw.io.loop.call_soon_threadsafe(_drop)
+                cw.io.loop.call_soon_threadsafe(_drop)
             except Exception:
                 pass
 

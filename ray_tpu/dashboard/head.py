@@ -1,0 +1,248 @@
+"""Dashboard head service: runs inside the head process next to the GCS
+(ref analogs: dashboard/head.py:65 aiohttp head, modules/job/
+job_manager.py:59 + job_supervisor.py subprocess-driver jobs,
+_private/metrics_agent.py:483 Prometheus text export).
+
+Endpoints:
+  GET  /metrics                 — Prometheus text format
+  GET  /api/cluster_status      — GCS cluster summary
+  GET  /api/nodes | /api/actors | /api/jobs
+  POST /api/jobs                — {"entrypoint": "...", "env": {...}}
+  GET  /api/jobs/{id}           — submission status
+  GET  /api/jobs/{id}/logs      — captured stdout+stderr
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Any, Optional
+
+
+class JobManager:
+    """Driver-script jobs: the entrypoint runs as a subprocess with
+    RAYT_ADDRESS pointing at this cluster; stdout/stderr captured to a
+    per-job log file (ref: job_manager.py:59 + JobSupervisor)."""
+
+    def __init__(self, gcs_address: str, log_dir: str = "/tmp/rayt_jobs"):
+        self.gcs_address = gcs_address
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self.jobs: dict[str, dict] = {}
+
+    def submit(self, entrypoint: str, env: Optional[dict] = None,
+               submission_id: Optional[str] = None) -> str:
+        sub_id = submission_id or f"raytjob-{uuid.uuid4().hex[:8]}"
+        if sub_id in self.jobs:
+            raise ValueError(f"submission id {sub_id!r} already exists")
+        log_path = os.path.join(self.log_dir, f"{sub_id}.log")
+        job_env = dict(os.environ)
+        job_env.update(env or {})
+        job_env["RAYT_ADDRESS"] = self.gcs_address
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=log_f, stderr=subprocess.STDOUT,
+            env=job_env)
+        self.jobs[sub_id] = {
+            "proc": proc, "log_path": log_path, "entrypoint": entrypoint,
+            "start_time": time.time(), "log_file": log_f,
+        }
+        return sub_id
+
+    def status(self, sub_id: str) -> Optional[dict]:
+        job = self.jobs.get(sub_id)
+        if job is None:
+            return None
+        rc = job["proc"].poll()
+        if rc is None:
+            status = "RUNNING"
+        elif rc == 0:
+            status = "SUCCEEDED"
+        else:
+            status = "FAILED"
+        return {"submission_id": sub_id, "status": status,
+                "entrypoint": job["entrypoint"], "returncode": rc,
+                "start_time": job["start_time"]}
+
+    def logs(self, sub_id: str) -> Optional[str]:
+        job = self.jobs.get(sub_id)
+        if job is None:
+            return None
+        try:
+            with open(job["log_path"], "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def stop_job(self, sub_id: str) -> bool:
+        job = self.jobs.get(sub_id)
+        if job is None or job["proc"].poll() is not None:
+            return False
+        job["proc"].terminate()
+        return True
+
+    def list(self) -> list[dict]:
+        return [self.status(s) for s in self.jobs]
+
+    def shutdown(self):
+        for job in self.jobs.values():
+            if job["proc"].poll() is None:
+                try:
+                    job["proc"].terminate()
+                except Exception:
+                    pass
+            try:
+                job["log_file"].close()
+            except Exception:
+                pass
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text(snapshot: list[dict]) -> str:
+    """Render the GCS metric snapshot in Prometheus exposition format."""
+    lines: list[str] = []
+    seen_types: set[str] = set()
+    for m in snapshot:
+        name = m["name"].replace(".", "_").replace("-", "_")
+        kind = {"counter": "counter", "gauge": "gauge"}.get(
+            m["kind"], "summary")
+        if name not in seen_types:
+            seen_types.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+        tags = ",".join(f'{k}="{_prom_escape(str(v))}"'
+                        for k, v in sorted(m.get("tags", {}).items()))
+        label = f"{{{tags}}}" if tags else ""
+        if m["kind"] == "histogram":
+            lines.append(f"{name}_count{label} {m['count']}")
+            lines.append(f"{name}_sum{label} {m['sum']}")
+        else:
+            lines.append(f"{name}{label} {m['value']}")
+    return "\n".join(lines) + "\n"
+
+
+class DashboardHead:
+    """aiohttp app colocated with the GCS (same process, direct table
+    access — the single-head analog of the reference's head + agents)."""
+
+    def __init__(self, gcs_server, gcs_address: str):
+        self.gcs = gcs_server
+        self.job_manager = JobManager(gcs_address)
+        self._runner = None
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/jobs", self._jobs_list)
+        app.router.add_post("/api/jobs", self._jobs_submit)
+        app.router.add_get("/api/jobs/{sub_id}", self._job_status)
+        app.router.add_get("/api/jobs/{sub_id}/logs", self._job_logs)
+        app.router.add_get("/api/jobs/{sub_id}/stop", self._job_stop)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        for s in site._server.sockets:
+            self.port = s.getsockname()[1]
+            break
+        return self.port
+
+    async def stop(self):
+        self.job_manager.shutdown()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ---------------------------------------------------------- handlers
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        snapshot = self.gcs.rpc_metrics_snapshot(None)
+        return web.Response(text=prometheus_text(snapshot),
+                            content_type="text/plain")
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            json.loads(json.dumps(self.gcs.rpc_cluster_status(None),
+                                  default=str)))
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        nodes = [
+            {"node_id": nid.hex(), "alive": info.alive,
+             "address": f"{info.address.host}:{info.address.port}",
+             "resources_total": info.resources_total,
+             "resources_available": self.gcs.node_resources_available.get(
+                 nid, {}),
+             "labels": info.labels}
+            for nid, info in self.gcs.nodes.items()
+        ]
+        return web.json_response(nodes)
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        actors = [
+            {"actor_id": aid.hex(), "state": info.state,
+             "name": info.name, "class_name": info.class_name,
+             "num_restarts": info.num_restarts}
+            for aid, info in self.gcs.actors.items()
+        ]
+        return web.json_response(actors)
+
+    async def _jobs_list(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.job_manager.list())
+
+    async def _jobs_submit(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            return web.json_response({"error": "entrypoint required"},
+                                     status=400)
+        try:
+            sub_id = self.job_manager.submit(
+                entrypoint, env=body.get("env"),
+                submission_id=body.get("submission_id"))
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"submission_id": sub_id})
+
+    async def _job_status(self, request):
+        from aiohttp import web
+
+        status = self.job_manager.status(request.match_info["sub_id"])
+        if status is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(status)
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+
+        logs = self.job_manager.logs(request.match_info["sub_id"])
+        if logs is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(text=logs, content_type="text/plain")
+
+    async def _job_stop(self, request):
+        from aiohttp import web
+
+        ok = self.job_manager.stop_job(request.match_info["sub_id"])
+        return web.json_response({"stopped": ok})

@@ -59,6 +59,24 @@ class LlamaConfig:
     # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "auto"
     seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
+    # MoE: >0 replaces every dense FFN with a mixture of this many experts
+    # (EP over the `expert` mesh axis; see ops/moe.py)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+
+    @property
+    def moe(self) -> bool:
+        return self.moe_num_experts > 0
+
+    def moe_config(self):
+        from ray_tpu.ops.moe import MoEConfig
+
+        return MoEConfig(num_experts=self.moe_num_experts,
+                         top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor,
+                         aux_loss_weight=self.moe_aux_loss_weight)
 
     @property
     def head_dim(self) -> int:
@@ -127,19 +145,31 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.normal(rng, shape, jnp.float32)
                 * (1.0 / math.sqrt(fan_in))).astype(pd)
 
-    params = {
-        "embed": dense(next(k), (cfg.vocab_size, d), d),
-        "layers": {
-            "wq": dense(next(k), (L, d, nh * hd), d),
-            "wk": dense(next(k), (L, d, nkv * hd), d),
-            "wv": dense(next(k), (L, d, nkv * hd), d),
-            "wo": dense(next(k), (L, nh * hd, d), nh * hd),
+    layers: dict = {
+        "wq": dense(next(k), (L, d, nh * hd), d),
+        "wk": dense(next(k), (L, d, nkv * hd), d),
+        "wv": dense(next(k), (L, d, nkv * hd), d),
+        "wo": dense(next(k), (L, nh * hd, d), nh * hd),
+        "attn_norm": jnp.ones((L, d), pd),
+        "mlp_norm": jnp.ones((L, d), pd),
+    }
+    if cfg.moe:
+        E = cfg.moe_num_experts
+        layers.update({
+            "router": dense(next(k), (L, d, E), d),
+            "w_gate": dense(next(k), (L, E, d, h), d),
+            "w_up": dense(next(k), (L, E, d, h), d),
+            "w_down": dense(next(k), (L, E, h, d), h),
+        })
+    else:
+        layers.update({
             "w_gate": dense(next(k), (L, d, h), d),
             "w_up": dense(next(k), (L, d, h), d),
             "w_down": dense(next(k), (L, h, d), h),
-            "attn_norm": jnp.ones((L, d), pd),
-            "mlp_norm": jnp.ones((L, d), pd),
-        },
+        })
+    params = {
+        "embed": dense(next(k), (cfg.vocab_size, d), d),
+        "layers": layers,
         "final_norm": jnp.ones((d,), pd),
     }
     if not cfg.tie_embeddings:
@@ -150,19 +180,30 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 def param_logical_axes(cfg: LlamaConfig) -> dict:
     """Same tree structure as init_params, leaves = logical-axis tuples
     consumed by parallel.mesh.shard_params."""
-    axes = {
-        "embed": ("vocab", "embed"),
-        "layers": {
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
+    layer_axes: dict = {
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "attn_norm": ("layers", None),
+        "mlp_norm": ("layers", None),
+    }
+    if cfg.moe:
+        layer_axes.update({
+            "router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layer_axes.update({
             "w_gate": ("layers", "embed", "mlp"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
-            "attn_norm": ("layers", None),
-            "mlp_norm": ("layers", None),
-        },
+        })
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layer_axes,
         "final_norm": (None,),
     }
     if not cfg.tie_embeddings:
@@ -182,7 +223,8 @@ def _attention(cfg: LlamaConfig, q, k, v):
 
 
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
-    """One transformer block. x: [b, s, d] (cfg.dtype)."""
+    """One transformer block. x: [b, s, d] (cfg.dtype).
+    Returns (x, moe_aux_loss) — aux is 0 for the dense path."""
     b, s, d = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     dt = cfg.dtype
@@ -197,15 +239,24 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     x = x + attn @ layer["wo"].astype(dt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    if cfg.moe:
+        from ray_tpu.ops.moe import moe_ffn
+
+        moe_params = {"router": layer["router"], "w_gate": layer["w_gate"],
+                      "w_up": layer["w_up"], "w_down": layer["w_down"]}
+        out, aux = moe_ffn(moe_params, h, cfg.moe_config())
+        return x + out, aux
     gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
     up = h @ layer["w_up"].astype(dt)
     x = x + (gate * up) @ layer["w_down"].astype(dt)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-             positions: jax.Array | None = None) -> jax.Array:
-    """tokens: [b, s] int32 -> final hidden states [b, s, d] (cfg.dtype).
+             positions: jax.Array | None = None,
+             with_aux: bool = False):
+    """tokens: [b, s] int32 -> final hidden states [b, s, d] (cfg.dtype),
+    or (hidden, moe_aux_loss) when with_aux.
 
     The layer stack is one lax.scan over stacked weights; each step is
     optionally rematerialized.
@@ -214,14 +265,20 @@ def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
-    def step(x, layer):
-        return _block(cfg, x, layer, cos, sin, positions), None
+    def step(carry, layer):
+        x, aux_sum = carry
+        x, aux = _block(cfg, x, layer, cos, sin, positions)
+        return (x, aux_sum + aux), None
 
     if cfg.remat:
         step = jax.checkpoint(
             step, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-    x, _ = jax.lax.scan(step, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    (x, aux_sum), _ = jax.lax.scan(
+        step, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if with_aux:
+        return x, aux_sum
+    return x
 
 
 def _head_matrix(params: dict, cfg: LlamaConfig) -> jax.Array:
@@ -244,10 +301,11 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig):
     """
     from ray_tpu.ops.cross_entropy import fused_lm_head_cross_entropy
 
-    x = backbone(params, batch["tokens"], cfg)
-    loss, n_tok = fused_lm_head_cross_entropy(
+    x, moe_aux = backbone(params, batch["tokens"], cfg, with_aux=True)
+    ce_loss, n_tok = fused_lm_head_cross_entropy(
         x, _head_matrix(params, cfg), batch["targets"])
-    return loss, {"loss": loss, "tokens": n_tok}
+    loss = ce_loss + moe_aux
+    return loss, {"loss": ce_loss, "tokens": n_tok, "moe_aux": moe_aux}
 
 
 # ----------------------------------------------------------------- decoding

@@ -8,3 +8,6 @@ from ray_tpu.rl.learner import (JaxLearner, PPOLearnerConfig,  # noqa: F401
                                 compute_gae)
 from ray_tpu.rl.module import MLPModuleConfig  # noqa: F401
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.impala import (IMPALA, AggregatorActor,  # noqa: F401
+                               IMPALAConfig, IMPALALearner)
+from ray_tpu.rl.vtrace import vtrace  # noqa: F401

@@ -94,6 +94,7 @@ class EnvRunner:
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "trunc_values": trunc_val_buf,
             "last_value": np.asarray(last_value),
+            "last_obs": np.asarray(self._obs),  # V-trace bootstrap input
             "episode_returns": completed,
         }
 

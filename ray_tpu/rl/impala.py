@@ -1,0 +1,325 @@
+"""IMPALA — async actor-learner architecture with V-trace correction.
+
+Ref analogs: rllib/algorithms/impala/impala.py:508 (algorithm),
+:860,923 (stateless AggregatorActors batching episodes for learners),
+Espeholt et al. 2018. Dataflow:
+
+  EnvRunner fleet (CPU actors, stale weights) --sample async-->
+  AggregatorActor(s) --train batches--> IMPALALearner (jitted V-trace
+  update) --weights broadcast (object store ref)--> runners
+
+The driver keeps `max_requests_in_flight` sample calls outstanding per
+runner and never blocks the learner on the slowest runner — the defining
+difference from PPO's synchronous iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rl.actor_manager import FaultTolerantActorManager
+from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.module import MLPModuleConfig
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_fragment_length: int = 64
+    num_aggregators: int = 1
+    hidden: tuple = (64, 64)
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    max_grad_norm: float = 40.0
+    # timesteps (T*B) per learner update; aggregator releases a batch
+    # once it holds at least this many
+    train_batch_size: int = 1024
+    max_requests_in_flight: int = 2
+    broadcast_interval: int = 1     # learner updates between broadcasts
+    seed: int = 0
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class AggregatorActor:
+    """Stateless-ish episode batcher (ref: impala.py:860 AggregatorActor):
+    concatenates runner sample dicts along the env axis until a train
+    batch is ready. Runs as a CPU actor so concat/copy cost stays off the
+    driver and learner."""
+
+    def __init__(self):
+        self._buf: list[dict] = []
+        self._timesteps = 0
+
+    def add(self, sample: dict, min_batch_timesteps: int) -> Optional[dict]:
+        self._buf.append(sample)
+        T, N = sample["rewards"].shape
+        self._timesteps += T * N
+        if self._timesteps < min_batch_timesteps:
+            return None
+        batch = {
+            key: np.concatenate([s[key] for s in self._buf], axis=1)
+            for key in ("obs", "actions", "logp", "rewards", "dones",
+                        "trunc_values")
+        }
+        batch["last_obs"] = np.concatenate(
+            [s["last_obs"] for s in self._buf], axis=0)
+        batch["episode_returns"] = [
+            r for s in self._buf for r in s["episode_returns"]]
+        self._buf = []
+        self._timesteps = 0
+        return batch
+
+    def ping(self) -> bool:
+        return True
+
+
+class IMPALALearner:
+    """Jitted V-trace learner (ref: impala learner w/ GPU; TPU/CPU here).
+    One update consumes one aggregated batch [T, B, ...]."""
+
+    def __init__(self, module_cfg_blob: bytes, cfg_blob: bytes,
+                 seed: int = 0):
+        from ray_tpu._internal.spawn import wait_site_ready
+
+        wait_site_ready()
+        import os
+
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # explicit CPU pin wins over a sitecustomize TPU override (an
+            # unreachable TPU plugin probe can hang indefinitely)
+            jax.config.update("jax_platforms", "cpu")
+        else:
+            try:
+                jax.devices()
+            except Exception:
+                jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl import module as rlm
+        from ray_tpu.rl.vtrace import vtrace
+
+        self.cfg: IMPALAConfig = cloudpickle.loads(cfg_blob)
+        self.module_cfg = cloudpickle.loads(module_cfg_blob)
+        self.params = rlm.init_params(self.module_cfg,
+                                      jax.random.PRNGKey(seed))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(self.cfg.max_grad_norm),
+            optax.adam(self.cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_updates = 0
+        cfg = self.cfg
+
+        def loss_fn(params, batch):
+            T, B = batch["rewards"].shape
+            obs_flat = batch["obs"].reshape(T * B, -1)
+            logits, values = rlm.forward(params, obs_flat)
+            logits = logits.reshape(T, B, -1)
+            values = values.reshape(T, B)
+            _, boot_value = rlm.forward(params, batch["last_obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(
+                batch["logp"], target_logp, batch["rewards"], values,
+                boot_value, batch["dones"], batch["trunc_values"],
+                gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
+            pg_loss = -(pg_adv * target_logp).mean()
+            vf_loss = 0.5 * ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            loss = (pg_loss + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy)
+            return loss, {"loss": loss, "pg_loss": pg_loss,
+                          "vf_loss": vf_loss, "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, new_opt = self.optimizer.update(grads, opt_state,
+                                                     params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), new_opt, aux
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, jb)
+        self.num_updates += 1
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x), self.params)
+
+    def set_weights(self, params) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, params)
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+
+class IMPALA:
+    """Algorithm driver. train() = drain completed sample futures,
+    aggregate, run learner updates on every ready batch, periodically
+    broadcast fresh weights; runners are immediately re-tasked, so
+    sampling never waits for the learner (async actor-learner)."""
+
+    def __init__(self, config: IMPALAConfig):
+        self.config = config
+        probe = make_vector_env(config.env, 1, config.seed)
+        self.module_cfg = MLPModuleConfig(
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions, hidden=tuple(config.hidden))
+        module_blob = cloudpickle.dumps(self.module_cfg)
+        cfg_blob = cloudpickle.dumps(config)
+
+        runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
+        self._runners = FaultTolerantActorManager([
+            runner_cls.remote(config.env, config.num_envs_per_runner,
+                              config.seed + i, module_blob)
+            for i in range(config.num_env_runners)])
+        agg_cls = rt.remote(num_cpus=1)(AggregatorActor)
+        self._aggregators = [agg_cls.remote()
+                             for _ in range(config.num_aggregators)]
+        learner_cls = rt.remote(num_cpus=1)(IMPALALearner)
+        self._learner = learner_cls.remote(module_blob, cfg_blob,
+                                           config.seed)
+        self._weights_ref = rt.put(
+            rt.get(self._learner.get_weights.remote(), timeout=120))
+        self._runners.foreach(
+            lambda a: a.set_weights.remote(self._weights_ref))
+        self._inflight: dict = {}   # sample ref -> runner
+        self._agg_rr = 0
+        self._updates_since_broadcast = 0
+        self._iteration = 0
+        self._recent_returns: list[float] = []
+        self._total_steps = 0
+
+    def _pump_runners(self):
+        cfg = self.config
+        counts: dict = {}
+        for ref, runner in self._inflight.items():
+            counts[id(runner)] = counts.get(id(runner), 0) + 1
+        for runner in self._runners.healthy_actors():
+            while counts.get(id(runner), 0) < cfg.max_requests_in_flight:
+                ref = runner.sample.remote(cfg.rollout_fragment_length)
+                self._inflight[ref] = runner
+                counts[id(runner)] = counts.get(id(runner), 0) + 1
+
+    def train(self) -> dict:
+        """One iteration: process sample results until at least one
+        learner update has run."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        aux_last: dict = {}
+        updates = 0
+        deadline = time.monotonic() + 120.0
+        while updates == 0 and time.monotonic() < deadline:
+            self._pump_runners()
+            if not self._inflight:
+                self._runners.probe_unhealthy()
+                if not self._runners.healthy_actors():
+                    raise RuntimeError("all env runners unhealthy")
+                continue
+            ready, _ = rt.wait(list(self._inflight),
+                               num_returns=1, timeout=10.0)
+            for ref in ready:
+                runner = self._inflight.pop(ref)
+                agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+                self._agg_rr += 1
+                try:
+                    batch = rt.get(agg.add.remote(ref, cfg.train_batch_size),
+                                   timeout=60)
+                except Exception:
+                    self._runners.probe_unhealthy()
+                    continue
+                # re-task the runner right away (async pipeline)
+                self._pump_runners()
+                if batch is None:
+                    continue
+                self._recent_returns.extend(batch.pop("episode_returns"))
+                self._recent_returns = self._recent_returns[-100:]
+                T, B = batch["rewards"].shape
+                self._total_steps += T * B
+                aux_last = rt.get(self._learner.update.remote(batch),
+                                  timeout=300)
+                updates += 1
+                self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= cfg.broadcast_interval:
+                self._broadcast_weights()
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            "num_learner_updates": updates,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{f"learner/{k}": v for k, v in aux_last.items()},
+        }
+
+    def _broadcast_weights(self):
+        self._weights_ref = rt.put(
+            rt.get(self._learner.get_weights.remote(), timeout=120))
+        self._runners.foreach(
+            lambda a: a.set_weights.remote(self._weights_ref))
+        self._updates_since_broadcast = 0
+
+    # ------------------------------------------------------- checkpointable
+    def save_to_path(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        weights = rt.get(self._learner.get_weights.remote(), timeout=120)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"weights": weights, "iteration": self._iteration,
+                         "config": self.config}, f)
+        return path
+
+    def restore_from_path(self, path: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._iteration = state["iteration"]
+        rt.get(self._learner.set_weights.remote(state["weights"]),
+               timeout=120)
+        self._broadcast_weights()
+
+    def stop(self):
+        for a in (self._runners._actors + self._aggregators
+                  + [self._learner]):
+            try:
+                rt.kill(a)
+            except Exception:
+                pass

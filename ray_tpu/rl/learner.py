@@ -62,15 +62,23 @@ class JaxLearner:
         from ray_tpu._internal.spawn import wait_site_ready
 
         wait_site_ready()  # PJRT plugin may still be registering
+        import os
+
         import cloudpickle
         import jax
 
-        try:
-            jax.devices()
-        except Exception:
-            # env points at a backend whose plugin isn't available in this
-            # worker: fall back to CPU rather than dying
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # an explicit CPU pin must win even though a sitecustomize TPU
+            # hook may have overridden jax_platforms at import time —
+            # probing an unreachable TPU plugin can hang indefinitely
             jax.config.update("jax_platforms", "cpu")
+        else:
+            try:
+                jax.devices()
+            except Exception:
+                # env points at a backend whose plugin isn't available in
+                # this worker: fall back to CPU rather than dying
+                jax.config.update("jax_platforms", "cpu")
         import jax.numpy as jnp
         import optax
 

@@ -1,0 +1,59 @@
+"""V-trace off-policy correction (Espeholt et al. 2018, IMPALA).
+
+Ref analog: rllib/algorithms/impala/* — the correction that lets a
+learner train on trajectories sampled by stale behavior policies. Pure
+jax, jit-safe (lax.scan over reversed time), used inside the IMPALA
+learner's loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace(behavior_logp: jax.Array, target_logp: jax.Array,
+           rewards: jax.Array, values: jax.Array,
+           bootstrap_value: jax.Array, dones: jax.Array,
+           trunc_values: jax.Array | None = None,
+           gamma: float = 0.99, rho_clip: float = 1.0,
+           c_clip: float = 1.0):
+    """All [T, B] except bootstrap_value [B].
+
+    `values` are the TARGET policy's value estimates for the visited
+    states; `dones` cuts bootstrapping (with `trunc_values[t]` supplying
+    V(final_obs) where the cut was a time-limit truncation, not a true
+    terminal). Returns (vs [T, B], pg_advantages [T, B]), both
+    stop-gradiented.
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho, rho_clip)
+    c_bar = jnp.minimum(rho, c_clip)
+    nonterminal = 1.0 - dones.astype(values.dtype)
+
+    # value of the successor state of step t (0 across true terminals,
+    # V(final_obs) across truncations)
+    v_next = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    v_next = v_next * nonterminal
+    if trunc_values is not None:
+        v_next = v_next + trunc_values
+    deltas = rho_bar * (rewards + gamma * v_next - values)
+
+    def step(carry, xs):
+        acc = carry  # vs_{t+1} - v_{t+1}
+        delta_t, c_t, nonterm_t = xs
+        acc = delta_t + gamma * c_t * nonterm_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(bootstrap_value),
+        (deltas, c_bar, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+
+    # pg advantage: r_t + gamma * vs_{t+1} - V(x_t), with vs_{T} bootstrap
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    vs_next = vs_next * nonterminal
+    if trunc_values is not None:
+        vs_next = vs_next + trunc_values
+    pg_adv = rho_bar * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)

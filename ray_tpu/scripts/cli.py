@@ -2,7 +2,8 @@
 util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
 
 Commands: start, stop, status, summary, list {nodes,actors,jobs,pgs,
-workers}, microbenchmark.
+workers}, microbenchmark, job {submit,status,logs,stop,list}
+(ref analog for jobs: dashboard/modules/job/cli.py).
 """
 
 from __future__ import annotations
@@ -17,6 +18,7 @@ import time
 
 PIDFILE = "/tmp/ray_tpu/head.pid"
 ADDRFILE = "/tmp/ray_tpu/head.addr"
+DASHFILE = "/tmp/ray_tpu/head.dashboard"
 
 
 def _write_state(pid: int, address: str):
@@ -25,6 +27,17 @@ def _write_state(pid: int, address: str):
         f.write(str(pid))
     with open(ADDRFILE, "w") as f:
         f.write(address)
+
+
+def _read_dashboard(args) -> str:
+    if getattr(args, "dashboard_address", None):
+        return args.dashboard_address
+    try:
+        with open(DASHFILE) as f:
+            return f.read().strip()
+    except OSError:
+        raise SystemExit("no dashboard found (start with "
+                         "`python -m ray_tpu start --head`)")
 
 
 def _read_address(args) -> str:
@@ -60,7 +73,8 @@ def cmd_start(args):
     proc = subprocess.Popen(
         fast_python_argv("ray_tpu.core.head_main")
         + ["--resources", json.dumps(resources),
-           "--gcs-port", str(args.port)],
+           "--gcs-port", str(args.port),
+           "--dashboard-port", str(args.dashboard_port)],
         stdout=subprocess.PIPE, stderr=log, env=child_env(pkg_root),
         text=True, start_new_session=True)
     log.close()
@@ -70,8 +84,15 @@ def cmd_start(args):
     info = json.loads(line)
     address = f"127.0.0.1:{info['gcs_port']}"
     _write_state(proc.pid, address)
+    dash_port = info.get("dashboard_port", -1)
+    if dash_port and dash_port > 0:
+        with open(DASHFILE, "w") as f:
+            f.write(f"127.0.0.1:{dash_port}")
     print(f"ray_tpu head started (pid {proc.pid})")
     print(f"  address: {address}")
+    if dash_port and dash_port > 0:
+        print(f"  dashboard: http://127.0.0.1:{dash_port} "
+              f"(/metrics, /api/jobs)")
     print(f"  attach:  ray_tpu.init(address='{address}')")
 
 
@@ -157,6 +178,51 @@ def cmd_microbenchmark(args):
         rt.shutdown()
 
 
+def _dash_request(args, path, data=None):
+    import urllib.request
+
+    addr = _read_dashboard(args)
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        data=json.dumps(data).encode() if data is not None else None,
+        headers={"Content-Type": "application/json"},
+        method="POST" if data is not None else "GET")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = r.read().decode()
+    return body
+
+
+def cmd_job_submit(args):
+    import shlex
+
+    parts = list(args.entrypoint)
+    if parts and parts[0] == "--":  # strip only the leading separator
+        parts = parts[1:]
+    entry = " ".join(shlex.quote(p) for p in parts)
+    if not entry:
+        raise SystemExit("usage: ray_tpu job submit -- <entrypoint...>")
+    payload = {"entrypoint": entry}
+    if args.submission_id:
+        payload["submission_id"] = args.submission_id
+    print(_dash_request(args, "/api/jobs", payload))
+
+
+def cmd_job_status(args):
+    print(_dash_request(args, f"/api/jobs/{args.submission_id}"))
+
+
+def cmd_job_logs(args):
+    print(_dash_request(args, f"/api/jobs/{args.submission_id}/logs"))
+
+
+def cmd_job_stop(args):
+    print(_dash_request(args, f"/api/jobs/{args.submission_id}/stop"))
+
+
+def cmd_job_list(args):
+    print(_dash_request(args, "/api/jobs"))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -166,7 +232,22 @@ def main(argv=None):
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--num-cpus", type=int)
     sp.add_argument("--num-tpus", type=int)
+    sp.add_argument("--dashboard-port", type=int, default=0)
     sp.set_defaults(fn=cmd_start)
+
+    jp = sub.add_parser("job", help="submit / inspect driver jobs")
+    jsub = jp.add_subparsers(dest="job_command", required=True)
+    for name, fn in (("submit", cmd_job_submit), ("status", cmd_job_status),
+                     ("logs", cmd_job_logs), ("stop", cmd_job_stop),
+                     ("list", cmd_job_list)):
+        jsp = jsub.add_parser(name)
+        jsp.add_argument("--dashboard-address")
+        if name == "submit":
+            jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+            jsp.add_argument("--submission-id")
+        elif name != "list":
+            jsp.add_argument("submission_id")
+        jsp.set_defaults(fn=fn)
 
     sp = sub.add_parser("stop", help="stop the head node")
     sp.set_defaults(fn=cmd_stop)

@@ -1,0 +1,81 @@
+"""Autoscaler: demand-driven slice scale-up + idle scale-down against the
+fake TPU-slice provider (ref analogs:
+tests/test_autoscaler_fake_multinode.py, test_autoscaler_fake_scaledown.py
+over autoscaler/_private/fake_multi_node/node_provider.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+AS_CONFIG = {
+    "node_types": [
+        {"name": "tpu-v5p-8", "resources_per_host": {"CPU": 2.0, "TPU": 4.0},
+         "hosts": 2, "max_slices": 2},
+    ],
+    "idle_timeout_s": 3.0,
+    "reconcile_interval_s": 0.5,
+}
+
+
+@pytest.fixture
+def autoscaling_cluster():
+    cluster = Cluster(head_resources={"CPU": 2.0},
+                      autoscaler_config=AS_CONFIG)
+    cluster.connect()
+    try:
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+def test_pending_pg_triggers_slice_scale_up(autoscaling_cluster):
+    """A PG needing TPU hosts (none exist yet) makes the autoscaler boot a
+    fake slice; the PG then places and gang tasks run inside it."""
+    cluster = autoscaling_cluster
+    pg = rt.placement_group([{"TPU": 4.0}, {"TPU": 4.0}],
+                            strategy="STRICT_SPREAD", timeout=90)
+
+    @rt.remote(num_cpus=0, resources={"TPU": 4.0})
+    def whoami():
+        import os
+
+        return os.environ["RAYT_NODE_ID"]
+
+    nodes = rt.get(
+        [whoami.options(scheduling_strategy=pg.bundle_strategy(i)).remote()
+         for i in range(2)], timeout=90)
+    assert len(set(nodes)) == 2  # two distinct slice hosts booted
+    rt.remove_placement_group(pg)
+
+
+def test_pending_actor_triggers_scale_up_then_idle_scale_down(
+        autoscaling_cluster):
+    cluster = autoscaling_cluster
+
+    @rt.remote(num_cpus=0, resources={"TPU": 1.0})
+    class TpuActor:
+        def ping(self):
+            return "pong"
+
+    a = TpuActor.remote()
+    assert rt.get(a.ping.remote(), timeout=90) == "pong"
+
+    view = cluster._cluster_view()
+    scaled_nodes = [k for k, v in view.items()
+                    if v.get("alive") and v["total"].get("TPU")]
+    assert scaled_nodes, "autoscaler never booted a TPU host"
+
+    # release the demand; the slice should drain away after idle_timeout
+    rt.kill(a)
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        view = cluster._cluster_view()
+        alive_tpu = [k for k, v in view.items()
+                     if v.get("alive") and v["total"].get("TPU")]
+        if not alive_tpu:
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"idle slice never scaled down: {alive_tpu}")

@@ -1,0 +1,95 @@
+"""Dashboard head: Prometheus metrics export + job submission API (ref
+analogs: dashboard/modules/job tests, metrics_agent Prometheus export)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def dash_cluster():
+    cluster = Cluster(head_resources={"CPU": 4.0}, dashboard_port=0)
+    cluster.connect()
+    assert cluster.dashboard_port and cluster.dashboard_port > 0
+    try:
+        yield cluster
+    finally:
+        cluster.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.read().decode()
+
+
+def test_metrics_prometheus_export(dash_cluster):
+    from ray_tpu.util.metrics import Counter, Gauge
+
+    c = Counter("test_requests_total", tag_keys=("route",))
+    c.inc(3.0, tags={"route": "a"})
+    c.inc(2.0, tags={"route": "a"})
+    g = Gauge("test_queue_depth")
+    g.set(7.0)
+    time.sleep(0.5)  # async publish to GCS
+
+    body = _get(dash_cluster.dashboard_port, "/metrics")
+    assert "# TYPE test_requests_total counter" in body
+    assert 'test_requests_total{route="a"} 5.0' in body
+    assert "test_queue_depth 7.0" in body
+
+
+def test_state_endpoints(dash_cluster):
+    @rt.remote(num_cpus=0)
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.remote()
+    rt.get(m.ping.remote(), timeout=30)
+
+    nodes = json.loads(_get(dash_cluster.dashboard_port, "/api/nodes"))
+    assert any(n["alive"] for n in nodes)
+    actors = json.loads(_get(dash_cluster.dashboard_port, "/api/actors"))
+    assert any(a["class_name"] == "Marker" for a in actors)
+    status = json.loads(
+        _get(dash_cluster.dashboard_port, "/api/cluster_status"))
+    assert status["num_nodes"] >= 1
+
+
+def test_job_submission_lifecycle(dash_cluster, tmp_path):
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import os\n"
+        "import ray_tpu as rt\n"
+        "rt.init(address=os.environ['RAYT_ADDRESS'])\n"
+        "@rt.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('job result:', rt.get(f.remote(21)))\n"
+        "rt.shutdown()\n")
+    port = dash_cluster.dashboard_port
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/jobs",
+        data=json.dumps(
+            {"entrypoint": f"python {script}",
+             "env": {"PYTHONPATH": "/root/repo"}}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        sub_id = json.loads(r.read())["submission_id"]
+
+    deadline = time.monotonic() + 90
+    status = None
+    while time.monotonic() < deadline:
+        status = json.loads(_get(port, f"/api/jobs/{sub_id}"))
+        if status["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.5)
+    logs = _get(port, f"/api/jobs/{sub_id}/logs")
+    assert status["status"] == "SUCCEEDED", (status, logs)
+    assert "job result: 42" in logs

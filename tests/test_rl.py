@@ -139,3 +139,69 @@ def test_ppo_checkpoint_roundtrip(local_cluster, tmp_path):
     result = algo2.train()
     assert result["training_iteration"] == it + 1
     algo2.stop()
+
+
+def test_vtrace_on_policy_reduces_to_returns():
+    """With target == behavior policy and rho/c clips inactive, vs equals
+    the discounted TD(lambda=1)-style corrected values; sanity-check the
+    recursion against a tiny hand-rolled rollout."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.vtrace import vtrace
+
+    T, B = 4, 1
+    logp = np.log(np.full((T, B), 0.5, np.float32))
+    rewards = np.ones((T, B), np.float32)
+    values = np.zeros((T, B), np.float32)
+    boot = np.zeros((B,), np.float32)
+    dones = np.zeros((T, B), np.float32)
+    vs, pg_adv = vtrace(jnp.asarray(logp), jnp.asarray(logp),
+                        jnp.asarray(rewards), jnp.asarray(values),
+                        jnp.asarray(boot), jnp.asarray(dones),
+                        jnp.zeros((T, B), jnp.float32), gamma=1.0)
+    # on-policy, V=0, gamma=1: vs_t = sum of future rewards
+    np.testing.assert_allclose(np.asarray(vs)[:, 0], [4, 3, 2, 1], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pg_adv)[:, 0], [4, 3, 2, 1],
+                               atol=1e-5)
+
+
+def test_vtrace_done_cuts_bootstrap():
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.vtrace import vtrace
+
+    T, B = 3, 1
+    logp = np.zeros((T, B), np.float32)
+    rewards = np.ones((T, B), np.float32)
+    values = np.zeros((T, B), np.float32)
+    dones = np.array([[0.0], [1.0], [0.0]], np.float32)
+    vs, _ = vtrace(jnp.asarray(logp), jnp.asarray(logp),
+                   jnp.asarray(rewards), jnp.asarray(values),
+                   jnp.asarray(np.full((B,), 100.0, np.float32)),
+                   jnp.asarray(dones), jnp.zeros((T, B), jnp.float32),
+                   gamma=1.0)
+    # episode ends at t=1: vs[0] = r0 + r1 = 2 (no leak across the cut);
+    # vs[2] bootstraps into the final value
+    np.testing.assert_allclose(np.asarray(vs)[:, 0], [2.0, 1.0, 101.0],
+                               atol=1e-5)
+
+
+def test_impala_learns_cartpole(local_cluster):
+    """Learning-curve gate (ref: rllib tuned_examples --as-test): IMPALA
+    must reach a mean return well above the random baseline (~20)."""
+    from ray_tpu.rl import IMPALA, IMPALAConfig
+
+    algo = IMPALAConfig(
+        env="CartPole-v1", num_env_runners=2, num_envs_per_runner=8,
+        rollout_fragment_length=64, train_batch_size=512, vf_coeff=0.25,
+        lr=1e-3, entropy_coeff=0.01, seed=1).build()
+    best = 0.0
+    try:
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 100.0:
+                break
+        assert best >= 100.0, f"IMPALA failed to learn: best={best}"
+    finally:
+        algo.stop()
