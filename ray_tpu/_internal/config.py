@@ -58,6 +58,15 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     # Shared-memory store capacity (bytes). 0 = auto (30% of system RAM).
     object_store_memory: int = 0
+    # Spill sealed objects to disk when the store passes this fraction of
+    # capacity (ref: local_object_manager.h:41). 0 disables spilling.
+    object_spilling_threshold: float = 0.8
+    object_spill_dir: str = "/tmp/rayt_spill"
+    # Node memory watermark: above this fraction of system RAM the memory
+    # monitor kills the newest retriable task worker (ref:
+    # memory_monitor.h + worker_killing_policy_retriable_fifo).
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
     # Seconds a get() waits between liveness re-checks of the owner.
     get_poll_interval_s: float = 0.2
 

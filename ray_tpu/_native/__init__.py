@@ -144,11 +144,13 @@ class NativeArenaStore:
         self._write_sealed(object_id, chunks, size)
         return size
 
-    def create_from_bytes(self, object_id, data: bytes) -> int:
-        self._write_sealed(object_id, [data], len(data))
+    def create_from_bytes(self, object_id, data: bytes,
+                          hold: bool = False) -> int:
+        self._write_sealed(object_id, [data], len(data), hold=hold)
         return len(data)
 
-    def _write_sealed(self, object_id, chunks, size: int):
+    def _write_sealed(self, object_id, chunks, size: int,
+                      hold: bool = False):
         off = ctypes.c_uint64()
         rc = self._lib.rayt_shm_create(self._handle, object_id.binary(),
                                        size, ctypes.byref(off))
@@ -164,7 +166,11 @@ class NativeArenaStore:
             self._mv[pos:pos + n] = bytes(c) if isinstance(c, bytes) else c
             pos += n
         self._lib.rayt_shm_seal(self._handle, object_id.binary())
-        self._lib.rayt_shm_release(self._handle, object_id.binary())
+        if not hold:
+            # with hold=True the creator keeps its create-ref so the LRU
+            # can't evict the object before the node manager pins it;
+            # the creator calls release_create_ref() afterwards
+            self._lib.rayt_shm_release(self._handle, object_id.binary())
 
     def contains_locally(self, object_id) -> bool:
         return bool(self._lib.rayt_shm_contains(self._handle,
@@ -201,6 +207,22 @@ class NativeArenaStore:
             self._held[object_id] = n - 1
             if self._held[object_id] == 0:
                 del self._held[object_id]
+        self._lib.rayt_shm_release(self._handle, object_id.binary())
+
+    def release_create_ref(self, object_id):
+        """Drop the ref held by create_from_bytes(hold=True)."""
+        self._lib.rayt_shm_release(self._handle, object_id.binary())
+
+    def pin(self, object_id) -> bool:
+        """Node-manager primary-copy pin (ref: plasma primary copies are
+        pinned by the raylet; spilling is the only reclaim path)."""
+        off = ctypes.c_uint64()
+        sz = ctypes.c_uint64()
+        return self._lib.rayt_shm_get(self._handle, object_id.binary(),
+                                      ctypes.byref(off),
+                                      ctypes.byref(sz)) == 0
+
+    def unpin(self, object_id):
         self._lib.rayt_shm_release(self._handle, object_id.binary())
 
     def unlink(self, object_id):

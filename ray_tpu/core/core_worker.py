@@ -264,6 +264,49 @@ class CoreWorker:
         oid, key = arg
         self.reference_counter.remove_borrower(oid, key)
 
+    # ------------------------------------------------- shm create helpers
+    def _shm_create_blocking(self, oid: ObjectID, blob: bytes):
+        """Create+seal holding the create-ref (so LRU can't evict before
+        the node manager pins); on arena-OOM ask the node manager to
+        spill and retry (ref: plasma create-request queue)."""
+        for _ in range(100):
+            try:
+                self.shm.create_from_bytes(oid, blob, hold=True)
+                return
+            except MemoryError:
+                try:
+                    freed = self.io.run(self.node_conn.call(
+                        "spill_now", len(blob)), timeout=60)
+                except Exception:
+                    freed = 0
+                if not freed:
+                    time.sleep(0.1)
+        raise MemoryError(
+            f"object store full: could not place {len(blob)} bytes")
+
+    async def _shm_create_async(self, oid: ObjectID, blob: bytes):
+        for _ in range(100):
+            try:
+                self.shm.create_from_bytes(oid, blob, hold=True)
+                return
+            except MemoryError:
+                try:
+                    freed = await self.node_conn.call("spill_now", len(blob))
+                except Exception:
+                    freed = 0
+                if not freed:
+                    await asyncio.sleep(0.1)
+        raise MemoryError(
+            f"object store full: could not place {len(blob)} bytes")
+
+    def _release_create_ref(self, oid: ObjectID):
+        release = getattr(self.shm, "release_create_ref", None)
+        if release is not None:
+            try:
+                release(oid)
+            except Exception:
+                pass
+
     # ---------------------------------------------------------------- put
     def put(self, value: Any) -> ObjectRef:
         with self._put_lock:
@@ -284,12 +327,19 @@ class CoreWorker:
             is_exception = True
         if blob is not None and len(blob) > cfg.max_direct_call_object_size \
                 and not is_exception:
-            self.shm.create_from_bytes(oid, blob)
+            self._shm_create_blocking(oid, blob)
             meta = ObjectMeta(oid, size=len(blob), in_shm=True,
                               node_ids=[self.node_id])
             self.object_meta[oid] = meta
-            self.io.spawn(self.node_conn.call(
-                "object_created", (oid, len(blob), self.worker_info)))
+
+            async def _announce(oid=oid, size=len(blob)):
+                try:
+                    await self.node_conn.call(
+                        "object_created", (oid, size, self.worker_info))
+                finally:
+                    self._release_create_ref(oid)
+
+            self.io.spawn(_announce())
         else:
             self.memory_store.put(oid, value, is_exception)
             self.object_meta[oid] = ObjectMeta(
@@ -405,7 +455,17 @@ class CoreWorker:
         """Pull a shm object from any live holder into the local node's
         store (ref: pull_manager.h:52 owner-directed pull)."""
         for nid in list(node_ids):
-            if nid == self.node_id or nid in self._dead_nodes:
+            if nid in self._dead_nodes:
+                continue
+            if nid == self.node_id:
+                # local but not in shm: it may have been SPILLED to disk —
+                # ask the node manager to restore it (ref: un-spill path
+                # in local_object_manager)
+                try:
+                    if await self.node_conn.call("restore_object", oid):
+                        return True
+                except Exception:
+                    pass
                 continue
             addr = (addrs or {}).get(nid) or self._node_addrs.get(nid)
             if addr is None:
@@ -949,9 +1009,12 @@ class CoreWorker:
                 TaskError(e, spec.name, traceback.format_exc())), True)
         else:
             if len(blob) > cfg.max_direct_call_object_size:
-                self.shm.create_from_bytes(oid, blob)
-                await self.node_conn.call(
-                    "object_created", (oid, len(blob), spec.owner))
+                await self._shm_create_async(oid, blob)
+                try:
+                    await self.node_conn.call(
+                        "object_created", (oid, len(blob), spec.owner))
+                finally:
+                    self._release_create_ref(oid)
                 entry = ("shm", len(blob), self.node_id)
             else:
                 entry = ("inline", blob, False)
@@ -1041,9 +1104,12 @@ class CoreWorker:
                     TaskError(e, spec.name, traceback.format_exc())), True))
                 continue
             if len(blob) > cfg.max_direct_call_object_size:
-                self.shm.create_from_bytes(oid, blob)
-                self.io.run(self.node_conn.call(
-                    "object_created", (oid, len(blob), spec.owner)))
+                self._shm_create_blocking(oid, blob)
+                try:
+                    self.io.run(self.node_conn.call(
+                        "object_created", (oid, len(blob), spec.owner)))
+                finally:
+                    self._release_create_ref(oid)
                 out.append(("shm", len(blob)))
             else:
                 out.append(("inline", blob, False))

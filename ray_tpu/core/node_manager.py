@@ -61,8 +61,14 @@ class NodeManager:
         self._unregistered: list[_Worker] = []
         self._doomed: list[_Worker] = []  # terminated, awaiting reap
         self.shm = make_shm_store(node_id)
-        # object directory: id -> {"size": int, "owner": WorkerInfo}
+        # object directory: id -> {"size": int, "owner": WorkerInfo,
+        #                          "spilled": path|None}
         self.object_dir: dict[ObjectID, dict] = {}
+        # insertion order doubles as spill order (oldest first)
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restore_count = 0
+        self._oom_kills = 0
         self._pending_leases: list[tuple[dict, asyncio.Future]] = []
         self._pg_reserved: dict[tuple, dict[str, float]] = {}
         self._pg_prepared: dict[tuple, dict[str, float]] = {}
@@ -85,6 +91,9 @@ class NodeManager:
         await self.gcs_conn.call("register_node", info)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if get_config().object_spilling_threshold > 0:
+            self._tasks.append(asyncio.ensure_future(self._spill_loop()))
+        self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         cfg = get_config()
         for _ in range(cfg.idle_worker_pool_size):
             self._spawn_worker()
@@ -513,27 +522,206 @@ class NodeManager:
         await self._push_heartbeat()
         return True
 
+    # ----------------------------------------------------- spilling / OOM
+    def _store_capacity(self) -> int:
+        cfg = get_config()
+        if cfg.object_store_memory:
+            return cfg.object_store_memory
+        cap = getattr(self.shm, "capacity", None)
+        if callable(cap):
+            try:
+                return int(cap())
+            except Exception:
+                pass
+        return 2 << 30
+
+    def _unspilled_bytes(self) -> int:
+        return sum(m["size"] for m in self.object_dir.values()
+                   if not m.get("spilled"))
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        d = os.path.join(get_config().object_spill_dir, self.node_id.hex())
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, oid.hex())
+
+    def _spill_one(self) -> bool:
+        """Spill the oldest unspilled primary to disk; returns True if one
+        was spilled."""
+        victim = next(
+            (oid for oid, m in self.object_dir.items()
+             if not m.get("spilled") and self.shm.contains_locally(oid)),
+            None)
+        if victim is None:
+            return False
+        meta = self.object_dir[victim]
+        data = self.shm.read_bytes(victim, meta["size"])
+        path = self._spill_path(victim)
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+        self.shm.unlink(victim)          # tombstone while pinned
+        if meta.pop("pinned", False):
+            self.shm.unpin(victim)       # refcount 0 -> space reclaimed
+        meta["spilled"] = path
+        self._spilled_bytes += meta["size"]
+        self._spill_count += 1
+        logger.info("spilled %s (%d bytes) to %s",
+                    victim, meta["size"], path)
+        return True
+
+    def _spill_until(self, target_unspilled: float) -> int:
+        n = 0
+        while self._unspilled_bytes() > target_unspilled:
+            if not self._spill_one():
+                break
+            n += 1
+        return n
+
+    def rpc_spill_now(self, conn, need_bytes: int):
+        """A creator hit shm OOM: synchronously free at least need_bytes
+        by spilling primaries (ref: plasma create-request queue + spill)."""
+        cap = self._store_capacity()
+        target = max(0.0, cap - float(need_bytes) * 2)
+        return self._spill_until(min(
+            target, get_config().object_spilling_threshold * cap))
+
+    async def _spill_loop(self):
+        """Move sealed shm objects to disk past the high-water mark (ref:
+        local_object_manager.h:41 spill-to-disk). Oldest-sealed first; the
+        directory keeps serving them (fetch reads the file, local access
+        restores into shm on demand)."""
+        cfg = get_config()
+        high = cfg.object_spilling_threshold * self._store_capacity()
+        while not self._stopping:
+            try:
+                self._spill_until(high)
+            except Exception:
+                logger.exception("spill loop error")
+            await asyncio.sleep(0.2)
+
+    def _restore_spilled(self, oid: ObjectID) -> bool:
+        meta = self.object_dir.get(oid)
+        if meta is None:
+            return False
+        if not meta.get("spilled"):
+            return self.shm.contains_locally(oid)
+        try:
+            with open(meta["spilled"], "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        if not self.shm.contains_locally(oid):
+            try:
+                self.shm.create_from_bytes(oid, data)
+            except MemoryError:
+                # make room by spilling other primaries, then retry
+                self._spill_until(max(
+                    0.0, self._store_capacity() - 2.0 * len(data)))
+                self.shm.create_from_bytes(oid, data)
+        try:
+            meta["pinned"] = self.shm.pin(oid)
+        except Exception:
+            meta["pinned"] = False
+        try:
+            os.remove(meta["spilled"])
+        except OSError:
+            pass
+        meta["spilled"] = None
+        self._restore_count += 1
+        return True
+
+    def rpc_restore_object(self, conn, oid: ObjectID):
+        """Local un-spill: a worker on this node wants shm access."""
+        return self._restore_spilled(oid)
+
+    async def _memory_monitor_loop(self):
+        """Node OOM guard (ref: memory_monitor.h + retriable-FIFO worker
+        killing policy): past the RAM watermark, kill the most recently
+        leased non-actor worker — its task retries elsewhere/later."""
+        cfg = get_config()
+        while not self._stopping:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            try:
+                import psutil
+
+                frac = psutil.virtual_memory().percent / 100.0
+            except Exception:
+                continue
+            if frac < cfg.memory_usage_threshold:
+                continue
+            victim = self._pick_worker_to_kill()
+            if victim is None:
+                continue
+            self._oom_kills += 1
+            logger.warning(
+                "memory pressure %.0f%% >= %.0f%%: killing worker %s "
+                "(task will retry)", frac * 100,
+                cfg.memory_usage_threshold * 100,
+                victim.info.worker_id if victim.info else "?")
+            try:
+                victim.proc.terminate()
+            except Exception:
+                pass
+
+    def _pick_worker_to_kill(self):
+        """Retriable-FIFO: newest busy non-actor worker first (ref:
+        worker_killing_policy_retriable_fifo.cc); actors only as a last
+        resort (they may not be restartable)."""
+        tasks = [w for w in self.workers.values()
+                 if w.busy and w.actor_id is None]
+        if tasks:
+            return max(tasks, key=lambda w: w.last_idle)
+        actors = [w for w in self.workers.values() if w.actor_id is not None]
+        if actors:
+            return max(actors, key=lambda w: w.last_idle)
+        return None
+
     # ------------------------------------------------------ object directory
     def rpc_object_created(self, conn, arg):
         object_id, size, owner = arg
-        self.object_dir[object_id] = {"size": size, "owner": owner}
+        # pin the primary copy: LRU eviction must not race the spill loop
+        # (ref: plasma pins primaries; spilling is the only reclaim path)
+        pinned = False
+        try:
+            pinned = self.shm.pin(object_id)
+        except Exception:
+            pass
+        self.object_dir[object_id] = {"size": size, "owner": owner,
+                                      "pinned": pinned}
         return True
 
     def rpc_object_lookup(self, conn, object_id: ObjectID):
         return self.object_dir.get(object_id)
 
     def rpc_free_object(self, conn, object_id: ObjectID):
-        self.object_dir.pop(object_id, None)
+        meta = self.object_dir.pop(object_id, None)
+        if meta is not None and meta.get("spilled"):
+            try:
+                os.remove(meta["spilled"])
+            except OSError:
+                pass
         self.shm.unlink(object_id)
+        if meta is not None and meta.get("pinned"):
+            try:
+                self.shm.unpin(object_id)
+            except Exception:
+                pass
         return True
 
     def rpc_fetch_object(self, conn, object_id: ObjectID):
         """Chunked pull entrypoint for node-to-node transfer (ref:
         push_manager.h:30 / pull_manager.h:52; single-frame for now, the
-        RPC layer already streams large frames)."""
+        RPC layer already streams large frames). Spilled objects serve
+        straight from disk — no need to round-trip through shm."""
         meta = self.object_dir.get(object_id)
         if meta is None:
             return None
+        if meta.get("spilled"):
+            try:
+                with open(meta["spilled"], "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
         return self.shm.read_bytes(object_id, meta["size"])
 
     async def rpc_store_remote_object(self, conn, arg):
@@ -548,7 +736,15 @@ class NodeManager:
             await c.close()
         if data is None:
             return False
-        self.shm.create_from_bytes(object_id, data)
+        try:
+            self.shm.create_from_bytes(object_id, data)
+        except MemoryError:
+            # make room by spilling primaries, then retry once
+            self._spill_until(max(
+                0.0, self._store_capacity() - 2.0 * len(data)))
+            self.shm.create_from_bytes(object_id, data)
+        # pulled SECONDARY copy: not pinned (evictable; the primary or its
+        # spill file elsewhere remains the durable copy)
         self.object_dir[object_id] = {"size": size, "owner": owner}
         return True
 
@@ -561,6 +757,10 @@ class NodeManager:
             "num_workers": len(self.workers),
             "num_objects": len(self.object_dir),
             "pending_leases": len(self._pending_leases),
+            "num_spilled": self._spill_count,
+            "num_restored": self._restore_count,
+            "spilled_bytes": self._spilled_bytes,
+            "oom_kills": self._oom_kills,
         }
 
 

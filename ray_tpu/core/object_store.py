@@ -107,14 +107,25 @@ class ShmObjectStore:
         self._open[object_id] = shm
         return size
 
-    def create_from_bytes(self, object_id: ObjectID, data: bytes) -> int:
-        """Seal a pre-serialized payload (used by node-to-node transfer)."""
+    def create_from_bytes(self, object_id: ObjectID, data: bytes,
+                          hold: bool = False) -> int:
+        """Seal a pre-serialized payload (used by node-to-node transfer).
+        `hold` is a no-op here: per-object segments are never evicted."""
         shm = shared_memory.SharedMemory(
             name=_shm_name(object_id), create=True, size=max(len(data), 1))
         _unregister_tracker(shm)
         shm.buf[:len(data)] = data
         self._open[object_id] = shm
         return len(data)
+
+    def release_create_ref(self, object_id: ObjectID):
+        pass
+
+    def pin(self, object_id: ObjectID) -> bool:
+        return True
+
+    def unpin(self, object_id: ObjectID):
+        pass
 
     def contains_locally(self, object_id: ObjectID) -> bool:
         if object_id in self._open:

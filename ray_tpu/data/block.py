@@ -1,8 +1,9 @@
-"""Block primitives. A Block is a row-major list of dicts; batch formats
-convert to columnar numpy / pandas on demand (ref analog:
-python/ray/data/_internal/arrow_block.py — the reference is Arrow-first;
-here rows keep the executor simple and numpy is the TPU-adjacent batch
-format fed to jax)."""
+"""Block primitives. A Block is EITHER a row-major list of dicts OR a
+columnar ``pyarrow.Table`` (ref analog:
+python/ray/data/_internal/arrow_block.py — the reference is Arrow-first).
+Arrow blocks flow zero-copy from parquet/csv into numpy batches (the
+TPU-adjacent format fed to jax); list blocks keep ad-hoc Python data
+simple. Every primitive here handles both."""
 
 from __future__ import annotations
 
@@ -10,14 +11,54 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
-Block = list  # list[dict[str, Any]] | list[Any] for simple datasets
+Block = Any  # list[dict] | list[Any] | pyarrow.Table
+
+
+def is_arrow_block(block: Block) -> bool:
+    try:
+        import pyarrow as pa
+    except Exception:
+        return False
+    return isinstance(block, pa.Table)
+
+
+def iter_rows(block: Block) -> Iterator:
+    """Row iterator over either block flavor."""
+    if is_arrow_block(block):
+        yield from block.to_pylist()
+    else:
+        yield from block
+
+
+def block_rows(block: Block) -> list:
+    """Materialize rows (list-of-dicts) from either block flavor."""
+    if is_arrow_block(block):
+        return block.to_pylist()
+    return block
 
 
 def is_record_block(block: Block) -> bool:
+    if is_arrow_block(block):
+        return True
     return bool(block) and isinstance(block[0], dict)
 
 
 def to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if is_arrow_block(block):
+        if batch_format == "pyarrow":
+            return block
+        if batch_format == "rows":
+            return block.to_pylist()
+        if batch_format == "numpy":
+            # columnar, zero-copy where dtypes allow
+            return {name: block.column(name).to_numpy(zero_copy_only=False)
+                    for name in block.column_names}
+        return block.to_pandas()
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(block if is_record_block(block)
+                                    else [{"item": v} for v in block])
     if batch_format == "rows":
         return block
     if not block:
@@ -41,6 +82,8 @@ def to_batch(block: Block, batch_format: str = "numpy") -> Any:
 def from_batch(batch: Any) -> Block:
     if batch is None:
         return []
+    if is_arrow_block(batch):
+        return batch  # arrow tables ARE blocks
     if isinstance(batch, list):
         return batch
     if isinstance(batch, dict):
@@ -63,23 +106,41 @@ def batch_iter(block: Block, batch_size: int | None) -> Iterator[Block]:
     if batch_size is None or batch_size <= 0:
         yield block
         return
+    if is_arrow_block(block):
+        for i in range(0, block.num_rows, batch_size):
+            yield block.slice(i, batch_size)  # zero-copy view
+        return
     for i in range(0, len(block), batch_size):
         yield block[i:i + batch_size]
 
 
 def split_block(block: Block, n: int) -> list[Block]:
+    length = block.num_rows if is_arrow_block(block) else len(block)
     out = []
-    size, rem = divmod(len(block), n)
+    size, rem = divmod(length, n)
     start = 0
     for i in range(n):
         end = start + size + (1 if i < rem else 0)
-        out.append(block[start:end])
+        if is_arrow_block(block):
+            out.append(block.slice(start, end - start))
+        else:
+            out.append(block[start:end])
         start = end
     return out
 
 
 def concat_blocks(blocks: Iterable[Block]) -> Block:
-    out: Block = []
+    blocks = list(blocks)
+    if any(is_arrow_block(b) for b in blocks):
+        import pyarrow as pa
+
+        tables = [b if is_arrow_block(b) else pa.Table.from_pylist(b)
+                  for b in blocks if (b.num_rows if is_arrow_block(b)
+                                      else len(b))]
+        if not tables:
+            return []
+        return pa.concat_tables(tables, promote_options="default")
+    out: list = []
     for b in blocks:
         out.extend(b)
     return out

@@ -130,10 +130,15 @@ class Dataset:
             if remaining <= 0:
                 return
             block = rt.get(ref)
-            if len(block) > remaining:
-                yield rt.put(block[:remaining])
+            from ray_tpu.data.block import is_arrow_block
+
+            n_rows = block.num_rows if is_arrow_block(block) else len(block)
+            if n_rows > remaining:
+                yield rt.put(block.slice(0, remaining)
+                             if is_arrow_block(block)
+                             else block[:remaining])
                 return
-            remaining -= len(block)
+            remaining -= n_rows
             yield ref
 
     def materialize(self) -> "Dataset":
@@ -141,15 +146,19 @@ class Dataset:
 
     # ------------------------------------------------------------- consumers
     def iter_rows(self) -> Iterator[dict]:
+        from ray_tpu.data.block import iter_rows as _block_iter_rows
+
         for ref in self._iter_block_refs():
-            yield from rt.get(ref)
+            yield from _block_iter_rows(rt.get(ref))
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator[Any]:
-        buffer: Block = []
+        from ray_tpu.data.block import block_rows
+
+        buffer: list = []
         for ref in self._iter_block_refs():
-            buffer.extend(rt.get(ref))
+            buffer.extend(block_rows(rt.get(ref)))
             while len(buffer) >= batch_size:
                 yield to_batch(buffer[:batch_size], batch_format)
                 buffer = buffer[batch_size:]
@@ -157,9 +166,11 @@ class Dataset:
             yield to_batch(buffer, batch_format)
 
     def take(self, n: int = 20) -> list:
+        from ray_tpu.data.block import block_rows
+
         out: list = []
         for ref in self._iter_block_refs():
-            out.extend(rt.get(ref))
+            out.extend(block_rows(rt.get(ref)))
             if len(out) >= n:
                 return out[:n]
         return out
@@ -168,7 +179,13 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        return sum(len(rt.get(ref)) for ref in self._iter_block_refs())
+        from ray_tpu.data.block import is_arrow_block
+
+        total = 0
+        for ref in self._iter_block_refs():
+            b = rt.get(ref)
+            total += b.num_rows if is_arrow_block(b) else len(b)
+        return total
 
     def num_blocks(self) -> int:
         return len(self._source_refs)
@@ -195,6 +212,11 @@ class Dataset:
             total += row[on]
             n += 1
         return total / n if n else float("nan")
+
+    def write_parquet(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_parquet
+
+        write_parquet(self, path)
 
     def to_pandas(self):
         import pandas as pd

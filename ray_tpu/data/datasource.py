@@ -46,10 +46,9 @@ def read_csv(paths):
     from ray_tpu.data.dataset import Dataset
 
     def read_file(path: str):
-        import csv
+        from pyarrow import csv as pa_csv
 
-        with open(path, newline="") as f:
-            return [dict(row) for row in csv.DictReader(f)]
+        return pa_csv.read_csv(path)  # arrow block (columnar)
 
     task = rt.remote(num_cpus=1)(read_file)
     return Dataset([task.remote(p) for p in _expand(paths)])
@@ -61,8 +60,9 @@ def read_parquet(paths, *, columns: Optional[list[str]] = None):
     def read_file(path: str, columns):
         import pyarrow.parquet as pq
 
-        table = pq.read_table(path, columns=columns)
-        return table.to_pylist()
+        # arrow table IS the block: stays columnar through the pipeline,
+        # zero-copy into numpy batches for train ingest
+        return pq.read_table(path, columns=columns)
 
     task = rt.remote(num_cpus=1)(read_file)
     return Dataset([task.remote(p, columns) for p in _expand(paths)])
@@ -89,10 +89,18 @@ def write_parquet(dataset, path: str) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from ray_tpu.data.block import is_arrow_block
+
     os.makedirs(path, exist_ok=True)
     for i, ref in enumerate(dataset._iter_block_refs()):
         block = rt.get(ref)
-        if not block:
+        if is_arrow_block(block):
+            if block.num_rows == 0:
+                continue
+            table = block
+        elif block:
+            table = pa.Table.from_pylist(block)
+        else:
             continue
-        pq.write_table(pa.Table.from_pylist(block),
+        pq.write_table(table,
                        os.path.join(path, f"part-{i:05d}.parquet"))

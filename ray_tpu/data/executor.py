@@ -17,8 +17,9 @@ import random
 from typing import Any, Callable, Iterator, Optional
 
 import ray_tpu as rt
-from ray_tpu.data.block import (Block, concat_blocks, from_batch,
-                                split_block, to_batch)
+from ray_tpu.data.block import (Block, block_rows, concat_blocks,
+                                from_batch, iter_rows, split_block,
+                                to_batch)
 
 
 @dataclasses.dataclass
@@ -42,21 +43,22 @@ def apply_map_spec(spec: MapSpec, fn, block: Block) -> Block:
     from ray_tpu.data.block import batch_iter
 
     if spec.kind == "map":
-        return [fn(row, **spec.fn_kwargs) for row in block]
+        return [fn(row, **spec.fn_kwargs) for row in iter_rows(block)]
     if spec.kind == "filter":
-        return [row for row in block if fn(row, **spec.fn_kwargs)]
+        return [row for row in iter_rows(block) if fn(row, **spec.fn_kwargs)]
     if spec.kind == "flat_map":
-        out: Block = []
-        for row in block:
+        out: list = []
+        for row in iter_rows(block):
             out.extend(fn(row, **spec.fn_kwargs))
         return out
     if spec.kind == "map_batches":
-        out = []
+        outs = []
         for sub in batch_iter(block, spec.batch_size):
             batch = to_batch(sub, spec.batch_format)
-            result = fn(batch, **spec.fn_kwargs)
-            out.extend(from_batch(result))
-        return out
+            outs.append(from_batch(fn(batch, **spec.fn_kwargs)))
+        if len(outs) == 1:
+            return outs[0]
+        return concat_blocks(outs)  # arrow-aware concat
     raise ValueError(f"unknown map kind {spec.kind!r}")
 
 
@@ -152,7 +154,7 @@ class StreamingExecutor:
         def shard(block: Block, n: int, seed) -> list[Block]:
             rng = random.Random(seed)
             shards: list[Block] = [[] for _ in range(n)]
-            for row in block:
+            for row in iter_rows(block):
                 shards[rng.randrange(n)].append(row)
             return shards
 
@@ -176,7 +178,8 @@ class StreamingExecutor:
 
     def sort(self, refs: list, key: Callable, descending: bool) -> list:
         blocks = rt.get(list(refs))
-        rows = concat_blocks(blocks)
+        rows = block_rows(concat_blocks(blocks))
+        rows = list(rows)
         rows.sort(key=key, reverse=descending)
         n = max(1, len(refs))
         return [rt.put(b) for b in split_block(rows, n)]

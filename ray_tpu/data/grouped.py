@@ -8,7 +8,7 @@ import zlib
 from typing import Any, Callable
 
 import ray_tpu as rt
-from ray_tpu.data.block import Block, concat_blocks
+from ray_tpu.data.block import Block, concat_blocks, iter_rows
 
 
 def _stable_hash(value: Any) -> int:
@@ -40,7 +40,7 @@ class GroupedData:
 
         def shard(block: Block, n: int) -> list[Block]:
             shards: list[Block] = [[] for _ in range(n)]
-            for row in block:
+            for row in iter_rows(block):
                 shards[_stable_hash(row[key]) % n].append(row)
             return shards
 
@@ -58,7 +58,7 @@ class GroupedData:
 
     def _grouped_rows(self, ref) -> dict[Any, Block]:
         groups: dict[Any, Block] = {}
-        for row in rt.get(ref):
+        for row in iter_rows(rt.get(ref)):
             groups.setdefault(row[self._key], []).append(row)
         return groups
 

@@ -7,7 +7,12 @@ from typing import Any, Optional
 
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,  # noqa: F401
                                       Deployment, deployment)
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.handle import (DeploymentHandle,  # noqa: F401
+                                  DeploymentResponse,
+                                  DeploymentResponseGenerator)
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,  # noqa: F401
+                                     multiplexed)
+from ray_tpu.serve.schema import build_app, deploy_config  # noqa: F401
 
 _proxy = None
 _proxy_port: Optional[int] = None

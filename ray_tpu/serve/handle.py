@@ -114,11 +114,15 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
+        self.multiplexed_model_id = multiplexed_model_id
+        # model-id -> replica affinity (multiplex routing)
+        self._model_affinity: dict = {}
         self._lock = threading.Lock()
         self._table_version = -1
         self._replicas: list = []
@@ -130,13 +134,20 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self.method_name,
-                 self.stream))
+                 self.stream, self.multiplexed_model_id))
 
     def options(self, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name,
-                                method_name or self.method_name,
-                                self.stream if stream is None else stream)
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self.method_name,
+            self.stream if stream is None else stream,
+            self.multiplexed_model_id if multiplexed_model_id is None
+            else multiplexed_model_id)
+        h._model_affinity = self._model_affinity  # share affinity cache
+        return h
 
     # ------------------------------------------------------------- routing
     def _refresh(self, force: bool = False):
@@ -184,9 +195,27 @@ class DeploymentHandle:
             return a if self._inflight.get(a, 0) <= self._inflight.get(
                 b, 0) else b
 
+    def _pick_replica_for_model(self, model_id: str):
+        """Model-affinity routing: repeat traffic for a model id goes to
+        the replica that last served it (its LRU likely holds the model —
+        ref: model-id-aware pow-2 scheduler), else normal pow-2 pick."""
+        if model_id:
+            preferred = self._model_affinity.get(model_id)
+            if preferred is not None:
+                self._refresh()
+                with self._lock:
+                    if any(r is preferred for r in self._replicas):
+                        return preferred
+        replica = self._pick_replica()
+        if model_id:
+            self._model_affinity[model_id] = replica
+            if len(self._model_affinity) > 1024:
+                self._model_affinity.pop(next(iter(self._model_affinity)))
+        return replica
+
     # ---------------------------------------------------------------- call
     def remote(self, *args, **kwargs):
-        replica = self._pick_replica()
+        replica = self._pick_replica_for_model(self.multiplexed_model_id)
         with self._lock:
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
 
@@ -198,7 +227,8 @@ class DeploymentHandle:
         if self.stream:
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                self.method_name, args, kwargs)
+                self.method_name, args, kwargs, self.multiplexed_model_id)
             return DeploymentResponseGenerator(ref_gen, done)
-        ref = replica.handle_request.remote(self.method_name, args, kwargs)
+        ref = replica.handle_request.remote(
+            self.method_name, args, kwargs, self.multiplexed_model_id)
         return DeploymentResponse(ref, done)

@@ -1,0 +1,86 @@
+"""Model multiplexing: many models time-share one replica pool (ref
+analog: python/ray/serve/multiplex.py `_ModelMultiplexWrapper` +
+serve.get_multiplexed_model_id).
+
+Usage:
+    @serve.deployment
+    class ModelHost:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            return load(model_id)              # LRU-cached per replica
+
+        async def __call__(self, payload):
+            model = await self.get_model(serve.get_multiplexed_model_id())
+            return model(payload)
+
+    handle.options(multiplexed_model_id="m7").remote(x)
+    # HTTP: header `serve_multiplexed_model_id: m7`
+
+Routing: the handle remembers which replica last served each model id and
+sends repeat traffic there (model-affinity), falling back to power-of-two
+choices — the single-handle version of the reference's model-id-aware
+replica scheduler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rayt_serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the request being handled."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+def _reset_model_id(token):
+    _current_model_id.reset(token)
+
+
+def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
+    """Decorate the model loader method; calls are LRU-cached per replica
+    (evicted models are simply dropped; define __del__ on the model for
+    custom unload)."""
+
+    def wrap(loader: Callable) -> Callable:
+        cache_attr = f"_rayt_mux_cache_{loader.__name__}"
+        lock_attr = f"_rayt_mux_lock_{loader.__name__}"
+
+        async def inner(self, model_id: str) -> Any:
+            cache: OrderedDict = self.__dict__.setdefault(
+                cache_attr, OrderedDict())
+            lock: asyncio.Lock = self.__dict__.setdefault(
+                lock_attr, asyncio.Lock())
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                while len(cache) >= max_num_models_per_replica:
+                    cache.popitem(last=False)  # evict LRU
+                result = loader(self, model_id)
+                if inspect.iscoroutine(result):
+                    result = await result
+                cache[model_id] = result
+                return result
+
+        inner.__name__ = loader.__name__
+        inner._rayt_multiplexed = True
+        return inner
+
+    return wrap
+
+
+def loaded_model_ids(instance, loader_name: str = "get_model") -> list[str]:
+    """Model ids currently cached on a replica instance (observability)."""
+    cache = instance.__dict__.get(f"_rayt_mux_cache_{loader_name}", {})
+    return list(cache)

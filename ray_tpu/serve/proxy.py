@@ -86,6 +86,10 @@ class ProxyActor:
         wants_stream = (request.query.get("stream") == "1"
                         or "text/event-stream" in
                         request.headers.get("Accept", ""))
+        # model multiplexing (ref: serve proxy forwards the model-id header)
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
         if wants_stream:
             if isinstance(payload, dict):

@@ -53,9 +53,12 @@ class ReplicaActor:
         return arg
 
     async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict) -> Any:
+                             kwargs: dict, model_id: str = "") -> Any:
+        from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
+
         self._ongoing += 1
         self._total += 1
+        token = _set_model_id(model_id)
         try:
             if method_name == "__call__":
                 fn = self._callable
@@ -66,19 +69,24 @@ class ReplicaActor:
             if inspect.iscoroutinefunction(coro_fn):
                 return await coro_fn(*args, **kwargs)
             loop = asyncio.get_running_loop()
+            ctx = __import__("contextvars").copy_context()
             return await loop.run_in_executor(
-                None, lambda: fn(*args, **kwargs))
+                None, lambda: ctx.run(fn, *args, **kwargs))
         finally:
+            _reset_model_id(token)
             self._ongoing -= 1
 
     async def handle_request_streaming(self, method_name: str, args: tuple,
-                                       kwargs: dict):
+                                       kwargs: dict, model_id: str = ""):
         """Async-generator entrypoint: the user callable may be a sync
         generator, an async generator, or return either; every produced
         item streams to the caller via the core streaming-return path
         (ref: serve response streaming over ObjectRefGenerator)."""
+        from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
+
         self._ongoing += 1
         self._total += 1
+        token = _set_model_id(model_id)
         try:
             if method_name == "__call__":
                 fn = self._callable
@@ -102,6 +110,7 @@ class ReplicaActor:
             else:
                 yield result
         finally:
+            _reset_model_id(token)
             self._ongoing -= 1
 
     def get_stats(self) -> dict:

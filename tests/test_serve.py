@@ -183,3 +183,89 @@ def test_streaming_http_sse(serve_cluster):
     events = [json.loads(line[len("data: "):])
               for line in body.splitlines() if line.startswith("data: ")]
     assert events == [{"token": i} for i in range(4)]
+
+
+def test_multiplexed_models(serve_cluster):
+    """Model multiplexing: per-replica LRU loading + model-id context
+    (ref: serve/multiplex.py)."""
+    @serve.deployment
+    class ModelHost:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        async def __call__(self, payload):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model, "loads": list(self.loads),
+                    "payload": payload}
+
+    h = serve.run(ModelHost.bind(), name="mux")
+    r1 = h.options(multiplexed_model_id="a").remote(1).result(timeout=30)
+    assert r1["model"] == "model-a" and r1["loads"] == ["a"]
+    # repeat request: cached, no second load
+    r2 = h.options(multiplexed_model_id="a").remote(2).result(timeout=30)
+    assert r2["loads"] == ["a"]
+    # two more models evict the LRU ("a")
+    h.options(multiplexed_model_id="b").remote(3).result(timeout=30)
+    r4 = h.options(multiplexed_model_id="c").remote(4).result(timeout=30)
+    assert r4["loads"] == ["a", "b", "c"]
+    r5 = h.options(multiplexed_model_id="a").remote(5).result(timeout=30)
+    assert r5["loads"] == ["a", "b", "c", "a"]  # reloaded after eviction
+
+
+def test_yaml_config_deploy(serve_cluster, tmp_path):
+    """Declarative YAML deploy with per-deployment overrides (ref:
+    serve/schema.py + `serve deploy`)."""
+    import sys
+    import textwrap
+
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Echo:
+            def __init__(self, prefix="e"):
+                self.prefix = prefix
+
+            def __call__(self, x):
+                return f"{self.prefix}:{x}"
+
+        def builder(prefix="built"):
+            return Echo.bind(prefix)
+
+        app = Echo.bind("static")
+    """))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        yaml_cfg = f"""
+applications:
+  - name: yaml_static
+    import_path: my_serve_app:app
+  - name: yaml_built
+    import_path: my_serve_app:builder
+    args: {{prefix: cfg}}
+    deployments:
+      - name: Echo
+        num_replicas: 2
+"""
+        cfg_file = tmp_path / "serve.yaml"
+        cfg_file.write_text(yaml_cfg)
+        handles = serve.deploy_config(str(cfg_file))
+        assert handles["yaml_static"].remote("x").result(
+            timeout=30) == "static:x"
+        assert handles["yaml_built"].remote("y").result(
+            timeout=30) == "cfg:y"
+        import ray_tpu as rt2
+        from ray_tpu.serve import _controller
+
+        deps = rt2.get(_controller().get_deployments.remote("yaml_built"),
+                       timeout=30)
+        assert deps[0]["num_replicas"] == 2
+    finally:
+        sys.path.remove(str(tmp_path))
