@@ -44,6 +44,11 @@ class Config:
     # ---- scheduler ----
     lease_timeout_s: float = 30.0
     worker_startup_timeout_s: float = 60.0
+    # Keep a granted lease (worker + resources) cached for this long after
+    # a task finishes so back-to-back tasks with the same resource shape
+    # skip the lease round-trip (ref: normal_task_submitter.cc:291 lease
+    # reuse). 0 disables caching.
+    lease_reuse_idle_s: float = 1.0
     # Number of pre-forked idle workers kept per node.
     idle_worker_pool_size: int = 1
     idle_worker_ttl_s: float = 300.0

@@ -67,6 +67,17 @@ class _PendingTask:
     done: bool = False
 
 
+@dataclass
+class _LeasePool:
+    """Per-scheduling-key lease pipeline state (ref analog: the
+    per-SchedulingKey entry in normal_task_submitter.h:108): tasks
+    waiting for a worker, idle leased workers kept warm, and the number
+    of outstanding lease requests against the cluster."""
+    idle: list = field(default_factory=list)       # [(winfo, token, nm_addr)]
+    waiters: list = field(default_factory=list)    # [Future]
+    inflight: int = 0
+
+
 class _ExecutionContext(threading.local):
     task_id: TaskID | None = None
 
@@ -103,7 +114,7 @@ class CoreWorker:
         self._conn_locks: dict[str, asyncio.Lock] = {}
         self._node_addrs: dict[NodeID, Address] = {}
         self._dead_nodes: set[NodeID] = set()
-        self._lease_cache: dict[tuple, list] = {}
+        self._lease_cache: dict[tuple, _LeasePool] = {}
         self._actor_submitters: dict[ActorID, _ActorTaskSubmitter] = {}
         # worker-mode execution state
         self.executor = ThreadPoolExecutor(max_workers=1,
@@ -174,6 +185,12 @@ class CoreWorker:
         self.io.stop()
 
     async def _async_shutdown(self):
+        for pool in self._lease_cache.values():
+            for winfo, token, nm_addr, _ in pool.idle:
+                await self._release_lease(winfo, token, nm_addr,
+                                          reusable=False)
+            pool.idle.clear()
+        self._lease_cache.clear()
         for conn in self._conns.values():
             await conn.close()
         if self.gcs is not None:
@@ -204,6 +221,29 @@ class CoreWorker:
     def current_task_id(self) -> TaskID:
         return self._exec_ctx.task_id or self.root_task_id
 
+    def _free_shm_copies(self, meta: ObjectMeta):
+        """Tell every node holding a shm copy of the object to drop its
+        pin (ref: the free_objects path through the local object
+        manager). Fire-and-forget from any thread."""
+        oid = meta.object_id
+
+        async def _free():
+            try:
+                for nid in meta.node_ids:
+                    if nid == self.node_id:
+                        await self.node_conn.call("free_object", oid)
+                    else:
+                        addr = self._node_addrs.get(nid)
+                        if addr is not None:
+                            c = await self._conn_to(addr)
+                            await c.call("free_object", oid)
+            except Exception:
+                pass
+        try:
+            self.io.spawn(_free())
+        except Exception:
+            pass
+
     def _free_object(self, oid: ObjectID):
         self.memory_store.delete(oid)
         meta = self.object_meta.pop(oid, None)
@@ -227,19 +267,7 @@ class CoreWorker:
                 if pt is not None and pt.done:
                     self.pending_tasks.pop(tid, None)
         if meta is not None and meta.in_shm:
-            async def _free():
-                try:
-                    for nid in meta.node_ids:
-                        if nid == self.node_id:
-                            await self.node_conn.call("free_object", oid)
-                        else:
-                            addr = self._node_addrs.get(nid)
-                            if addr is not None:
-                                c = await self._conn_to(addr)
-                                await c.call("free_object", oid)
-                except Exception:
-                    pass
-            self.io.spawn(_free())
+            self._free_shm_copies(meta)
 
     def _notify_owner_refcount(self, oid: ObjectID, owner, kind: str):
         if owner is None:
@@ -583,36 +611,74 @@ class CoreWorker:
     # --------------------------------------------------------------- wait
     def wait(self, refs: list[ObjectRef], num_returns: int = 1,
              timeout: float | None = None):
+        """Event-driven wait: owned refs block on the object-ready event,
+        remote refs long-poll the owner — no fixed-interval re-polling
+        (ref: CoreWorker::Wait fulfills from memory-store/plasma
+        callbacks, not polling)."""
         deadline = None if timeout is None else time.monotonic() + timeout
 
-        async def _status(ref: ObjectRef) -> bool:
+        def _ready_now(ref: ObjectRef) -> bool:
             oid = ref.id
             if self.memory_store.contains(oid):
                 return True
-            meta = self.object_meta.get(oid)
-            if meta is not None:
+            if self.object_meta.get(oid) is not None or self._owns(oid):
                 return not self._is_pending(oid)
-            if self._owns(oid):
-                return not self._is_pending(oid)
-            if self.shm.contains_locally(oid):
-                return True
-            try:
-                res = await self._remote_status(ref, wait_s=0.0)
-                return res[0] not in ("pending",)
-            except Exception:
-                return False
+            return self.shm.contains_locally(oid)
+
+        async def _wait_ready(ref: ObjectRef):
+            """Resolves (to the ref) only when the ref becomes ready."""
+            oid = ref.id
+            while True:
+                if _ready_now(ref):
+                    return ref
+                if ref.owner is None \
+                        or ref.owner.worker_id == self.worker_id:
+                    if not self._owns(oid):
+                        # freed self-owned ref: status is "unknown", which
+                        # counts as no-longer-pending (matches the remote
+                        # owner path's semantics)
+                        return ref
+                    await self._wait_object_event(oid, deadline)
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        return None
+                    continue
+                # remote owner: long-poll its status endpoint
+                budget = self._poll_budget(deadline)
+                try:
+                    res = await self._remote_status(ref, wait_s=budget)
+                except Exception:
+                    await asyncio.sleep(0.5)  # owner unreachable; retry
+                    res = ("pending",)
+                if res[0] != "pending":
+                    return ref
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
 
         async def _wait_loop():
-            while True:
-                statuses = await asyncio.gather(*[_status(r) for r in refs])
-                ready = [r for r, s in zip(refs, statuses) if s]
-                if len(ready) >= num_returns or (
-                        deadline is not None
-                        and time.monotonic() >= deadline):
-                    ready_set = {r.id for r in ready}
-                    not_ready = [r for r in refs if r.id not in ready_set]
-                    return ready, not_ready
-                await asyncio.sleep(0.01)
+            waiters = {asyncio.ensure_future(_wait_ready(r)): r
+                       for r in refs}
+            ready_ids = set()
+            try:
+                while len(ready_ids) < num_returns and waiters:
+                    budget = None if deadline is None else max(
+                        0.0, deadline - time.monotonic())
+                    done, _ = await asyncio.wait(
+                        waiters.keys(), timeout=budget,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        break  # deadline hit with nothing new
+                    for t in done:
+                        r = waiters.pop(t)
+                        if not t.cancelled() and t.exception() is None \
+                                and t.result() is not None:
+                            ready_ids.add(r.id)
+            finally:
+                for t in waiters:
+                    t.cancel()
+            ready = [r for r in refs if r.id in ready_ids]
+            not_ready = [r for r in refs if r.id not in ready_ids]
+            return ready, not_ready
 
         return self.io.run(_wait_loop())
 
@@ -757,12 +823,86 @@ class CoreWorker:
     def _lease_key(self, demand: dict[str, float]) -> tuple:
         return tuple(sorted(demand.items()))
 
+    def _lease_pool_for(self, key: tuple) -> "_LeasePool":
+        pool = self._lease_cache.get(key)
+        if pool is None:
+            pool = _LeasePool()
+            self._lease_cache[key] = pool
+        return pool
+
     async def _acquire_lease(self, demand: dict[str, float]):
+        """Get a leased worker for `demand`: reuse an idle cached lease if
+        one exists, otherwise queue as a waiter and make sure enough lease
+        fetches are in flight (ref: normal_task_submitter.cc:291 — one
+        scheduling-key pipeline, workers handed task-to-task without a
+        raylet round-trip)."""
         key = self._lease_key(demand)
-        cache = self._lease_cache.get(key)
-        while cache:
-            winfo, token, nm_addr, _ = cache.pop()
-            return winfo, token, nm_addr
+        pool = self._lease_pool_for(key)
+        if pool.idle:
+            entry = pool.idle.pop()
+            return entry[0], entry[1], entry[2]
+        fut = asyncio.get_running_loop().create_future()
+        pool.waiters.append(fut)
+        if pool.inflight < len(pool.waiters):
+            pool.inflight += 1
+            asyncio.ensure_future(self._fetch_lease(key, demand, pool))
+        entry = await fut
+        return entry[0], entry[1], entry[2]
+
+    async def _fetch_lease(self, key: tuple, demand: dict[str, float],
+                           pool: "_LeasePool"):
+        """One in-flight lease request against the cluster; the grant goes
+        to whichever waiter is first in line."""
+        try:
+            entry = await self._request_cluster_lease(demand)
+        except Exception as e:
+            pool.inflight -= 1
+            # fetches and waiters are ~1:1 (one spawned per new waiter),
+            # so a failed fetch fails exactly ONE waiter — the same blast
+            # radius as the old request-per-task design. Other waiters
+            # keep their own in-flight fetches.
+            while pool.waiters:
+                fut = pool.waiters.pop(0)
+                if not fut.done():
+                    fut.set_exception(e)
+                    break
+            return
+        pool.inflight -= 1
+        self._offer_lease(key, pool, entry, recycled=False)
+
+    def _offer_lease(self, key: tuple, pool: "_LeasePool", entry,
+                     recycled: bool):
+        """Hand a granted/finished lease to the next waiter; otherwise keep
+        a recycled lease warm for lease_reuse_idle_s, and return a fetched
+        lease nobody wants (holding it would starve other clients queued
+        at the node manager)."""
+        while pool.waiters:
+            fut = pool.waiters.pop(0)
+            if not fut.done():
+                fut.set_result(entry)
+                return
+        idle_s = get_config().lease_reuse_idle_s
+        if not recycled or idle_s <= 0 or self._shutdown:
+            asyncio.ensure_future(self._release_lease(
+                entry[0], entry[1], entry[2], reusable=False))
+            return
+        # identity sentinel: the same lease can be recycled repeatedly, so
+        # an expire timer from an EARLIER idle period must not evict the
+        # lease's newer idle incarnation (tuple equality would)
+        idle_entry = (entry[0], entry[1], entry[2], object())
+        pool.idle.append(idle_entry)
+
+        async def _expire():
+            await asyncio.sleep(idle_s)
+            for i, cand in enumerate(pool.idle):
+                if cand[3] is idle_entry[3]:
+                    del pool.idle[i]
+                    await self._release_lease(
+                        entry[0], entry[1], entry[2], reusable=False)
+                    return
+        asyncio.ensure_future(_expire())
+
+    async def _request_cluster_lease(self, demand: dict[str, float]):
         nm_addr = Address(self.node_address.host, self.node_address.port)
         allow_spill = True
         infeasible_deadline: float | None = None
@@ -823,6 +963,14 @@ class CoreWorker:
         except Exception:
             pass
 
+    def _recycle_lease(self, demand: dict[str, float], winfo, token, nm_addr):
+        """A task finished on this leased worker: hand the lease straight
+        to the next queued task of the same shape, or keep it warm for
+        lease_reuse_idle_s. Runs on the IO loop."""
+        key = self._lease_key(demand)
+        self._offer_lease(key, self._lease_pool_for(key),
+                          (winfo, token, nm_addr), recycled=True)
+
     async def _run_normal_task(self, spec: TaskSpec):
         pt = self.pending_tasks[spec.task_id]
         while True:
@@ -846,7 +994,7 @@ class CoreWorker:
                 self._fail_task(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
                 return
-            await self._release_lease(winfo, token, nm_addr)
+            self._recycle_lease(spec.resources, winfo, token, nm_addr)
             if reply[0] == "task_error":
                 _, err_blob, tb = reply
                 if spec.retry_exceptions and pt.retries_left > 0:
@@ -989,9 +1137,13 @@ class CoreWorker:
                 oid, size=size, in_shm=True, node_ids=[node_id])
         await stream.wait_capacity()
         if stream.dropped:
-            # consumer went away while we waited: free the stored item
+            # consumer went away while we waited: free the stored item,
+            # including the producer-node shm copy (it was pinned by
+            # object_created and would otherwise leak until node restart)
             self.memory_store.delete(oid)
-            self.object_meta.pop(oid, None)
+            dropped_meta = self.object_meta.pop(oid, None)
+            if dropped_meta is not None and dropped_meta.in_shm:
+                self._free_shm_copies(dropped_meta)
             return False
         stream.push(index, oid)
         return True
@@ -1279,10 +1431,10 @@ class _ActorTaskSubmitter:
             try:
                 res = await self.cw.gcs.actor_handle_state(self.actor_id)
             except Exception:
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(0.25)
                 continue
             if res is None:
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(0.25)
                 continue
             state, address, death_cause, _, node_id = res
             self.state = state
@@ -1290,7 +1442,7 @@ class _ActorTaskSubmitter:
             if state == ActorState.ALIVE and address is not None \
                     and address == self._avoid_address:
                 # stale ALIVE record for an endpoint we saw die
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(0.25)
                 continue
             if state == ActorState.ALIVE and address is not None:
                 if address != self.address:
@@ -1302,7 +1454,9 @@ class _ActorTaskSubmitter:
             if state == ActorState.DEAD:
                 self._resolved.set()
                 return
-            await asyncio.sleep(0.05)
+            # PENDING/RESTARTING: pubsub (on_actor_update) delivers the
+            # transition promptly; this poll is only a lost-event fallback
+            await asyncio.sleep(0.25)
 
     async def on_actor_update(self, info):
         self.state = info.state

@@ -102,7 +102,12 @@ class ObjectRefGenerator:
                 stream.drop()
                 for oid in stream.items.values():
                     cw.memory_store.delete(oid)
-                    cw.object_meta.pop(oid, None)
+                    meta = cw.object_meta.pop(oid, None)
+                    if meta is not None and meta.in_shm:
+                        # shm items were pinned on the producer node by
+                        # object_created; free them there too or they
+                        # leak store/spill space until node restart
+                        cw._free_shm_copies(meta)
                 stream.items.clear()
             try:
                 cw.io.loop.call_soon_threadsafe(_drop)

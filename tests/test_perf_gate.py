@@ -1,0 +1,65 @@
+"""Microbenchmark regression gate (ref analog: release/microbenchmark/
+nightly runs of python/ray/_private/ray_perf.py:93).
+
+Floors are deliberately conservative (~10x below the numbers committed
+in MICROBENCH.json, which were measured on an idle dev box) so the gate
+catches order-of-magnitude regressions — e.g. a reintroduced poll loop
+or a lease-per-task path — without flaking on slow shared CI machines.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu._internal.perf import run_microbenchmarks
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ctx = rt.init(num_cpus=8)
+    yield ctx
+    rt.shutdown()
+
+FLOORS = {
+    "tasks_per_second": 100.0,
+    "actor_calls_sync_per_second": 100.0,
+    "actor_calls_async_per_second": 250.0,
+    "async_actor_calls_per_second": 250.0,
+    "put_small_per_second": 1000.0,
+    "put_get_gigabytes_per_second": 0.05,
+}
+
+
+@pytest.mark.timeout(180)
+def test_microbenchmark_floors(ray_cluster):
+    rows = {r["benchmark"]: r["rate_per_s"]
+            for r in run_microbenchmarks(duration=0.5)}
+    failures = {
+        name: (rows.get(name), floor)
+        for name, floor in FLOORS.items()
+        if rows.get(name, 0.0) < floor
+    }
+    assert not failures, (
+        f"microbenchmark regression: rate < floor for {failures}; "
+        f"all rates: {rows}")
+
+
+def test_lease_reuse_faster_than_fresh_lease(ray_cluster):
+    """Back-to-back same-shape tasks must reuse the cached lease (ref:
+    normal_task_submitter.cc:291): serial round-trips with reuse should
+    comfortably beat a conservative no-reuse bound."""
+    import time
+
+    @rt.remote
+    def f(x):
+        return x
+
+    rt.get(f.remote(0))  # warm worker + lease
+    t0 = time.perf_counter()
+    n = 50
+    for i in range(n):
+        rt.get(f.remote(i))
+    dt = time.perf_counter() - t0
+    # 50 serial calls at sub-ms lease-reused latency; allow wide margin
+    assert dt < 5.0, f"50 serial tasks took {dt:.2f}s — lease reuse broken?"
