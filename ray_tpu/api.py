@@ -58,7 +58,8 @@ class RemoteFunction:
             num_returns=o.get("num_returns", 1),
             name=o.get("name", ""),
             scheduling_strategy=o.get("scheduling_strategy"),
-            runtime_env=o.get("runtime_env"))
+            runtime_env=o.get("runtime_env"),
+            tensor_transport=bool(o.get("tensor_transport", False)))
 
     def remote(self, *args, **kwargs):
         opts = self._task_options()
@@ -83,18 +84,22 @@ class RemoteFunction:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
-                 max_retries: int = -1):
+                 max_retries: int = -1, tensor_transport: bool = False):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._max_retries = max_retries
+        self._tensor_transport = tensor_transport
 
     def options(self, num_returns: int | None = None,
-                max_retries: int | None = None, **_):
+                max_retries: int | None = None,
+                tensor_transport: bool | None = None, **_):
         return ActorMethod(
             self._handle, self._name,
             self._num_returns if num_returns is None else num_returns,
-            self._max_retries if max_retries is None else max_retries)
+            self._max_retries if max_retries is None else max_retries,
+            self._tensor_transport if tensor_transport is None
+            else tensor_transport)
 
     def remote(self, *args, **kwargs):
         nr = self._num_returns
@@ -103,7 +108,8 @@ class ActorMethod:
         opts = TaskOptions(num_returns=nr,
                            max_retries=(self._handle._max_task_retries
                                         if self._max_retries < 0
-                                        else self._max_retries))
+                                        else self._max_retries),
+                           tensor_transport=self._tensor_transport)
         refs = _core_worker().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs, opts)
         if nr == -1:
@@ -210,6 +216,14 @@ def put(value) -> ObjectRef:
     if isinstance(value, ObjectRef):
         raise TypeError("calling put() on an ObjectRef is not allowed")
     return _core_worker().put(value)
+
+
+def put_device(value) -> ObjectRef:
+    """Store a jax.Array as a device-resident object: the payload stays
+    in this process's device memory (HBM on TPU); consumers elsewhere
+    receive a host-staged copy rebuilt on their own devices. See
+    core/device_objects.py."""
+    return _core_worker().put_device(value)
 
 
 def wait(refs, *, num_returns: int = 1, timeout: float | None = None):

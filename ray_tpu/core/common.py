@@ -57,6 +57,9 @@ class TaskOptions:
     name: str = ""
     scheduling_strategy: Any = None  # None | "SPREAD" | PlacementGroupSchedulingStrategy
     runtime_env: dict | None = None
+    # jax.Array returns stay device-resident in the executing worker
+    # (ref analog: dag nodes annotated with_tensor_transport)
+    tensor_transport: bool = False
 
 
 @dataclasses.dataclass
@@ -98,6 +101,9 @@ class TaskSpec:
     # Packaged runtime env (see _internal/runtime_env.py), applied by the
     # executing worker before the function/actor-ctor runs.
     runtime_env: dict | None = None
+    # jax.Array returns stay in the executing worker's device memory and
+    # the owner records a device-object ref (core/device_objects.py).
+    tensor_transport: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +156,10 @@ class ObjectMeta:
     in_shm: bool = False
     node_ids: list[NodeID] = dataclasses.field(default_factory=list)
     error: Any = None                # stored exception, if task failed
+    # Device-resident object (payload = jax.Array in the holder worker
+    # process's HBM; see core/device_objects.py). holder is a WorkerInfo.
+    in_device: bool = False
+    holder: Any = None
 
 
 @dataclasses.dataclass

@@ -38,6 +38,10 @@ from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
                                  WorkerInfo)
 from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
 from ray_tpu.core.object_ref import ObjectRef, set_core_worker
+from ray_tpu.core.device_objects import (DeviceObjectStore,
+                                          deserialize_array,
+                                          is_device_value,
+                                          serialize_array)
 from ray_tpu.core.object_store import MemoryStore, make_shm_store
 from ray_tpu.core.reference_counter import ReferenceCounter
 
@@ -97,6 +101,9 @@ class CoreWorker:
         self.server.add_service(self)
         self.memory_store = MemoryStore(self.io.loop)
         self.shm = make_shm_store(node_id)
+        # device-resident objects held by THIS worker process
+        # (payloads in the local jax client; see device_objects.py)
+        self.device_store = DeviceObjectStore()
         self.object_meta: dict[ObjectID, ObjectMeta] = {}
         self._object_events: dict[ObjectID, asyncio.Event] = {}
         self.pending_tasks: dict[TaskID, _PendingTask] = {}
@@ -268,6 +275,20 @@ class CoreWorker:
                     self.pending_tasks.pop(tid, None)
         if meta is not None and meta.in_shm:
             self._free_shm_copies(meta)
+        if meta is not None and meta.in_device:
+            self.device_store.delete(oid)
+            holder = meta.holder
+            if holder is not None and holder.worker_id != self.worker_id:
+                async def _free_dev():
+                    try:
+                        c = await self._conn_to(holder.address)
+                        await c.call("free_device_object", oid)
+                    except Exception:
+                        pass
+                try:
+                    self.io.spawn(_free_dev())
+                except Exception:
+                    pass
 
     def _notify_owner_refcount(self, oid: ObjectID, owner, kind: str):
         if owner is None:
@@ -344,6 +365,27 @@ class CoreWorker:
         self._store_owned_value(oid, value)
         return ObjectRef(oid, self.worker_info)
 
+    def put_device(self, value: Any) -> ObjectRef:
+        """Store a jax.Array as a DEVICE-RESIDENT object: the payload
+        stays in this process's device memory (HBM on TPU); only
+        metadata reaches the object directory. get() in this process
+        returns the same jax.Array; get() elsewhere host-stages the raw
+        shard bytes over RPC — never a pickle of the device buffer
+        (ref analog: torch_tensor_nccl_channel.py device channels)."""
+        if not is_device_value(value):
+            raise TypeError(
+                f"put_device expects a jax.Array, got {type(value)}")
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(self.current_task_id(), idx)
+        self.device_store.put(oid, value)
+        self.object_meta[oid] = ObjectMeta(
+            oid, size=getattr(value, "nbytes", -1), in_device=True,
+            holder=self.worker_info, node_ids=[self.node_id])
+        self._signal_object_ready(oid)
+        return ObjectRef(oid, self.worker_info)
+
     def _store_owned_value(self, oid: ObjectID, value: Any,
                            is_exception: bool = False):
         cfg = get_config()
@@ -414,6 +456,23 @@ class CoreWorker:
             meta = self.object_meta.get(oid)
             if meta is not None and meta.error is not None:
                 return (meta.error, "exc")
+            # 2a. device-resident object: zero-copy if we hold it, else
+            # host-staged fetch from the holder worker (device_objects.py)
+            if meta is not None and meta.in_device:
+                local = self.device_store.get(oid)
+                if local is not None:
+                    return (local, "val")
+                arr = await self._fetch_device_object(oid, meta.holder,
+                                                      deadline)
+                if arr is not None:
+                    return (arr, "val")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"get({oid}) timed out")
+                if self._owns(oid) and self._maybe_recover_object(oid):
+                    continue
+                raise ObjectLostError(
+                    f"{oid}: device-object holder is gone and the value "
+                    "is not reconstructable")
             # 2. shm object we own: read locally, pull cross-node, or
             # reconstruct via lineage (ref: object_recovery_manager.h:38)
             if meta is not None and meta.in_shm:
@@ -471,6 +530,30 @@ class CoreWorker:
                         await asyncio.sleep(0.1)
                         continue
                 return (self.shm.read_bytes(oid, size), "blob")
+            if kind == "device":
+                _, holder = res
+                local = self.device_store.get(oid)
+                if local is not None:
+                    return (local, "val")  # we ARE the holder: zero-copy
+                arr = await self._fetch_device_object(oid, holder, deadline)
+                if arr is not None:
+                    return (arr, "val")
+                # tell the owner its holder looks dead so IT can lineage-
+                # reconstruct (the owner can't see worker-level deaths on
+                # other nodes); then re-ask — a recovering owner answers
+                # "pending" until the re-execution lands
+                pull_failures += 1
+                try:
+                    conn = await self._conn_to(ref.owner.address)
+                    await conn.call("report_device_object_lost",
+                                    (oid, holder.worker_id))
+                except Exception:
+                    pass
+                if pull_failures >= 3:
+                    raise ObjectLostError(
+                        f"could not fetch device object {oid}")
+                await asyncio.sleep(0.1)
+                continue
             if kind == "pending":
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(f"get({oid}) timed out")
@@ -507,6 +590,37 @@ class CoreWorker:
             if ok:
                 return True
         return self.shm.contains_locally(oid)
+
+    async def _fetch_device_object(self, oid: ObjectID, holder,
+                                   deadline: float | None = None):
+        """Host-staged device-object transfer: raw shard bytes from the
+        holder worker's HBM -> local device_put. Never pickles the
+        device buffer (ref analog: NCCL channel p2p, host-staged for
+        the MPMD plane; in-mesh transfers ride XLA collectives).
+
+        Returns None when the holder is unreachable/doesn't have the
+        object (callers may recover via lineage); REMOTE errors (e.g.
+        the holder failing to serialize the array) propagate — they
+        would recur on retry and must not masquerade as a lost holder."""
+        if holder is None:
+            return None
+        budget = 300.0
+        if deadline is not None:
+            budget = max(0.05, min(budget, deadline - time.monotonic()))
+        try:
+            conn = await self._conn_to(holder.address)
+            res = await conn.call("fetch_device_object", oid,
+                                  timeout=budget)
+        except RemoteError:
+            raise
+        except Exception as e:
+            logger.warning("device-object fetch of %s from %s failed: %s",
+                           oid, holder.address, e)
+            return None
+        if res is None:
+            return None
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, deserialize_array, res)
 
     def _maybe_recover_object(self, oid: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the task that produced `oid`
@@ -586,6 +700,8 @@ class CoreWorker:
             meta = self.object_meta.get(oid)
             if meta is not None and meta.error is not None:
                 return ("inline", serialize_to_bytes(meta.error), True)
+            if meta is not None and meta.in_device:
+                return ("device", meta.holder)
             if meta is not None and meta.in_shm:
                 locs = [(nid, self._node_addrs.get(nid)) for nid in meta.node_ids
                         if self._node_addrs.get(nid) is not None]
@@ -607,6 +723,31 @@ class CoreWorker:
             if self._maybe_recover_object(oid):
                 continue
             return ("unknown",)
+
+    def rpc_report_device_object_lost(self, conn, arg):
+        """A borrower failed to reach the recorded holder of a device
+        object we own: drop the stale meta and lineage-reconstruct if
+        possible (ref: object_recovery_manager.h:38)."""
+        oid, holder_wid = arg
+        meta = self.object_meta.get(oid)
+        if meta is None or not meta.in_device or meta.holder is None                 or meta.holder.worker_id != holder_wid:
+            return False  # already recovered / different holder now
+        if self.device_store.contains(oid):
+            return False  # we hold a live copy ourselves
+        return self._maybe_recover_object(oid)
+
+    async def rpc_fetch_device_object(self, conn, oid: ObjectID):
+        """Serve a device object we hold as raw host bytes (+dtype/shape).
+        Runs the gather on an executor thread — device_get can block."""
+        value = self.device_store.get(oid)
+        if value is None:
+            return None
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, serialize_array, value)
+
+    def rpc_free_device_object(self, conn, oid: ObjectID):
+        self.device_store.delete(oid)
+        return True
 
     # --------------------------------------------------------------- wait
     def wait(self, refs: list[ObjectRef], num_returns: int = 1,
@@ -692,6 +833,10 @@ class CoreWorker:
         max_retries = options.max_retries
         if max_retries < 0:
             max_retries = cfg.default_max_retries
+        if options.num_returns == -1 and options.tensor_transport:
+            raise ValueError(
+                "tensor_transport is not supported for streaming "
+                "generators; yielded items go through the object store")
         if options.num_returns == -1:
             # retrying a partially-consumed stream would replay items
             max_retries = 0
@@ -705,7 +850,8 @@ class CoreWorker:
             owner=self.worker_info, max_retries=max_retries,
             retry_exceptions=options.retry_exceptions,
             scheduling_strategy=options.scheduling_strategy,
-            runtime_env=self._package_runtime_env(options.runtime_env))
+            runtime_env=self._package_runtime_env(options.runtime_env),
+            tensor_transport=options.tensor_transport)
         refs = self._register_task(spec, pinned + pinned_kw)
         self.io.spawn(self._run_normal_task(spec))
         if spec.num_returns == -1:
@@ -1029,6 +1175,11 @@ class CoreWorker:
                 self.memory_store.put(oid, value, is_exc)
                 self.object_meta[oid] = ObjectMeta(oid, size=len(blob),
                                                    inline=True)
+            elif entry[0] == "device":
+                _, size, holder = entry
+                self.object_meta[oid] = ObjectMeta(
+                    oid, size=size, in_device=True, holder=holder,
+                    node_ids=[holder.node_id])
             else:  # ("shm", size)
                 _, size = entry
                 self.object_meta[oid] = ObjectMeta(
@@ -1088,6 +1239,10 @@ class CoreWorker:
         spec_args, pinned = self._prepare_args(args)
         spec_kwargs, pinned_kw = self._prepare_args(kwargs)
         max_retries = options.max_retries if options.max_retries >= 0 else 0
+        if options.num_returns == -1 and options.tensor_transport:
+            raise ValueError(
+                "tensor_transport is not supported for streaming "
+                "generators; yielded items go through the object store")
         if options.num_returns == -1:
             # retrying a partially-consumed stream would replay items
             max_retries = 0
@@ -1098,7 +1253,8 @@ class CoreWorker:
             num_returns=options.num_returns,
             resources={}, owner=self.worker_info,
             max_retries=max_retries,
-            actor_id=actor_id, method_name=method_name)
+            actor_id=actor_id, method_name=method_name,
+            tensor_transport=options.tensor_transport)
         refs = self._register_task(spec, pinned + pinned_kw)
         sub = self.get_actor_submitter(actor_id)
         self.io.spawn(sub.submit(spec))
@@ -1249,6 +1405,13 @@ class CoreWorker:
         out = []
         for i, value in enumerate(values):
             oid = ObjectID.for_return(spec.task_id, i)
+            if spec.tensor_transport and is_device_value(value):
+                # device plane: the array never leaves this worker's HBM;
+                # the owner records holder metadata only
+                self.device_store.put(oid, value)
+                out.append(("device", getattr(value, "nbytes", -1),
+                            self.worker_info))
+                continue
             try:
                 blob = serialize_to_bytes(value)
             except Exception as e:

@@ -4,11 +4,16 @@
 compile() topologically sorts the graph once and freezes the submission
 plan; execute() replays it with object refs wired producer→consumer, so
 intermediate values move directly worker-to-worker through the object
-store (the driver only submits). The reference's further step —
-pre-negotiated mutable channels bypassing per-call RPC, NCCL/ICI device
-channels (torch_tensor_nccl_channel.py) — is the round-2+ fast path; for
-TPU the device data plane is the mesh (jax collectives inside one jit),
-so DAG edges here carry host values/metadata between SPMD programs.
+store (the driver only submits).
+
+Device edges: a node marked `.with_tensor_transport()` keeps its
+jax.Array output in the producing actor's device memory (HBM) and the
+consumer fetches raw shard bytes directly from that actor, rebuilding
+the array on its own devices — no host pickle bounce through the
+object store (ref analog: torch_tensor_nccl_channel.py NCCL channels;
+see core/device_objects.py). For TPU the *intra-mesh* device plane is
+still the mesh itself (XLA collectives inside one jit); device edges
+are the MPMD-level transport between SPMD programs.
 
 Pipeline parallelism: execute_async() overlaps successive executions —
 each call submits immediately without waiting for prior results, so
@@ -94,6 +99,8 @@ class CompiledDAG:
                 call_kwargs = {k: self._resolve(v, values)
                                for k, v in node.kwargs.items()}
                 method = getattr(node.actor, node.method_name)
+                if getattr(node, "tensor_transport", False):
+                    method = method.options(tensor_transport=True)
                 values[id(node)] = method.remote(*call_args, **call_kwargs)
             elif isinstance(node, FunctionNode):
                 call_args = tuple(self._resolve(a, values)

@@ -67,6 +67,17 @@ class ClassMethodNode(DAGNode):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
+        self.tensor_transport = False
+
+    def with_tensor_transport(self, transport: str = "auto"
+                              ) -> "ClassMethodNode":
+        """Mark this node's output as a DEVICE edge: the produced
+        jax.Array stays in the producing actor's device memory and moves
+        to consumers via host-staged raw-bytes transfer — never a pickle
+        of the buffer (ref analog: dag_node.with_tensor_transport /
+        TorchTensorType on compiled-graph edges)."""
+        self.tensor_transport = True
+        return self
 
     def _upstream(self):
         return [a for a in list(self.args) + list(self.kwargs.values())
