@@ -63,6 +63,17 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     # Shared-memory store capacity (bytes). 0 = auto (30% of system RAM).
     object_store_memory: int = 0
+    # ---- node-to-node object transfer (ref: pull_manager.h:52,
+    # push_manager.h:30, object_buffer_pool chunking) ----
+    # Transfer chunk size; objects larger than this stream in pieces.
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    # Parallel chunk requests per pull (pipeline depth over one link).
+    object_transfer_max_inflight_chunks: int = 8
+    # Pull admission control: total bytes of objects being pulled into
+    # this node concurrently; excess pulls queue FIFO.
+    pull_max_inflight_bytes: int = 256 * 1024 * 1024
+    # Push throttling: concurrent outbound chunk reads served per node.
+    push_max_concurrent_chunks: int = 16
     # Spill sealed objects to disk when the store passes this fraction of
     # capacity (ref: local_object_manager.h:41). 0 disables spilling.
     object_spilling_threshold: float = 0.8

@@ -149,6 +149,13 @@ class NativeArenaStore:
         self._write_sealed(object_id, [data], len(data), hold=hold)
         return len(data)
 
+    def create_from_chunks(self, object_id, chunks, size: int,
+                           hold: bool = False) -> int:
+        """Seal a payload assembled from transfer chunks without first
+        joining them into one host buffer."""
+        self._write_sealed(object_id, chunks, size, hold=hold)
+        return size
+
     def _write_sealed(self, object_id, chunks, size: int,
                       hold: bool = False):
         off = ctypes.c_uint64()
@@ -196,6 +203,16 @@ class NativeArenaStore:
         view = self._get_view(object_id, size)
         try:
             return bytes(view)
+        finally:
+            self.release(object_id)
+
+    def read_range(self, object_id, size: int, offset: int,
+                   length: int) -> bytes:
+        """One transfer chunk: bytes [offset, offset+length) of the
+        sealed payload (ref: object_buffer_pool chunked reads)."""
+        view = self._get_view(object_id, size)
+        try:
+            return bytes(view[offset:offset + length])
         finally:
             self.release(object_id)
 

@@ -45,6 +45,124 @@ class _Worker:
         self.last_idle = time.monotonic()
 
 
+class _PullManager:
+    """Admission-controlled, deduplicated object pulls (ref analog:
+    pull_manager.h:52). Bounds the total bytes of objects streaming into
+    this node at once (quota); same-object pulls coalesce onto one
+    in-flight transfer; chunks of one object are fetched with a bounded
+    pipeline depth (ref: object_buffer_pool chunking)."""
+
+    def __init__(self, nm: "NodeManager"):
+        self.nm = nm
+        self._inflight: dict[ObjectID, asyncio.Future] = {}
+        self._used_bytes = 0
+        # FIFO admission queue: (size, future). Strict ordering so an
+        # oversize pull can't be starved by later small pulls barging in.
+        self._admit_queue: list = []
+        self.pulled_objects = 0
+        self.pulled_bytes = 0
+
+    async def pull(self, oid: ObjectID, size: int, owner,
+                   remote_addr: Address) -> bool:
+        if self.nm.shm.contains_locally(oid):
+            return True
+        fut = self._inflight.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[oid] = fut
+        try:
+            ok = await self._admitted_pull(oid, size, owner, remote_addr)
+        except Exception as e:
+            logger.warning("pull of %s from %s failed: %s",
+                           oid, remote_addr, e)
+            ok = False
+        finally:
+            self._inflight.pop(oid, None)
+        if not fut.done():
+            fut.set_result(ok)
+        return ok
+
+    def _fits(self, size: int) -> bool:
+        # oversize objects are admitted alone (a strict quota check would
+        # deadlock them)
+        quota = get_config().pull_max_inflight_bytes
+        return self._used_bytes == 0 \
+            or self._used_bytes + size <= quota
+
+    def _drain_admit_queue(self):
+        while self._admit_queue:
+            size, fut = self._admit_queue[0]
+            if fut.done():  # cancelled waiter
+                self._admit_queue.pop(0)
+                continue
+            if not self._fits(size):
+                break  # strict FIFO: later pulls wait behind the head
+            self._admit_queue.pop(0)
+            self._used_bytes += size
+            fut.set_result(True)
+
+    async def _admitted_pull(self, oid, size, owner, remote_addr) -> bool:
+        if not self._admit_queue and self._fits(size):
+            self._used_bytes += size
+        else:
+            fut = asyncio.get_running_loop().create_future()
+            self._admit_queue.append((size, fut))
+            try:
+                await fut
+            except asyncio.CancelledError:
+                self._admit_queue[:] = [
+                    (sz, f) for sz, f in self._admit_queue if f is not fut]
+                raise
+        try:
+            return await self._transfer(oid, size, owner, remote_addr)
+        finally:
+            self._used_bytes -= size
+            self._drain_admit_queue()
+
+    async def _transfer(self, oid, size, owner, remote_addr) -> bool:
+        cfg = get_config()
+        chunk = max(1, cfg.object_transfer_chunk_bytes)
+        c = await connect(remote_addr.host, remote_addr.port)
+        try:
+            if size <= chunk:
+                data = await c.call("fetch_object", oid, timeout=120)
+                if data is None:
+                    return False
+                chunks = [data]
+            else:
+                offsets = list(range(0, size, chunk))
+                chunks = [None] * len(offsets)
+                sem = asyncio.Semaphore(
+                    max(1, cfg.object_transfer_max_inflight_chunks))
+
+                async def fetch(i: int, off: int):
+                    async with sem:
+                        d = await c.call(
+                            "fetch_chunk",
+                            (oid, off, min(chunk, size - off)),
+                            timeout=120)
+                    if d is None:
+                        raise LookupError(f"chunk {i} of {oid} missing")
+                    chunks[i] = d
+
+                await asyncio.gather(
+                    *(fetch(i, off) for i, off in enumerate(offsets)))
+        except LookupError:
+            return False  # remote no longer has (part of) the object
+        except Exception as e:
+            logger.warning("chunked fetch of %s failed (%s)", oid, e)
+            return False
+        finally:
+            await c.close()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.nm._store_pulled, oid, chunks, size, owner)
+        self.pulled_objects += 1
+        self.pulled_bytes += size
+        return True
+
+
 class NodeManager:
     def __init__(self, node_id: NodeID, resources: dict[str, float],
                  gcs_address: Address, labels: dict[str, str] | None = None):
@@ -75,6 +193,11 @@ class NodeManager:
         self._cluster_view: dict = {}
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
+        self._pull_manager = _PullManager(self)
+        self._push_sem: asyncio.Semaphore | None = None
+        import threading
+
+        self._spill_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
@@ -536,7 +659,9 @@ class NodeManager:
         return 2 << 30
 
     def _unspilled_bytes(self) -> int:
-        return sum(m["size"] for m in self.object_dir.values()
+        # snapshot: restore/spill IO on executor threads can mutate the
+        # dict concurrently with this loop-side iteration
+        return sum(m["size"] for m in list(self.object_dir.values())
                    if not m.get("spilled"))
 
     def _spill_path(self, oid: ObjectID) -> str:
@@ -544,29 +669,81 @@ class NodeManager:
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, oid.hex())
 
-    def _spill_one(self) -> bool:
-        """Spill the oldest unspilled primary to disk; returns True if one
-        was spilled."""
-        victim = next(
-            (oid for oid, m in self.object_dir.items()
-             if not m.get("spilled") and self.shm.contains_locally(oid)),
-            None)
-        if victim is None:
-            return False
-        meta = self.object_dir[victim]
-        data = self.shm.read_bytes(victim, meta["size"])
+    def _claim_spill_victim(self):
+        """Pick AND mark a spill victim under the spill lock — sync spills
+        on executor threads and the async spill loop must not race onto
+        the same object."""
+        with self._spill_lock:
+            victim = next(
+                (oid for oid, m in list(self.object_dir.items())
+                 if not m.get("spilled") and not m.get("spilling")
+                 and self.shm.contains_locally(oid)),
+                None)
+            if victim is not None:
+                self.object_dir[victim]["spilling"] = True
+            return victim
+
+    def _spill_write(self, victim: ObjectID, size: int) -> str:
+        """The IO half of a spill (shm read + file write) — safe to run
+        on an executor thread; state mutation stays on the loop."""
+        data = self.shm.read_bytes(victim, size)
         path = self._spill_path(victim)
         with open(path + ".tmp", "wb") as f:
             f.write(data)
         os.replace(path + ".tmp", path)
-        self.shm.unlink(victim)          # tombstone while pinned
-        if meta.pop("pinned", False):
-            self.shm.unpin(victim)       # refcount 0 -> space reclaimed
-        meta["spilled"] = path
-        self._spilled_bytes += meta["size"]
-        self._spill_count += 1
+        return path
+
+    def _finish_spill(self, victim: ObjectID, meta: dict, path: str):
+        with self._spill_lock:
+            if meta.get("spilled"):
+                return  # another path already completed this spill
+            self.shm.unlink(victim)      # tombstone while pinned
+            if meta.pop("pinned", False):
+                self.shm.unpin(victim)   # refcount 0 -> space reclaimed
+            meta["spilled"] = path
+            self._spilled_bytes += meta["size"]
+            self._spill_count += 1
         logger.info("spilled %s (%d bytes) to %s",
                     victim, meta["size"], path)
+
+    def _spill_one(self) -> bool:
+        """Synchronous spill (OOM fallback paths, possibly on executor
+        threads); the background spill loop uses _spill_one_async to keep
+        file IO off the RPC loop. Both claim victims via the spill lock."""
+        victim = self._claim_spill_victim()
+        if victim is None:
+            return False
+        meta = self.object_dir[victim]
+        try:
+            path = self._spill_write(victim, meta["size"])
+            self._finish_spill(victim, meta, path)
+        finally:
+            meta.pop("spilling", None)
+        return True
+
+    async def _spill_one_async(self) -> bool:
+        """Spill with the file IO on an executor thread (ref:
+        local_object_manager spills via IO workers, not the main loop).
+        The victim is marked `spilling` so concurrent picks skip it; if
+        it is freed while the write is in flight, the file is removed."""
+        victim = self._claim_spill_victim()
+        if victim is None:
+            return False
+        meta = self.object_dir[victim]
+        loop = asyncio.get_running_loop()
+        try:
+            path = await loop.run_in_executor(
+                None, self._spill_write, victim, meta["size"])
+            if self.object_dir.get(victim) is not meta:
+                # freed mid-spill: drop the orphan file
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return True
+            self._finish_spill(victim, meta, path)
+        finally:
+            meta.pop("spilling", None)
         return True
 
     def _spill_until(self, target_unspilled: float) -> int:
@@ -577,24 +754,34 @@ class NodeManager:
             n += 1
         return n
 
-    def rpc_spill_now(self, conn, need_bytes: int):
-        """A creator hit shm OOM: synchronously free at least need_bytes
-        by spilling primaries (ref: plasma create-request queue + spill)."""
+    async def rpc_spill_now(self, conn, need_bytes: int):
+        """A creator hit shm OOM: free at least need_bytes by spilling
+        primaries (ref: plasma create-request queue + spill). The caller
+        blocks, but this loop keeps serving other RPCs — spill IO runs
+        on executor threads."""
         cap = self._store_capacity()
-        target = max(0.0, cap - float(need_bytes) * 2)
-        return self._spill_until(min(
-            target, get_config().object_spilling_threshold * cap))
+        target = min(max(0.0, cap - float(need_bytes) * 2),
+                     get_config().object_spilling_threshold * cap)
+        n = 0
+        while self._unspilled_bytes() > target:
+            if not await self._spill_one_async():
+                break
+            n += 1
+        return n
 
     async def _spill_loop(self):
         """Move sealed shm objects to disk past the high-water mark (ref:
         local_object_manager.h:41 spill-to-disk). Oldest-sealed first; the
         directory keeps serving them (fetch reads the file, local access
-        restores into shm on demand)."""
+        restores into shm on demand). File IO runs on executor threads so
+        multi-GiB spills don't stall lease/RPC traffic on this loop."""
         cfg = get_config()
         high = cfg.object_spilling_threshold * self._store_capacity()
         while not self._stopping:
             try:
-                self._spill_until(high)
+                while self._unspilled_bytes() > high:
+                    if not await self._spill_one_async():
+                        break
             except Exception:
                 logger.exception("spill loop error")
             await asyncio.sleep(0.2)
@@ -630,9 +817,11 @@ class NodeManager:
         self._restore_count += 1
         return True
 
-    def rpc_restore_object(self, conn, oid: ObjectID):
-        """Local un-spill: a worker on this node wants shm access."""
-        return self._restore_spilled(oid)
+    async def rpc_restore_object(self, conn, oid: ObjectID):
+        """Local un-spill: a worker on this node wants shm access. The
+        disk read + shm write run off-loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._restore_spilled, oid)
 
     async def _memory_monitor_loop(self):
         """Node OOM guard (ref: memory_monitor.h + retriable-FIFO worker
@@ -724,29 +913,66 @@ class NodeManager:
                 return None
         return self.shm.read_bytes(object_id, meta["size"])
 
-    async def rpc_store_remote_object(self, conn, arg):
-        """Pull `object_id` from another node's manager into local shm."""
-        object_id, size, owner, remote_addr = arg
-        if self.shm.contains_locally(object_id):
-            return True
-        c = await connect(remote_addr.host, remote_addr.port)
+    async def rpc_fetch_chunk(self, conn, arg):
+        """Serve bytes [offset, offset+length) of a sealed object — the
+        push side of chunked transfer, throttled so bulk pulls can't
+        monopolize this node (ref: push_manager.h:30)."""
+        object_id, offset, length = arg
+        if self._push_sem is None:
+            self._push_sem = asyncio.Semaphore(
+                max(1, get_config().push_max_concurrent_chunks))
+        async with self._push_sem:
+            meta = self.object_dir.get(object_id)
+            if meta is None:
+                return None
+            loop = asyncio.get_running_loop()
+            if meta.get("spilled"):
+                path = meta["spilled"]
+
+                def read_file_range():
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(offset)
+                            return f.read(length)
+                    except OSError:
+                        return None
+
+                data = await loop.run_in_executor(None, read_file_range)
+                if data is not None:
+                    return data
+                # a concurrent local restore deleted the spill file
+                # mid-pull; it re-created the shm copy first, so fall
+                # through and serve the chunk from shm
+            read_range = getattr(self.shm, "read_range", None)
+            try:
+                if read_range is None:
+                    return self.shm.read_bytes(
+                        object_id, meta["size"])[offset:offset + length]
+                return await loop.run_in_executor(
+                    None, read_range, object_id, meta["size"], offset,
+                    length)
+            except (KeyError, FileNotFoundError):
+                return None
+
+    def _store_pulled(self, object_id: ObjectID, chunks: list, size: int,
+                      owner):
+        """Seal a pulled object into local shm, spilling to make room."""
         try:
-            data = await c.call("fetch_object", object_id, timeout=120)
-        finally:
-            await c.close()
-        if data is None:
-            return False
-        try:
-            self.shm.create_from_bytes(object_id, data)
+            self.shm.create_from_chunks(object_id, chunks, size)
         except MemoryError:
-            # make room by spilling primaries, then retry once
             self._spill_until(max(
-                0.0, self._store_capacity() - 2.0 * len(data)))
-            self.shm.create_from_bytes(object_id, data)
+                0.0, self._store_capacity() - 2.0 * size))
+            self.shm.create_from_chunks(object_id, chunks, size)
         # pulled SECONDARY copy: not pinned (evictable; the primary or its
         # spill file elsewhere remains the durable copy)
         self.object_dir[object_id] = {"size": size, "owner": owner}
-        return True
+
+    async def rpc_store_remote_object(self, conn, arg):
+        """Pull `object_id` from another node's manager into local shm —
+        chunked, admission-controlled, deduplicated (_PullManager)."""
+        object_id, size, owner, remote_addr = arg
+        return await self._pull_manager.pull(object_id, size, owner,
+                                             remote_addr)
 
     # ------------------------------------------------------------ debugging
     def rpc_node_stats(self, conn, arg=None):
@@ -757,6 +983,8 @@ class NodeManager:
             "num_workers": len(self.workers),
             "num_objects": len(self.object_dir),
             "pending_leases": len(self._pending_leases),
+            "pulled_objects": self._pull_manager.pulled_objects,
+            "pulled_bytes": self._pull_manager.pulled_bytes,
             "num_spilled": self._spill_count,
             "num_restored": self._restore_count,
             "spilled_bytes": self._spilled_bytes,

@@ -110,13 +110,35 @@ class ShmObjectStore:
     def create_from_bytes(self, object_id: ObjectID, data: bytes,
                           hold: bool = False) -> int:
         """Seal a pre-serialized payload (used by node-to-node transfer).
-        `hold` is a no-op here: per-object segments are never evicted."""
-        shm = shared_memory.SharedMemory(
-            name=_shm_name(object_id), create=True, size=max(len(data), 1))
+        `hold` is a no-op here: per-object segments are never evicted.
+        Duplicate creates (concurrent restores of the same object) keep
+        the existing segment, matching the native arena's rc==-1."""
+        try:
+            shm = shared_memory.SharedMemory(
+                name=_shm_name(object_id), create=True,
+                size=max(len(data), 1))
+        except FileExistsError:
+            return len(data)
         _unregister_tracker(shm)
         shm.buf[:len(data)] = data
         self._open[object_id] = shm
         return len(data)
+
+    def create_from_chunks(self, object_id: ObjectID, chunks, size: int,
+                           hold: bool = False) -> int:
+        try:
+            shm = shared_memory.SharedMemory(
+                name=_shm_name(object_id), create=True, size=max(size, 1))
+        except FileExistsError:
+            return size
+        _unregister_tracker(shm)
+        off = 0
+        for c in chunks:
+            n = len(c)
+            shm.buf[off:off + n] = c
+            off += n
+        self._open[object_id] = shm
+        return size
 
     def release_create_ref(self, object_id: ObjectID):
         pass
@@ -155,6 +177,15 @@ class ShmObjectStore:
             _unregister_tracker(shm)
             self._open[object_id] = shm
         return bytes(shm.buf[:size])
+
+    def read_range(self, object_id: ObjectID, size: int, offset: int,
+                   length: int) -> bytes:
+        shm = self._open.get(object_id)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+            _unregister_tracker(shm)
+            self._open[object_id] = shm
+        return bytes(shm.buf[offset:offset + length])
 
     def release(self, object_id: ObjectID):
         shm = self._open.pop(object_id, None)

@@ -1,0 +1,115 @@
+"""Chunked node-to-node object transfer tests (ref analogs:
+src/ray/object_manager/pull_manager.h:52 admission control,
+push_manager.h:30 throttling, object_buffer_pool chunking; scale
+envelope: release/benchmarks "1 GiB broadcast" / "100 GiB get").
+
+Uses a small chunk size so even modest objects exercise the multi-chunk
+pipeline, and a multi-node in-process cluster so pulls cross node
+managers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def chunked_cluster(monkeypatch):
+    # 256 KiB chunks: a 64 MiB object = 256 chunks through the pipeline
+    monkeypatch.setenv("RAYT_OBJECT_TRANSFER_CHUNK_BYTES", str(256 * 1024))
+    from ray_tpu._internal import config as config_mod
+
+    config_mod.set_config(config_mod.load_config())
+    cluster = Cluster(head_resources={"CPU": 2.0})
+    node_b = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+    cluster.connect()
+    try:
+        yield cluster, node_b
+    finally:
+        cluster.shutdown()
+        config_mod.set_config(config_mod.load_config())
+
+
+def test_large_object_chunked_pull(chunked_cluster):
+    """A 64 MiB array produced on node B is pulled to the driver node in
+    chunks and survives byte-for-byte."""
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    def make():
+        rng = np.random.default_rng(7)
+        return rng.integers(0, 255, size=64 * 1024 * 1024,
+                            dtype=np.uint8)
+
+    ref = make.remote()
+    arr = rt.get(ref, timeout=180)
+    assert arr.nbytes == 64 * 1024 * 1024
+    rng = np.random.default_rng(7)
+    expected = rng.integers(0, 255, size=64 * 1024 * 1024, dtype=np.uint8)
+    assert np.array_equal(arr, expected)
+
+
+def test_broadcast_to_consumers(chunked_cluster):
+    """One big object consumed by tasks on both nodes (broadcast): each
+    node pulls once; concurrent consumers on the same node coalesce onto
+    one in-flight pull (dedup)."""
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    def make():
+        return np.ones(8 * 1024 * 1024, np.uint8)
+
+    ref = make.remote()
+
+    @rt.remote(num_cpus=0.25)
+    def consume(a):
+        return int(a[0]) + len(a)
+
+    # 4 concurrent consumers on the driver node — the node manager must
+    # dedupe these into a single cross-node transfer
+    outs = rt.get([consume.remote(ref) for _ in range(4)], timeout=120)
+    assert outs == [1 + 8 * 1024 * 1024] * 4
+
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    stats = cw.io.run(cw.node_conn.call("node_stats"))
+    assert stats["pulled_objects"] == 1, stats
+
+
+def test_spilled_object_served_chunked(chunked_cluster):
+    """An object spilled to disk on the producer node still serves
+    chunked pulls (file-range reads)."""
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    def make():
+        return np.full(4 * 1024 * 1024, 3, np.uint8)
+
+    ref = make.remote()
+    rt.wait([ref], num_returns=1, timeout=60)
+    _, node_b = chunked_cluster
+    # force-spill everything on node B
+    import asyncio
+
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+
+    async def spill_on_b():
+        from ray_tpu._internal.rpc import connect
+
+        c = await connect("127.0.0.1", node_b.nm_port)
+        try:
+            return await c.call("spill_now", 1 << 40)
+        finally:
+            await c.close()
+
+    spilled = cw.io.run(spill_on_b())
+    assert spilled >= 1
+    arr = rt.get(ref, timeout=120)
+    assert arr[0] == 3 and arr.nbytes == 4 * 1024 * 1024
