@@ -85,8 +85,10 @@ def _run(on_tpu: bool) -> dict:
     else:
         preset, batch, seq, steps = "debug", 4, 128, 5
 
-    cfg = llama.config_for(preset, max_seq_len=seq, remat=on_tpu,
-                           attn_impl="flash" if on_tpu else "xla")
+    cfg = llama.config_for(
+        preset, max_seq_len=seq, remat=on_tpu,
+        remat_save_attn=os.environ.get("RAYT_BENCH_SAVE_ATTN", "0") == "1",
+        attn_impl="flash" if on_tpu else "xla")
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     step, state = build_train_step(

@@ -128,6 +128,7 @@ class NativeArenaStore:
         self._mv = memoryview(self._map)
         self._arena_off = lib.rayt_shm_arena_offset(self._handle)
         self._held: dict[Any, int] = {}   # oid -> get-refcount
+        self._pending: dict[Any, int] = {}  # unsealed oid -> abs offset
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- helpers
@@ -158,26 +159,59 @@ class NativeArenaStore:
 
     def _write_sealed(self, object_id, chunks, size: int,
                       hold: bool = False):
+        if not self.create_unsealed(object_id, size):
+            return  # already present (duplicate transfer): keep existing
+        pos = 0
+        for c in chunks:
+            n = len(c) if isinstance(c, bytes) else c.nbytes
+            self.write_at(object_id, pos,
+                          bytes(c) if not isinstance(
+                              c, (bytes, bytearray, memoryview)) else c)
+            pos += n
+        self.seal(object_id, hold=hold)
+
+    # --------------------------------------------------- streaming creates
+    def create_unsealed(self, object_id, size: int) -> bool:
+        """Allocate an entry to be filled by write_at + seal. The object
+        is invisible to contains/get until sealed (state kCreating).
+        False if it already exists; MemoryError if the arena is full."""
         off = ctypes.c_uint64()
         rc = self._lib.rayt_shm_create(self._handle, object_id.binary(),
                                        size, ctypes.byref(off))
         if rc == -1:
-            return  # already present (duplicate transfer): keep existing
+            return False
         if rc != 0:
             raise MemoryError(
                 f"shm store out of memory for {size} bytes "
                 f"(used {self.used()}/{self.capacity()})")
-        pos = self._arena_off + off.value
-        for c in chunks:
-            n = len(c) if isinstance(c, bytes) else c.nbytes
-            self._mv[pos:pos + n] = bytes(c) if isinstance(c, bytes) else c
-            pos += n
+        with self._lock:
+            self._pending[object_id] = self._arena_off + off.value
+        return True
+
+    def write_at(self, object_id, offset: int, data):
+        with self._lock:
+            base = self._pending[object_id]
+        n = len(data)
+        self._mv[base + offset:base + offset + n] = data
+
+    def seal(self, object_id, hold: bool = False):
         self._lib.rayt_shm_seal(self._handle, object_id.binary())
+        with self._lock:
+            self._pending.pop(object_id, None)
         if not hold:
             # with hold=True the creator keeps its create-ref so the LRU
             # can't evict the object before the node manager pins it;
             # the creator calls release_create_ref() afterwards
             self._lib.rayt_shm_release(self._handle, object_id.binary())
+
+    def abort_unsealed(self, object_id):
+        """Drop a half-written entry (failed/cancelled pull)."""
+        with self._lock:
+            self._pending.pop(object_id, None)
+        # creator still holds its create-ref: delete tombstones the entry,
+        # release drops the last ref and frees the block
+        self._lib.rayt_shm_delete(self._handle, object_id.binary())
+        self._lib.rayt_shm_release(self._handle, object_id.binary())
 
     def contains_locally(self, object_id) -> bool:
         return bool(self._lib.rayt_shm_contains(self._handle,

@@ -61,6 +61,12 @@ class GcsServer:
         self._actors_placing: set[ActorID] = set()
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
+        # at-most-once envelope for client-retried mutations: req_id ->
+        # ("ok", result) | ("err", msg); bounded LRU, snapshotted so a
+        # replay across a GCS restart still dedupes
+        from collections import OrderedDict
+        self._dedup_results: OrderedDict[str, tuple] = OrderedDict()
+        self._dedup_inflight: dict[str, asyncio.Future] = {}
         # channel -> set of subscribed connections
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
@@ -118,6 +124,7 @@ class GcsServer:
             "named_actors": self.named_actors,
             "jobs": self.jobs,
             "placement_groups": self.placement_groups,
+            "dedup_results": dict(self._dedup_results),
         }
 
     def _write_snapshot(self):
@@ -173,6 +180,8 @@ class GcsServer:
         self.named_actors = state.get("named_actors", {})
         self.jobs = state.get("jobs", {})
         self.placement_groups = state.get("placement_groups", {})
+        from collections import OrderedDict
+        self._dedup_results = OrderedDict(state.get("dedup_results", {}))
         # nodes must re-register (their conns died with the old process);
         # give them a heartbeat grace window before declaring them dead
         for nid in self.nodes:
@@ -263,6 +272,63 @@ class GcsServer:
         channel, message = arg
         await self.publish(channel, message)
         return True
+
+    # --------------------------------------------------------- dedup envelope
+    _DEDUP_CAP = 4096
+
+    async def rpc_dedup_call(self, conn: Connection, arg):
+        """At-most-once execution for client-retried mutations.
+
+        GcsClient retries once after ConnectionLost, but the drop can
+        happen *after* the handler executed (and the 100ms snapshot flush
+        preserves that execution across a GCS restart). The client sends
+        non-idempotent mutations through this envelope with a stable
+        req_id; a replay returns the first execution's cached outcome
+        instead of running the handler twice (ref analog: gRPC server-side
+        idempotency for GCS mutations, ADVICE r2 #2).
+        """
+        req_id, method, inner = arg
+        cached = self._dedup_results.get(req_id)
+        if cached is not None:
+            self._dedup_results.move_to_end(req_id)
+            ok, payload = cached
+            if ok:
+                return payload
+            raise RuntimeError(payload)
+        inflight = self._dedup_inflight.get(req_id)
+        if inflight is not None:
+            # replay raced the still-running first execution
+            return await asyncio.shield(inflight)
+        handler = self.server.handlers.get(method)
+        if handler is None:
+            raise RuntimeError(f"dedup_call: no handler {method!r}")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._dedup_inflight[req_id] = fut
+        try:
+            result = handler(conn, inner)
+            if asyncio.iscoroutine(result):
+                result = await result
+        except Exception as e:
+            self._record_dedup(req_id, (False, f"{type(e).__name__}: {e}"))
+            if not fut.done():
+                fut.set_exception(e)
+            fut.exception()  # mark retrieved: no un-awaited error warnings
+            raise
+        else:
+            self._record_dedup(req_id, (True, result))
+            if not fut.done():
+                fut.set_result(result)
+            return result
+        finally:
+            self._dedup_inflight.pop(req_id, None)
+
+    def _record_dedup(self, req_id: str, outcome: tuple):
+        # No mark_dirty here: a mutation that changed the tables already
+        # set the dirty flag, so its dedup record rides the same snapshot
+        # flush; records for no-op handlers aren't worth a full re-pickle.
+        self._dedup_results[req_id] = outcome
+        while len(self._dedup_results) > self._DEDUP_CAP:
+            self._dedup_results.popitem(last=False)
 
     # ----------------------------------------------------------------- KV
     def rpc_kv_put(self, conn, arg):
@@ -847,15 +913,40 @@ class GcsClient:
             logger.info("GCS client reconnected")
             return
 
+    # Methods safe to replay verbatim: reads, and conn-bound registrations
+    # that the reconnect path must re-execute on the NEW connection.
+    _REPLAY_SAFE = frozenset({
+        "kv_get", "kv_multi_get", "kv_keys", "kv_exists",
+        "get_all_nodes", "get_cluster_resources", "get_all_jobs",
+        "get_actor_info", "get_named_actor", "get_all_actors",
+        "actor_handle_state", "get_placement_group", "metrics_snapshot",
+        "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
+        # periodic overwrite-style reports: replaying is harmless, and
+        # routing them through the dedup envelope would churn the LRU
+        "report_task_demand",
+        # conn-bound: GCS stores the calling connection for death
+        # detection, so the retry MUST re-execute on the new connection
+        # (re-registration is idempotent on the tables)
+        "register_node",
+    })
+
     async def call(self, method: str, arg: Any = None,
                    timeout: float | None = None) -> Any:
         """Call with one transparent retry across a GCS restart.
 
-        ONLY ConnectionLost retries: RemoteError (handler raised) and
-        timeouts may have executed the handler, and GCS mutations are not
-        idempotent (kv_put overwrite=False, register_actor)."""
+        ONLY ConnectionLost retries — but a connection can drop *after*
+        the server executed the handler (and the snapshot flush keeps that
+        execution across a restart), so non-idempotent mutations
+        (kv_put overwrite=False, register_actor, ...) are wrapped in the
+        server's at-most-once ``dedup_call`` envelope: the retry carries
+        the same req_id and gets the first execution's cached outcome."""
+        import uuid
+
         from ray_tpu._internal.rpc import ConnectionLost
 
+        if method not in self._REPLAY_SAFE:
+            arg = (uuid.uuid4().hex, method, arg)
+            method = "dedup_call"
         try:
             return await self.conn.call(method, arg, timeout=timeout)
         except ConnectionLost:

@@ -64,15 +64,28 @@ class _PullManager:
 
     async def pull(self, oid: ObjectID, size: int, owner,
                    remote_addr: Address) -> bool:
-        if self.nm.shm.contains_locally(oid):
-            return True
-        fut = self._inflight.get(oid)
-        if fut is not None:
-            return await asyncio.shield(fut)
+        while True:
+            if self.nm.shm.contains_locally(oid):
+                return True
+            fut = self._inflight.get(oid)
+            if fut is None:
+                break
+            try:
+                return await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                if fut.cancelled():
+                    continue  # the LEADER was cancelled: take over
+                raise  # this waiter itself was cancelled
         fut = asyncio.get_running_loop().create_future()
         self._inflight[oid] = fut
         try:
             ok = await self._admitted_pull(oid, size, owner, remote_addr)
+        except asyncio.CancelledError:
+            # wake coalesced waiters so one of them becomes the new leader
+            self._inflight.pop(oid, None)
+            if not fut.done():
+                fut.cancel()
+            raise
         except Exception as e:
             logger.warning("pull of %s from %s failed: %s",
                            oid, remote_addr, e)
@@ -111,8 +124,16 @@ class _PullManager:
             try:
                 await fut
             except asyncio.CancelledError:
-                self._admit_queue[:] = [
-                    (sz, f) for sz, f in self._admit_queue if f is not fut]
+                if fut.done() and not fut.cancelled():
+                    # admission was granted (quota charged by
+                    # _drain_admit_queue) before the cancel landed:
+                    # release it or the quota leaks permanently
+                    self._used_bytes -= size
+                    self._drain_admit_queue()
+                else:
+                    self._admit_queue[:] = [
+                        (sz, f) for sz, f in self._admit_queue
+                        if f is not fut]
                 raise
         try:
             return await self._transfer(oid, size, owner, remote_addr)
@@ -123,16 +144,27 @@ class _PullManager:
     async def _transfer(self, oid, size, owner, remote_addr) -> bool:
         cfg = get_config()
         chunk = max(1, cfg.object_transfer_chunk_bytes)
+        loop = asyncio.get_running_loop()
         c = await connect(remote_addr.host, remote_addr.port)
+        created = False
         try:
             if size <= chunk:
                 data = await c.call("fetch_object", oid, timeout=120)
                 if data is None:
                     return False
-                chunks = [data]
+                await loop.run_in_executor(
+                    None, self.nm._store_pulled, oid, [data], size, owner)
             else:
-                offsets = list(range(0, size, chunk))
-                chunks = [None] * len(offsets)
+                # Allocate the destination first, then stream each chunk
+                # straight into it as it arrives — resident heap stays
+                # ~chunk * max_inflight, not the whole object (the 100 GiB
+                # get envelope; ref object_buffer_pool.h).
+                created = await loop.run_in_executor(
+                    None, self.nm._prepare_pull_segment, oid, size)
+                if not created:
+                    # another transfer/restore of the same object is (or
+                    # finished) writing it — treat as satisfied
+                    return True
                 sem = asyncio.Semaphore(
                     max(1, cfg.object_transfer_max_inflight_chunks))
 
@@ -142,22 +174,31 @@ class _PullManager:
                             "fetch_chunk",
                             (oid, off, min(chunk, size - off)),
                             timeout=120)
-                    if d is None:
-                        raise LookupError(f"chunk {i} of {oid} missing")
-                    chunks[i] = d
+                        if d is None:
+                            raise LookupError(f"chunk {i} of {oid} missing")
+                        await loop.run_in_executor(
+                            None, self.nm.shm.write_at, oid, off, d)
 
                 await asyncio.gather(
-                    *(fetch(i, off) for i, off in enumerate(offsets)))
+                    *(fetch(i, off)
+                      for i, off in enumerate(range(0, size, chunk))))
+                await loop.run_in_executor(
+                    None, self.nm._finish_pull_segment, oid, size, owner)
+                created = False  # sealed: no abort on close path
         except LookupError:
             return False  # remote no longer has (part of) the object
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
             logger.warning("chunked fetch of %s failed (%s)", oid, e)
             return False
         finally:
+            if created:  # failed/cancelled mid-stream: drop the partial
+                try:
+                    self.nm.shm.abort_unsealed(oid)
+                except Exception:
+                    pass
             await c.close()
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
-            None, self.nm._store_pulled, oid, chunks, size, owner)
         self.pulled_objects += 1
         self.pulled_bytes += size
         return True
@@ -194,6 +235,7 @@ class NodeManager:
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
         self._pull_manager = _PullManager(self)
+        self._restore_futs: dict[ObjectID, asyncio.Future] = {}
         self._push_sem: asyncio.Semaphore | None = None
         import threading
 
@@ -819,9 +861,26 @@ class NodeManager:
 
     async def rpc_restore_object(self, conn, oid: ObjectID):
         """Local un-spill: a worker on this node wants shm access. The
-        disk read + shm write run off-loop."""
+        disk read + shm write run off-loop. Concurrent restores of the
+        same object coalesce onto one executor task — two threads racing
+        create would let the loser return while the winner is mid-write
+        (and double-pin the segment)."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self._restore_spilled, oid)
+        fut = self._restore_futs.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = loop.create_future()
+        self._restore_futs[oid] = fut
+        try:
+            ok = await loop.run_in_executor(None, self._restore_spilled, oid)
+        except Exception:
+            logger.exception("restore of %s failed", oid)
+            ok = False
+        finally:
+            self._restore_futs.pop(oid, None)
+        if not fut.done():
+            fut.set_result(ok)
+        return ok
 
     async def _memory_monitor_loop(self):
         """Node OOM guard (ref: memory_monitor.h + retriable-FIFO worker
@@ -965,6 +1024,20 @@ class NodeManager:
             self.shm.create_from_chunks(object_id, chunks, size)
         # pulled SECONDARY copy: not pinned (evictable; the primary or its
         # spill file elsewhere remains the durable copy)
+        self.object_dir[object_id] = {"size": size, "owner": owner}
+
+    def _prepare_pull_segment(self, object_id: ObjectID, size: int) -> bool:
+        """Allocate the (unsealed) destination for a streamed pull,
+        spilling to make room. False if the object already exists."""
+        try:
+            return self.shm.create_unsealed(object_id, size)
+        except MemoryError:
+            self._spill_until(max(
+                0.0, self._store_capacity() - 2.0 * size))
+            return self.shm.create_unsealed(object_id, size)
+
+    def _finish_pull_segment(self, object_id: ObjectID, size: int, owner):
+        self.shm.seal(object_id)
         self.object_dir[object_id] = {"size": size, "owner": owner}
 
     async def rpc_store_remote_object(self, conn, arg):

@@ -91,6 +91,9 @@ class ShmObjectStore:
 
     def __init__(self):
         self._open: dict[ObjectID, shared_memory.SharedMemory] = {}
+        # objects allocated but still being written (streamed pulls,
+        # restores): hidden from contains_locally until seal
+        self._unsealed: set[ObjectID] = set()
 
     def create_and_seal(self, object_id: ObjectID, value: Any) -> int:
         chunks = serialize(value)
@@ -113,32 +116,52 @@ class ShmObjectStore:
         `hold` is a no-op here: per-object segments are never evicted.
         Duplicate creates (concurrent restores of the same object) keep
         the existing segment, matching the native arena's rc==-1."""
-        try:
-            shm = shared_memory.SharedMemory(
-                name=_shm_name(object_id), create=True,
-                size=max(len(data), 1))
-        except FileExistsError:
-            return len(data)
-        _unregister_tracker(shm)
-        shm.buf[:len(data)] = data
-        self._open[object_id] = shm
-        return len(data)
+        return self.create_from_chunks(object_id, [data], len(data),
+                                       hold=hold)
 
     def create_from_chunks(self, object_id: ObjectID, chunks, size: int,
                            hold: bool = False) -> int:
+        if not self.create_unsealed(object_id, size):
+            return size
+        off = 0
+        for c in chunks:
+            n = len(c)
+            self.write_at(object_id, off, c)
+            off += n
+        self.seal(object_id)
+        return size
+
+    # --------------------------------------------------- streaming creates
+    def create_unsealed(self, object_id: ObjectID, size: int) -> bool:
+        """Allocate an object to be filled by write_at + seal. False if
+        the object already exists (created or being created elsewhere)."""
         try:
             shm = shared_memory.SharedMemory(
                 name=_shm_name(object_id), create=True, size=max(size, 1))
         except FileExistsError:
-            return size
+            return False
         _unregister_tracker(shm)
-        off = 0
-        for c in chunks:
-            n = len(c)
-            shm.buf[off:off + n] = c
-            off += n
+        self._unsealed.add(object_id)
         self._open[object_id] = shm
-        return size
+        return True
+
+    def write_at(self, object_id: ObjectID, offset: int, data):
+        shm = self._open[object_id]
+        n = len(data)
+        shm.buf[offset:offset + n] = data
+
+    def seal(self, object_id: ObjectID, hold: bool = False):
+        self._unsealed.discard(object_id)
+
+    def abort_unsealed(self, object_id: ObjectID):
+        self._unsealed.discard(object_id)
+        shm = self._open.pop(object_id, None)
+        if shm is not None:
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:
+                pass
 
     def release_create_ref(self, object_id: ObjectID):
         pass
@@ -150,6 +173,8 @@ class ShmObjectStore:
         pass
 
     def contains_locally(self, object_id: ObjectID) -> bool:
+        if object_id in self._unsealed:
+            return False
         if object_id in self._open:
             return True
         try:

@@ -40,29 +40,18 @@ def _detect_default_resources(num_cpus, resources):
     out.setdefault("CPU", float(num_cpus))
     if "TPU" not in out:
         # TPU autodetect (ref analog: _private/accelerators/tpu.py:70):
-        # count local chips without importing jax (env/devfs probes).
-        chips = _autodetect_tpu_chips()
-        if chips:
-            out["TPU"] = float(chips)
+        # GKE env -> GCE metadata -> devfs; advertises slice-typed
+        # resources (TPU-v5e-8, TPU-v5e-8-head on worker 0) so slice
+        # gang-scheduling works with no flags.
+        from ray_tpu._internal.accelerators import detect_tpu_slice
+
+        info = detect_tpu_slice(
+            use_metadata=os.environ.get("RAYT_DISABLE_GCE_METADATA") != "1")
+        if info is not None:
+            for k, v in info.resources().items():
+                out.setdefault(k, v)
     out.setdefault("memory", float(_system_memory_bytes()))
     return out
-
-
-def _autodetect_tpu_chips() -> int:
-    env = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get(
-        "TPU_VISIBLE_DEVICES")
-    if env:
-        return len([c for c in env.split(",") if c.strip()])
-    # vfio/accel device files on TPU VMs
-    for pattern in ("/dev/accel", "/dev/vfio"):
-        try:
-            entries = [e for e in os.listdir(os.path.dirname(pattern) or "/dev")
-                       if e.startswith(os.path.basename(pattern))]
-            if pattern == "/dev/accel" and entries:
-                return len(entries)
-        except OSError:
-            pass
-    return 0
 
 
 def _system_memory_bytes() -> int:

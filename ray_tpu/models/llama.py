@@ -32,6 +32,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.ops.attention import _repeat_kv, dot_product_attention
@@ -56,6 +57,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    remat_save_attn: bool = False
     # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "auto"
     seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
@@ -236,6 +238,11 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     q = apply_rope(q, cos, sin, positions)
     kk = apply_rope(kk, cos, sin, positions)
     attn = _attention(cfg, q, kk, vv).reshape(b, s, nh * hd)
+    # Named so the remat policy can save it: attention outputs are dots
+    # WITH batch dims, so dots_with_no_batch_dims_saveable would rerun
+    # the whole flash kernel forward inside the backward pass (~+33% on
+    # the attention budget) to rebuild this one activation.
+    attn = checkpoint_name(attn, "attn_out")
     x = x + attn @ layer["wo"].astype(dt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -271,8 +278,15 @@ def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         return (x, aux_sum + aux), None
 
     if cfg.remat:
-        step = jax.checkpoint(
-            step, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if cfg.remat_save_attn:
+            # also save flash-attention outputs (dots WITH batch dims are
+            # not covered by the base policy, so the kernel forward would
+            # rerun inside the backward); costs b*s*d*2B per layer
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                policy,
+                jax.checkpoint_policies.save_only_these_names("attn_out"))
+        step = jax.checkpoint(step, policy=policy)
     (x, aux_sum), _ = jax.lax.scan(
         step, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -317,21 +331,28 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
         "length": jnp.zeros((), jnp.int32),
+        # per-row first REAL slot: left-padded batched serving writes pad
+        # tokens into cache slots [0, start); they are masked out and rope
+        # positions are start-relative (vLLM-style batched decode)
+        "start": jnp.zeros((batch,), jnp.int32),
     }
 
 
 def kv_cache_logical_axes() -> dict:
     return {"k": ("layers", "batch", None, "kv_heads", "head_dim"),
             "v": ("layers", "batch", None, "kv_heads", "head_dim"),
-            "length": ()}
+            "length": (), "start": ("batch",)}
 
 
 def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
-                  positions, cache_len):
+                  positions, cache_len, start=None, abs_positions=None):
     """Single-step (or chunked prefill) block with KV cache.
 
     x: [b, s, d]; k_cache/v_cache: [b, max_len, nkv, hd]. Writes new K/V at
     [cache_len, cache_len+s) via dynamic_update_slice (static shapes).
+    `positions` are rope positions (start-relative for left-padded rows);
+    `abs_positions` are cache-slot positions used for masking; `start` [b]
+    hides the left-pad slots of each row.
     """
     b, s, d = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -344,11 +365,13 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     kk = apply_rope(kk, cos, sin, positions)
     k_cache = jax.lax.dynamic_update_slice(k_cache, kk, (0, cache_len, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, vv, (0, cache_len, 0, 0))
-    # mask: key j visible iff j <= query position
+    # mask: key slot j visible iff start <= j <= query slot
     max_len = k_cache.shape[1]
-    q_pos = positions  # [b, s] absolute positions
+    q_pos = positions if abs_positions is None else abs_positions  # [b, s]
     k_pos = jnp.arange(max_len)[None, :]
     mask = k_pos[:, None, :] <= q_pos[..., None]          # [b, s, max_len]
+    if start is not None:
+        mask = mask & (k_pos[:, None, :] >= start[:, None, None])
     kr = _repeat_kv(k_cache, nh // nkv)
     vr = _repeat_kv(v_cache, nh // nkv)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
@@ -371,14 +394,21 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
     b, s = tokens.shape
     dt = cfg.dtype
     cache_len = cache["length"]
-    positions = cache_len + jnp.arange(s)[None, :].repeat(b, 0)
+    abs_positions = cache_len + jnp.arange(s)[None, :].repeat(b, 0)
+    start = cache.get("start")
+    if start is None:
+        positions = abs_positions
+    else:
+        # rope positions are relative to each row's first real token
+        positions = jnp.maximum(abs_positions - start[:, None], 0)
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
     def step(x, inputs):
         layer, kc, vc = inputs
         x, kc, vc = _decode_block(cfg, x, layer, kc, vc, cos, sin,
-                                  positions, cache_len)
+                                  positions, cache_len, start=start,
+                                  abs_positions=abs_positions)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -388,4 +418,6 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
             else params["lm_head"]).astype(dt)
     logits = (x[:, -1] @ head).astype(jnp.float32)
     new_cache = {"k": k_new, "v": v_new, "length": cache_len + s}
+    if start is not None:
+        new_cache["start"] = start
     return logits, new_cache

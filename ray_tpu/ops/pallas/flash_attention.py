@@ -55,9 +55,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     k_start = ki * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)          # [block_q, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [block_k, d]
-        v = v_ref[0, 0].astype(jnp.float32)          # [block_k, d]
+        # Feed the MXU its native input dtype (bf16) and accumulate f32
+        # via preferred_element_type — casting operands to f32 first
+        # forces the multi-pass f32 matmul path (~6x slower on MXU).
+        q = q_ref[0, 0]                              # [block_q, d]
+        k = k_ref[0, 0]                              # [block_k, d]
+        v = v_ref[0, 0]                              # [block_k, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
@@ -77,7 +80,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scratch[:, 0:1] = m_new
         l_scratch[:, 0:1] = l_new
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [block_q, d]
         acc_scratch[:] = acc_scratch[:] * alpha + pv
 
@@ -172,10 +175,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
-        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        v = v_ref[0, 0]                               # [bk, d]
+        do = do_ref[0, 0]                             # [bq, d]
         lse = lse_ref[0, 0]                           # [bq, 1]
         delta = delta_ref[0, 0]                       # [bq, 1]
         s = jax.lax.dot_general(
@@ -191,7 +194,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scratch[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, d]
@@ -224,10 +227,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
-        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        v = v_ref[0, 0]                               # [bk, d]
+        do = do_ref[0, 0]                             # [bq, d]
         lse = lse_ref[0, 0]                           # [bq, 1]
         delta = delta_ref[0, 0]                       # [bq, 1]
         s = jax.lax.dot_general(
@@ -241,12 +244,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dv_scratch[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_scratch[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]

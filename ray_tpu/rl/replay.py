@@ -1,0 +1,63 @@
+"""Replay buffer actor for off-policy RL (ref analogs:
+rllib/utils/replay_buffers/replay_buffer.py — uniform ring buffer —
+and multi_agent_replay_buffer usage in rllib/algorithms/dqn/).
+
+A plain remote actor: rollout actors `add` transition batches, the
+learner `sample`s uniform minibatches. Storage is preallocated numpy
+rings (stable memory, O(1) add), created lazily from the first batch's
+shapes so the buffer is agnostic to observation spaces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer over transition dicts."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = int(capacity)
+        self._store: dict[str, np.ndarray] | None = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+        self._added = 0
+
+    def _init_store(self, batch: dict):
+        self._store = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            self._store[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                      v.dtype)
+
+    def add(self, batch: dict) -> int:
+        """batch: dict of [N, ...] arrays (same N). Returns total added."""
+        arrays = {k: np.asarray(v) for k, v in batch.items()}
+        if self._store is None:
+            self._init_store(arrays)
+        n = len(next(iter(arrays.values())))
+        i = self._idx
+        for k, v in arrays.items():
+            end = min(i + n, self.capacity)
+            first = end - i
+            self._store[k][i:end] = v[:first]
+            if first < n:  # wrap
+                self._store[k][:n - first] = v[first:]
+        self._idx = (i + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+        self._added += n
+        return self._added
+
+    def sample(self, batch_size: int) -> dict | None:
+        if self._size < batch_size:
+            return None
+        idxs = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idxs] for k, v in self._store.items()}
+
+    def size(self) -> int:
+        return self._size
+
+    def stats(self) -> dict:
+        return {"size": self._size, "added": self._added,
+                "capacity": self.capacity}

@@ -62,6 +62,18 @@ def cmd_start(args):
     resources = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
     if args.num_tpus:
         resources["TPU"] = float(args.num_tpus)
+    else:
+        # slice-aware autodetect (ref: _private/accelerators/tpu.py:70):
+        # `rayt start` on a TPU VM advertises TPU / TPU-<type> /
+        # TPU-<type>-head with no flags
+        from ray_tpu._internal.accelerators import detect_tpu_slice
+
+        info = detect_tpu_slice()
+        if info is not None:
+            resources.update(info.resources())
+            print(f"detected TPU slice: {info.accel_type} "
+                  f"(worker {info.worker_id}/{info.num_workers}, "
+                  f"{info.chips_on_host} chips here, via {info.source})")
     resources.setdefault("memory", 8 << 30)
     pkg_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))

@@ -25,6 +25,10 @@ class ServeController:
         self.replicas: dict[tuple, list] = {}  # (app, dep) -> [handle]
         self.version = 0
         self._scale_marks: dict[tuple, float] = {}
+        # replicas removed from the routing table but still finishing
+        # in-flight requests: [(handle, drain_deadline)] (graceful rolling
+        # replace, ref deployment_state.py replica draining)
+        self._draining: list[tuple] = []
         self._loop_task = None  # started via ensure_loop (needs the
         # actor's asyncio loop, which doesn't exist during __init__)
 
@@ -58,12 +62,16 @@ class ServeController:
         stale = set(old) - set(new)
         stale |= {d for d in set(old) & set(new)
                   if self._spec_version(old[d]) != self._spec_version(new[d])}
+        # Graceful rolling replace: drop stale replicas from the routing
+        # table immediately (so no NEW requests reach them) but let their
+        # in-flight requests drain before killing — the reconcile below
+        # starts new-version replicas right away.
         for dep_name in stale:
+            drain_s = float(old.get(dep_name, {}).get(
+                "drain_timeout_s", 30.0) or 0)
+            deadline = time.monotonic() + drain_s
             for handle in self.replicas.pop((app_name, dep_name), []):
-                try:
-                    rt.kill(handle)
-                except Exception:
-                    pass
+                self._draining.append((handle, deadline))
         if stale:
             self.version += 1
         self.apps[app_name] = new
@@ -123,7 +131,40 @@ class ServeController:
                 await self._reconcile()
             except Exception:
                 pass
+            try:
+                await self._drain_tick()
+            except Exception:
+                pass
             await asyncio.sleep(0.5)
+
+    async def _drain_tick(self):
+        """Kill draining (de-routed) replicas once their in-flight requests
+        finish, or at the drain deadline."""
+        import ray_tpu as rt
+
+        if not self._draining:
+            return
+        keep = []
+        for handle, deadline in self._draining:
+            done = time.monotonic() >= deadline
+            if not done:
+                try:
+                    stats = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda h=handle: rt.get(h.get_stats.remote(),
+                                                      timeout=5))
+                    done = stats["ongoing"] <= 0
+                except Exception:
+                    # a transient stats timeout under load must NOT kill a
+                    # replica mid-request; only a dead actor stops draining
+                    done = not self._alive(handle)
+            if done:
+                try:
+                    rt.kill(handle)
+                except Exception:
+                    pass
+            else:
+                keep.append((handle, deadline))
+        self._draining = keep
 
     async def _reconcile(self):
         import ray_tpu as rt

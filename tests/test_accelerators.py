@@ -1,0 +1,86 @@
+"""TPU autodetect + slice resource modeling (ref analog:
+python/ray/_private/accelerators/tpu.py:70,197 and its test suite)."""
+
+import ray_tpu as rt
+from ray_tpu._internal.accelerators import (TpuSliceInfo, detect_tpu_slice,
+                                            tpu_slice_bundles)
+
+
+def test_detect_from_gke_env():
+    env = {"TPU_ACCELERATOR_TYPE": "v4-16", "TPU_WORKER_ID": "1",
+           "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3",
+           "TPU_VISIBLE_CHIPS": "0,1,2,3", "TPU_NAME": "my-slice"}
+    info = detect_tpu_slice(env, use_metadata=False)
+    assert info.accel_type == "v4-16"
+    assert info.gen == "v4"
+    assert info.total_chips == 16
+    assert info.chips_on_host == 4
+    assert info.worker_id == 1
+    assert info.num_workers == 4
+    res = info.resources()
+    assert res == {"TPU": 4.0, "TPU-v4-16": 4.0}  # not worker 0: no head
+    assert info.labels()["tpu-slice"] == "my-slice"
+
+
+def test_detect_normalizes_v5litepod_and_head_resource():
+    env = {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0",
+           "TPU_VISIBLE_CHIPS": "0,1,2,3,4,5,6,7"}
+    info = detect_tpu_slice(env, use_metadata=False)
+    assert info.accel_type == "v5e-8"
+    assert info.num_workers == 1
+    res = info.resources()
+    assert res["TPU-v5e-8-head"] == 1.0
+    assert res["TPU"] == 8.0
+
+
+def test_detect_none_without_tpu():
+    assert detect_tpu_slice({}, use_metadata=False) is None
+
+
+def test_slice_bundles_shape():
+    info = TpuSliceInfo(accel_type="v5p-16", gen="v5p", total_chips=16,
+                        chips_on_host=4, num_workers=4)
+    assert tpu_slice_bundles(info) == [{"TPU": 4.0}] * 4
+
+
+def test_slice_gang_placement_group():
+    """STRICT_SPREAD slice PG over per-host TPU bundles + a coordinator
+    pinned to the slice-head resource (the TPU-<type>-head trick)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    info = TpuSliceInfo(accel_type="v5e-16", gen="v5e", total_chips=16,
+                        chips_on_host=8, worker_id=0, num_workers=2)
+    # model a 2-host slice: two in-process nodes advertise the slice
+    # resources exactly as detect_tpu_slice would on each host
+    cluster = Cluster(head_resources={"CPU": 2.0})
+    cluster.add_node(num_cpus=2, resources={"TPU": 8.0, "TPU-v5e-16": 8.0,
+                                            "TPU-v5e-16-head": 1.0})
+    cluster.add_node(num_cpus=2, resources={"TPU": 8.0, "TPU-v5e-16": 8.0})
+    cluster.connect()
+    try:
+        _slice_pg_body(info)
+    finally:
+        cluster.shutdown()
+
+
+def _slice_pg_body(info):
+    pg = rt.placement_group(tpu_slice_bundles(info),
+                            strategy="STRICT_SPREAD")
+
+    @rt.remote(num_cpus=0, resources={"TPU": 1})
+    def on_slice_host():
+        import os
+        return os.getpid()
+
+    pids = rt.get([
+        on_slice_host.options(
+            scheduling_strategy=pg.bundle_strategy(i)).remote()
+        for i in range(2)], timeout=60)
+    assert len(set(pids)) == 2  # one per host
+
+    @rt.remote(num_cpus=0, resources={"TPU-v5e-16-head": 1})
+    def coordinator():
+        return "coord"
+
+    assert rt.get(coordinator.remote(), timeout=60) == "coord"
+    rt.remove_placement_group(pg)

@@ -71,3 +71,30 @@ def test_node_registration_survives_restart(restartable_cluster):
     assert rt.get(ping.remote(), timeout=60) == "ok"
     view = cluster._cluster_view()
     assert any(v.get("alive") for v in view.values())
+
+
+def test_gcs_mutation_replay_dedupe(restartable_cluster):
+    """A replayed mutation (same req_id through the dedup envelope, as the
+    client's ConnectionLost retry sends) must not execute twice (ADVICE r2
+    #2: kv_put overwrite=False is not idempotent)."""
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    req = ("dedupe-req-1", "kv_put", ("default", "dd_key", b"v1", False))
+    assert cw.io.run(cw.gcs.conn.call("dedup_call", req)) is True
+    # replay: cached first outcome (True), NOT a re-execution (False)
+    assert cw.io.run(cw.gcs.conn.call("dedup_call", req)) is True
+    # a fresh req_id re-executes for real: key exists -> False
+    req2 = ("dedupe-req-2", "kv_put", ("default", "dd_key", b"v2", False))
+    assert cw.io.run(cw.gcs.conn.call("dedup_call", req2)) is False
+    assert cw.io.run(cw.gcs.kv_get("dd_key")) == b"v1"
+
+    # the dedup table survives a head restart (snapshot), so a replay
+    # that crosses the restart still dedupes
+    time.sleep(0.5)
+    restartable_cluster.kill_head(graceful=False)
+    restartable_cluster.restart_head()
+    time.sleep(2.0)
+    assert cw.io.run(cw.gcs.conn.call("dedup_call", req),
+                     timeout=30) is True
+    assert cw.io.run(cw.gcs.kv_get("dd_key"), timeout=30) == b"v1"

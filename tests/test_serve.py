@@ -269,3 +269,41 @@ applications:
         assert deps[0]["num_replicas"] == 2
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_rolling_replace_drains_inflight(serve_cluster):
+    """Version replace must not kill replicas mid-request: old replicas
+    leave the routing table immediately but drain in-flight requests
+    (ADVICE r2 #5; ref deployment_state.py graceful replica stop)."""
+    import threading
+
+    @serve.deployment
+    class Slow:
+        def __init__(self, version):
+            self.version = version
+
+        def __call__(self, delay):
+            time.sleep(delay)
+            return self.version
+
+    h1 = serve.run(Slow.bind("v1"), name="roll")
+    assert h1.remote(0).result(timeout=30) == "v1"
+
+    result = {}
+
+    def long_request():
+        try:
+            result["value"] = h1.remote(3.0).result(timeout=60)
+        except Exception as e:  # pragma: no cover - the failure mode
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=long_request)
+    t.start()
+    time.sleep(0.5)  # request is in flight on the v1 replica
+
+    h2 = serve.run(Slow.bind("v2"), name="roll")
+    # new requests land on the new version
+    assert h2.remote(0).result(timeout=30) == "v2"
+    # the in-flight v1 request completes instead of dying with the replica
+    t.join(timeout=60)
+    assert result.get("value") == "v1", result

@@ -1,0 +1,92 @@
+"""TP-sharded LLM serving (BASELINE config #5 shape, on the CPU mesh):
+engine batching/parity + Serve deployment streaming (ref analog:
+serve/_private/replica.py:750 + response streaming; the engine itself is
+TPU-native, no reference equivalent)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.models import llama
+from ray_tpu.serve.llm import LLMEngine
+
+
+def _collect(engine, tokens, **kw):
+    async def run():
+        return [t async for t in engine.generate(tokens, **kw)]
+    return asyncio.run(run())
+
+
+def test_engine_greedy_matches_unbatched_decode():
+    """Batched left-padded generation must equal a plain single-sequence
+    greedy decode with the same params."""
+    eng = LLMEngine("debug", tp=2, max_batch=4, batch_window_s=0.01)
+    cfg = eng.cfg
+    prompt = [5, 9, 11, 42, 7]
+    got = _collect(eng, prompt, max_new_tokens=8)
+
+    # plain reference decode: no padding, batch 1
+    params = jax.device_get(eng.params)
+    cache = llama.init_kv_cache(cfg, 1, max_len=cfg.max_seq_len)
+    toks = np.asarray([prompt], np.int32)
+    logits, cache = llama.decode_step(params, cache, toks, cfg)
+    want = []
+    for _ in range(8):
+        nxt = int(np.argmax(np.asarray(logits)[0]))
+        want.append(nxt)
+        logits, cache = llama.decode_step(
+            params, cache, np.asarray([[nxt]], np.int32), cfg)
+    assert got == want
+
+
+def test_engine_batches_concurrent_requests():
+    eng = LLMEngine("debug", tp=2, max_batch=4, batch_window_s=0.05)
+
+    async def run():
+        outs = await asyncio.gather(*[
+            _agen_list(eng.generate([3 + i, 8, 1], max_new_tokens=5))
+            for i in range(3)])
+        return outs
+
+    outs = asyncio.run(run())
+    assert all(len(o) == 5 for o in outs)
+    # the three concurrent requests shared at most 2 engine batches
+    assert eng.batches <= 2
+    # different prompts may produce different streams; each is deterministic
+    again = _collect(eng, [3, 8, 1], max_new_tokens=5)
+    assert again == outs[0]
+
+
+async def _agen_list(agen):
+    return [t async for t in agen]
+
+
+def test_engine_respects_per_request_lengths_and_eos():
+    eng = LLMEngine("debug", tp=2, max_batch=4, batch_window_s=0.05)
+
+    async def run():
+        a, b = await asyncio.gather(
+            _agen_list(eng.generate([1, 2, 3], max_new_tokens=2)),
+            _agen_list(eng.generate([9, 9], max_new_tokens=7)))
+        return a, b
+
+    a, b = asyncio.run(run())
+    assert len(a) == 2
+    assert len(b) == 7
+
+
+def test_llm_serve_app_streams_tokens(local_cluster):
+    try:
+        app = __import__("ray_tpu.serve.llm", fromlist=["llm_app"]).llm_app(
+            "debug", tp=2, max_batch=4)
+        h = serve.run(app, name="llm")
+        items = list(h.options(stream=True).remote(
+            {"tokens": [4, 8, 15], "max_new_tokens": 6}))
+        assert len(items) == 6
+        assert all(isinstance(d["token"], int) for d in items)
+    finally:
+        serve.shutdown()

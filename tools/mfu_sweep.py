@@ -1,0 +1,76 @@
+"""On-chip MFU sweep: try bench configs in ONE process, print a table.
+
+Usage: python tools/mfu_sweep.py  (expects a live TPU backend)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import llama
+from ray_tpu.parallel.mesh import build_mesh
+from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+PEAK = 197e12  # v5e bf16
+
+
+def measure(preset: str, batch: int, seq: int, remat: bool,
+            mu_dtype=None, steps: int = 15, attn="flash") -> dict:
+    cfg = llama.config_for(preset, max_seq_len=seq, remat=remat,
+                           attn_impl=attn)
+    mesh = build_mesh({"data": 1}, jax.devices()[:1])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, mu_dtype=mu_dtype)
+    step, state = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, params,
+        llama.param_logical_axes(cfg), mesh)
+    del params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    data = shard_batch(data, mesh)
+    state, aux = step(state, data)
+    float(aux["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, aux = step(state, data)
+    float(aux["loss"])
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * steps / dt
+    mfu = tok_s * cfg.flops_per_token() / PEAK
+    del state
+    return {"tok_s": round(tok_s, 1), "mfu": round(mfu, 4)}
+
+
+def main():
+    configs = [
+        dict(preset="410m", batch=8, seq=2048, remat=True),
+        dict(preset="410m", batch=8, seq=2048, remat=False),
+        dict(preset="410m", batch=16, seq=2048, remat=True),
+        dict(preset="410m", batch=16, seq=2048, remat=False),
+        dict(preset="410m", batch=32, seq=2048, remat=True),
+        dict(preset="1b", batch=8, seq=2048, remat=True,
+             mu_dtype=jnp.bfloat16),
+        dict(preset="1b", batch=16, seq=2048, remat=True,
+             mu_dtype=jnp.bfloat16),
+    ]
+    for c in configs:
+        label = {k: (str(v) if k == "mu_dtype" else v)
+                 for k, v in c.items()}
+        try:
+            r = measure(**c)
+        except Exception as e:
+            print(json.dumps({"cfg": label,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+            continue
+        print(json.dumps({"cfg": label, **r}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

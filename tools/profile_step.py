@@ -1,0 +1,67 @@
+"""Capture a device profile of the bench train step and print top HLO ops.
+
+Usage: python tools/profile_step.py [preset batch seq]
+"""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+    preset = sys.argv[1] if len(sys.argv) > 1 else "410m"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    seq = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+
+    cfg = llama.config_for(
+        preset, max_seq_len=seq, remat=True,
+        remat_save_attn=os.environ.get("RAYT_BENCH_SAVE_ATTN", "0") == "1",
+        attn_impl=os.environ.get("RAYT_BENCH_ATTN", "flash"))
+    mesh = build_mesh({"data": 1}, jax.devices()[:1])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    step, state = build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(3e-4), params,
+        llama.param_logical_axes(cfg), mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    data = shard_batch({"tokens": tokens,
+                        "targets": jnp.roll(tokens, -1, 1)}, mesh)
+    state, aux = step(state, data)
+    float(aux["loss"])
+
+    logdir = "/tmp/rayt_prof"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        for _ in range(3):
+            state, aux = step(state, data)
+        float(aux["loss"])
+
+    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", paths, file=sys.stderr)
+    if not paths:
+        print("NO TRACE CAPTURED")
+        return
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    data_out, _ = raw_to_tool_data.xspace_to_tool_data(
+        paths, "framework_op_stats", {})
+    out = f"{logdir}/op_stats.csv"
+    with open(out, "wb") as f:
+        f.write(data_out if isinstance(data_out, bytes)
+                else data_out.encode())
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()
