@@ -147,6 +147,11 @@ def detect_tpu_slice(env: Optional[dict] = None,
         total = int(accel.split("-")[-1])
     except ValueError:
         total = chips_on_host or _count_devfs_chips() or 1
+    else:
+        if gen in ("v2", "v3", "v4", "v5p"):
+            # those accelerator-type suffixes count TensorCores (2/chip),
+            # not chips (ref tpu.py halves for pre-v5e generations)
+            total = max(1, total // 2)
     per_host = _CHIPS_PER_HOST.get(gen, 4)
     num_workers = max(1, -(-total // per_host))
     if hostnames:

@@ -43,6 +43,9 @@ class Config:
     node_death_timeout_s: float = 10.0
     # ---- scheduler ----
     lease_timeout_s: float = 30.0
+    # GCS gives up placing a PENDING actor after this (ref: actor
+    # scheduling; raise on oversubscribed hosts where fleet boot is slow)
+    actor_scheduling_deadline_s: float = 300.0
     worker_startup_timeout_s: float = 60.0
     # Keep a granted lease (worker + resources) cached for this long after
     # a task finishes so back-to-back tasks with the same resource shape

@@ -29,6 +29,9 @@ from typing import Any, Awaitable, Callable
 import msgpack
 
 from ray_tpu._internal.config import get_config
+from ray_tpu._internal.logging_utils import setup_logger
+
+logger = setup_logger("rpc")
 from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
 
 REQUEST, RESPONSE, ERROR, NOTIFY = 0, 1, 2, 3
@@ -135,6 +138,7 @@ class Connection:
         except Exception:
             pass
         self._msgid = itertools.count(1)
+        self.close_reason = ""
         self._pending: dict[int, asyncio.Future] = {}
         self._notify_handlers: dict[str, Callable[[Any], None]] = {}
         self._closed = asyncio.Event()
@@ -184,10 +188,17 @@ class Connection:
                                 asyncio.ensure_future(res)
                         except Exception:
                             traceback.print_exc()
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self.close_reason = self.close_reason or repr(e)
         except asyncio.CancelledError:
-            pass
+            self.close_reason = self.close_reason or "cancelled"
+        except BaseException as e:  # diagnosis: NEVER silently drop a conn
+            self.close_reason = f"unexpected {type(e).__name__}: {e}"
+            logger.warning("rpc read loop died (%s): %s",
+                           self.peername(), self.close_reason)
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                self._teardown()
+                raise
         finally:
             self._teardown()
 
@@ -264,6 +275,10 @@ class Connection:
         self._notify_handlers[method] = handler
 
     async def close(self):
+        if not self.close_reason:
+            self.close_reason = "closed by:" + "|".join(
+                f"{f.name}@{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+                for f in traceback.extract_stack(limit=6)[:-1])
         if self._read_task is not None:
             self._read_task.cancel()
         self._teardown()

@@ -12,6 +12,14 @@ correctness-relevant option is worse than rejecting it):
   put it on sys.path.
 * ``py_modules``: list of local module directories/files shipped the same
   way and prepended to sys.path.
+* ``pip``: list of requirement strings (or {"packages": [...],
+  "pip_install_options": [...]}). Workers build a content-addressed venv
+  (``--system-site-packages`` so jax & friends stay visible) once per
+  unique requirement set, then splice its site-packages ahead of
+  sys.path for the task and export VIRTUAL_ENV/PATH so child processes
+  resolve the venv's interpreter (ref: _private/runtime_env/pip.py —
+  the reference launches dedicated workers from the venv interpreter;
+  pooled workers here splice import paths instead and restore after).
 """
 
 from __future__ import annotations
@@ -22,9 +30,12 @@ import os
 import sys
 import zipfile
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 KV_NAMESPACE = "runtime_env"
 _CACHE_ROOT = "/tmp/rayt_runtime_env"
+_VENV_ROOT = os.path.join(_CACHE_ROOT, "venvs")
+# keep at most this many cached venvs (LRU by last-use mtime)
+_VENV_GC_KEEP = 8
 # skip bulky junk when zipping (ref: packaging.py excludes)
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
@@ -52,6 +63,20 @@ def validate(renv: dict) -> None:
         if not os.path.exists(m):
             raise ValueError(f"runtime_env['py_modules'] entry {m!r} does "
                              "not exist")
+    pip = renv.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            unknown = set(pip) - {"packages", "pip_install_options"}
+            if unknown:
+                raise ValueError(
+                    f"unsupported runtime_env['pip'] keys {sorted(unknown)}")
+            pkgs = pip.get("packages")
+        else:
+            pkgs = pip
+        if not isinstance(pkgs, (list, tuple)) or not all(
+                isinstance(p, str) for p in pkgs):
+            raise TypeError("runtime_env['pip'] must be a list of "
+                            "requirement strings or {'packages': [...]}")
 
 
 def _zip_path(path: str) -> bytes:
@@ -102,7 +127,97 @@ def package(renv: dict, kv_put) -> dict:
         mods.append((key, name, os.path.isdir(m)))
     if mods:
         spec["py_modules"] = mods
+    pip = renv.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            pkgs = sorted(pip.get("packages") or [])
+            opts = list(pip.get("pip_install_options") or [])
+        else:
+            pkgs, opts = sorted(pip), []
+        tag = hashlib.sha256(
+            repr((pkgs, opts, sys.version_info[:2])).encode()
+        ).hexdigest()[:16]
+        spec["pip"] = {"packages": pkgs, "options": opts, "hash": tag}
     return spec
+
+
+# ------------------------------------------------------------------ pip/venv
+def _venv_site_packages(venv_dir: str) -> str:
+    ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+    return os.path.join(venv_dir, "lib", ver, "site-packages")
+
+
+def ensure_pip_venv(pip_spec: dict) -> str:
+    """Build (or reuse) the cached venv for a pip spec; returns its path.
+
+    Content-addressed by (sorted requirements, options, py version); an
+    fcntl lock serializes concurrent workers building the same env, and a
+    ``.complete`` marker makes reuse O(1) (ref: pip.py's URI cache + GC).
+    """
+    import fcntl
+    import subprocess
+
+    venv_dir = os.path.join(_VENV_ROOT, pip_spec["hash"])
+    marker = os.path.join(venv_dir, ".complete")
+    if os.path.exists(marker):
+        os.utime(venv_dir)  # LRU touch
+        return venv_dir
+    os.makedirs(_VENV_ROOT, exist_ok=True)
+    lock_path = venv_dir + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return venv_dir
+            _gc_venvs(keep=_VENV_GC_KEEP - 1)
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 venv_dir],
+                check=True, capture_output=True, text=True)
+            py = os.path.join(venv_dir, "bin", "python")
+            cmd = ([py, "-m", "pip", "install", "--quiet",
+                    "--disable-pip-version-check"]
+                   + list(pip_spec.get("options") or [])
+                   + list(pip_spec["packages"]))
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                import shutil
+
+                shutil.rmtree(venv_dir, ignore_errors=True)
+                raise RuntimeError(
+                    f"pip install failed for runtime_env: {r.stderr[-2000:]}")
+            with open(marker, "w") as f:
+                f.write("ok")
+            return venv_dir
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+_VENV_GC_MIN_AGE_S = 3600.0
+
+
+def _gc_venvs(keep: int):
+    """Drop the oldest cached venvs beyond `keep` (LRU by mtime). Venvs
+    touched within the last hour are never collected — a running task may
+    still have the venv spliced into sys.path (mtime is refreshed on
+    every ensure), so only cold entries are safe to rmtree."""
+    import shutil
+    import time
+
+    try:
+        entries = [os.path.join(_VENV_ROOT, e) for e in os.listdir(_VENV_ROOT)
+                   if os.path.isdir(os.path.join(_VENV_ROOT, e))]
+    except OSError:
+        return
+    entries.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+    cutoff = time.time() - _VENV_GC_MIN_AGE_S
+    for stale in entries[keep:]:
+        try:
+            if os.path.getmtime(stale) > cutoff:
+                continue
+        except OSError:
+            pass
+        shutil.rmtree(stale, ignore_errors=True)
 
 
 def _extract(key: str, data: bytes, subdir: str | None) -> str:
@@ -139,3 +254,18 @@ def materialize(spec: dict, kv_get) -> None:
         os.chdir(root)
         if root not in sys.path:
             sys.path.insert(0, root)
+    pip_spec = spec.get("pip")
+    if pip_spec:
+        venv_dir = ensure_pip_venv(pip_spec)
+        site = _venv_site_packages(venv_dir)
+        if site not in sys.path:
+            sys.path.insert(0, site)
+        # child processes of the task resolve the venv interpreter
+        os.environ["VIRTUAL_ENV"] = venv_dir
+        os.environ["PATH"] = (os.path.join(venv_dir, "bin") + os.pathsep
+                              + os.environ.get("PATH", ""))
+        # a module imported under a previous env must not satisfy this
+        # env's import of the same distribution
+        import importlib
+
+        importlib.invalidate_caches()

@@ -1,0 +1,84 @@
+"""Task-event tracing: per-worker event buffer -> GCS ring -> Chrome
+trace export (ref analogs: src/ray/core_worker/task_event_buffer.cc,
+gcs/gcs_server/gcs_task_manager.h task-event store, and the
+`ray timeline` Chrome-trace exporter at scripts/scripts.py `timeline`).
+
+Workers record one event per executed task/actor-method (name, ids,
+wall-clock start/duration) into a bounded local buffer; a periodic flush
+ships them to the GCS, which keeps a bounded ring. `rayt timeline` (or
+`export_chrome_trace`) renders them as Chrome trace-viewer "X" events
+grouped by node (pid) and worker (tid).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+# local buffer bound: events beyond this are dropped (oldest kept — the
+# flush loop drains every second, so hitting it means a flood)
+_LOCAL_CAP = 4096
+
+
+class TaskEventBuffer:
+    def __init__(self, worker_hex: str, node_hex: str):
+        self.worker = worker_hex
+        self.node = node_hex
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, *, name: str, task_id: str, kind: str,
+               start_s: float, dur_s: float, ok: bool = True,
+               actor_id: str = ""):
+        ev = {
+            "name": name, "task_id": task_id, "kind": kind,
+            "worker": self.worker, "node": self.node,
+            "actor_id": actor_id, "ok": ok,
+            "ts_us": int(start_s * 1e6), "dur_us": int(dur_s * 1e6),
+        }
+        with self._lock:
+            if len(self._events) >= _LOCAL_CAP:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out, self._events = self._events, []
+            if self._dropped:
+                out.append({
+                    "name": f"<dropped {self._dropped} events>",
+                    "task_id": "", "kind": "meta", "worker": self.worker,
+                    "node": self.node, "actor_id": "", "ok": True,
+                    "ts_us": int(time.time() * 1e6), "dur_us": 0})
+                self._dropped = 0
+            return out
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """Chrome trace-viewer JSON (load via chrome://tracing / Perfetto)."""
+    trace_events: list[dict] = []
+    for ev in events:
+        trace_events.append({
+            "name": ev["name"],
+            "cat": ev.get("kind", "task"),
+            "ph": "X",
+            "ts": ev["ts_us"],
+            "dur": max(1, ev["dur_us"]),
+            "pid": f"node:{ev['node'][:8]}",
+            "tid": f"worker:{ev['worker'][:8]}",
+            "args": {"task_id": ev.get("task_id", ""),
+                     "actor_id": ev.get("actor_id", ""),
+                     "ok": ev.get("ok", True)},
+        })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(events: list[dict], path: str) -> int:
+    data = to_chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return len(data["traceEvents"])

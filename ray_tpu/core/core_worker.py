@@ -134,6 +134,12 @@ class CoreWorker:
         self.gcs: GcsClient | None = None
         self.node_conn: Connection | None = None
         self.worker_info: WorkerInfo | None = None
+        # task-event tracing (ref: task_event_buffer.cc); flushed to the
+        # GCS ring by _task_event_flush_loop, rendered by `rayt timeline`
+        from ray_tpu._internal.tracing import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(self.worker_id.hex(),
+                                           self.node_id.hex())
 
     # ------------------------------------------------------------ bootstrap
     def connect_cluster(self):
@@ -175,6 +181,7 @@ class CoreWorker:
                 asyncio.ensure_future(sub.on_actor_update(info))
 
         await self.gcs.subscribe(CH_ACTOR, on_actor_event)
+        asyncio.ensure_future(self._task_event_flush_loop())
         if self.mode == "worker":
             await self.node_conn.call(
                 "register_worker", (self.worker_info, os.getpid()))
@@ -888,8 +895,10 @@ class CoreWorker:
 
         from ray_tpu._internal import runtime_env as renv_mod
 
-        saved_env = {k: os.environ.get(k)
-                     for k in (spec.runtime_env.get("env_vars") or {})}
+        saved_keys = list(spec.runtime_env.get("env_vars") or {})
+        if spec.runtime_env.get("pip"):
+            saved_keys += ["VIRTUAL_ENV", "PATH"]  # venv splice reverts too
+        saved_env = {k: os.environ.get(k) for k in saved_keys}
         saved_cwd = os.getcwd()
         saved_path = list(sys.path)
 
@@ -910,6 +919,15 @@ class CoreWorker:
             except OSError:
                 pass
             sys.path[:] = saved_path
+            if spec.runtime_env.get("pip"):
+                # modules imported from the venv must not satisfy later
+                # imports on this pooled worker (sys.modules outlives the
+                # sys.path splice)
+                venv_root = renv_mod._VENV_ROOT
+                for name, mod in list(sys.modules.items()):
+                    f = getattr(mod, "__file__", None) or ""
+                    if f.startswith(venv_root):
+                        del sys.modules[name]
 
         return restore
 
@@ -1360,6 +1378,16 @@ class CoreWorker:
             self.executor, self._execute_task, spec)
 
     def _execute_task(self, spec: TaskSpec):
+        t_wall, t0 = time.time(), time.perf_counter()
+        out = self._execute_task_body(spec)
+        self.task_events.record(
+            name=spec.name or "task", task_id=spec.task_id.hex(),
+            kind="task", start_s=t_wall, dur_s=time.perf_counter() - t0,
+            ok=not (isinstance(out, tuple) and out
+                    and out[0] == "task_error"))
+        return out
+
+    def _execute_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
         restore_env = None
         try:
@@ -1525,6 +1553,18 @@ class CoreWorker:
         return self._resolve_args(args)
 
     def _execute_actor_task(self, spec: TaskSpec):
+        t_wall, t0 = time.time(), time.perf_counter()
+        out = self._execute_actor_task_body(spec)
+        self.task_events.record(
+            name=spec.method_name or "actor_task",
+            task_id=spec.task_id.hex(), kind="actor_task",
+            actor_id=self.actor_id.hex() if self.actor_id else "",
+            start_s=t_wall, dur_s=time.perf_counter() - t0,
+            ok=not (isinstance(out, tuple) and out
+                    and out[0] == "task_error"))
+        return out
+
+    def _execute_actor_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
         try:
             if self.actor_instance is None:
@@ -1543,6 +1583,19 @@ class CoreWorker:
             return ("task_error", serialize_to_bytes(e), traceback.format_exc())
         finally:
             self._exec_ctx.task_id = None
+
+    async def _task_event_flush_loop(self):
+        """Ship buffered task events to the GCS ring every second (ref:
+        task_event_buffer.cc periodic flush to gcs_task_manager)."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            events = self.task_events.drain()
+            if not events:
+                continue
+            try:
+                await self.gcs.call("add_task_events", events)
+            except Exception:
+                pass  # dropped on GCS hiccup: tracing is best-effort
 
     def rpc_exit_worker(self, conn, arg=None):
         def _die():

@@ -64,9 +64,11 @@ class GcsServer:
         # at-most-once envelope for client-retried mutations: req_id ->
         # ("ok", result) | ("err", msg); bounded LRU, snapshotted so a
         # replay across a GCS restart still dedupes
-        from collections import OrderedDict
+        from collections import OrderedDict, deque
         self._dedup_results: OrderedDict[str, tuple] = OrderedDict()
         self._dedup_inflight: dict[str, asyncio.Future] = {}
+        # task-event ring for `rayt timeline` (ref: gcs_task_manager.h)
+        self._task_events: deque = deque(maxlen=50_000)
         # channel -> set of subscribed connections
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
@@ -382,10 +384,11 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
-        self.node_conns.pop(node_id, None)
+        conn = self.node_conns.pop(node_id, None)
         self.node_resources_available.pop(node_id, None)
         self.mark_dirty()
-        logger.warning("node %s lost", node_id)
+        logger.warning("node %s lost (conn: %s)", node_id,
+                       getattr(conn, "close_reason", "") or "untracked")
         await self.publish(CH_NODE, {"event": "removed", "node": info})
         # Fail over actors on this node (restart if budget remains).
         for actor in list(self.actors.values()):
@@ -495,7 +498,10 @@ class GcsServer:
         # placement check only: zero-resource actors still target a node
         # with a CPU free (they hold nothing once placed)
         demand = dict(spec.resources) or {"CPU": 1.0}
-        deadline = time.monotonic() + 300.0
+        from ray_tpu._internal.config import get_config
+
+        deadline = time.monotonic() + \
+            get_config().actor_scheduling_deadline_s
         while time.monotonic() < deadline:
             if info.state == ActorState.DEAD:
                 return  # killed while pending placement
@@ -790,6 +796,14 @@ class GcsServer:
         except Exception:
             pass
 
+    def rpc_add_task_events(self, conn, events: list):
+        """Bounded task-event ring (ref: gcs_task_manager.h event store)."""
+        self._task_events.extend(events)
+        return True
+
+    def rpc_get_task_events(self, conn, arg=None):
+        return list(self._task_events)
+
     def rpc_metrics_snapshot(self, conn, arg=None):
         store = getattr(self, "metrics_store", {})
         return [
@@ -923,7 +937,7 @@ class GcsClient:
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU
-        "report_task_demand",
+        "report_task_demand", "add_task_events",
         # conn-bound: GCS stores the calling connection for death
         # detection, so the retry MUST re-execute on the new connection
         # (re-registration is idempotent on the tables)

@@ -167,6 +167,7 @@ class _PullManager:
                     return True
                 sem = asyncio.Semaphore(
                     max(1, cfg.object_transfer_max_inflight_chunks))
+                write_futs: list = []
 
                 async def fetch(i: int, off: int):
                     async with sem:
@@ -176,12 +177,28 @@ class _PullManager:
                             timeout=120)
                         if d is None:
                             raise LookupError(f"chunk {i} of {oid} missing")
-                        await loop.run_in_executor(
+                        f = loop.run_in_executor(
                             None, self.nm.shm.write_at, oid, off, d)
+                        write_futs.append(f)
+                        await f
 
-                await asyncio.gather(
-                    *(fetch(i, off)
-                      for i, off in enumerate(range(0, size, chunk))))
+                tasks = [asyncio.ensure_future(fetch(i, off))
+                         for i, off in enumerate(range(0, size, chunk))]
+                try:
+                    await asyncio.gather(*tasks)
+                except BaseException:
+                    # sibling fetches may still be writing into the
+                    # segment; every started executor write MUST finish
+                    # before the abort path frees it (a write into a
+                    # freed+reallocated arena block would corrupt another
+                    # object). Cancelling a task abandons its await, not
+                    # the thread job — drain write_futs explicitly.
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    await asyncio.gather(*write_futs,
+                                         return_exceptions=True)
+                    raise
                 await loop.run_in_executor(
                     None, self.nm._finish_pull_segment, oid, size, owner)
                 created = False  # sealed: no abort on close path
@@ -308,10 +325,13 @@ class NodeManager:
         node so a persistence-backed head rebuilds its live view (ref:
         python/ray/tests/test_gcs_fault_tolerance.py semantics)."""
         try:
+            old = self.gcs_conn
             self.gcs_conn = await connect(self.gcs_address.host,
                                           self.gcs_address.port,
                                           handlers=self.server.handlers,
                                           retries=2)
+            if old is not None and not old.closed:
+                await old.close()
             info = NodeInfo(
                 node_id=self.node_id, address=self.address,
                 resources_total=dict(self.resources_total),

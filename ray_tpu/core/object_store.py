@@ -19,6 +19,7 @@ move over ICI via the mesh/collective layer. This store is for host objects.
 from __future__ import annotations
 
 import asyncio
+import os
 from multiprocessing import shared_memory, resource_tracker
 from typing import Any
 
@@ -132,6 +133,14 @@ class ShmObjectStore:
         return size
 
     # --------------------------------------------------- streaming creates
+    @staticmethod
+    def _unsealed_marker(object_id: ObjectID) -> str:
+        # cross-process visibility: the native arena keeps kCreating state
+        # in the shared header; this fallback store marks in-progress
+        # writes with a sibling file so OTHER processes' contains_locally
+        # can't attach a half-written segment by name
+        return f"/dev/shm/{_shm_name(object_id)}.unsealed"
+
     def create_unsealed(self, object_id: ObjectID, size: int) -> bool:
         """Allocate an object to be filled by write_at + seal. False if
         the object already exists (created or being created elsewhere)."""
@@ -141,6 +150,11 @@ class ShmObjectStore:
         except FileExistsError:
             return False
         _unregister_tracker(shm)
+        try:
+            with open(self._unsealed_marker(object_id), "w"):
+                pass
+        except OSError:
+            pass
         self._unsealed.add(object_id)
         self._open[object_id] = shm
         return True
@@ -152,9 +166,17 @@ class ShmObjectStore:
 
     def seal(self, object_id: ObjectID, hold: bool = False):
         self._unsealed.discard(object_id)
+        try:
+            os.remove(self._unsealed_marker(object_id))
+        except OSError:
+            pass
 
     def abort_unsealed(self, object_id: ObjectID):
         self._unsealed.discard(object_id)
+        try:
+            os.remove(self._unsealed_marker(object_id))
+        except OSError:
+            pass
         shm = self._open.pop(object_id, None)
         if shm is not None:
             try:
@@ -177,6 +199,8 @@ class ShmObjectStore:
             return False
         if object_id in self._open:
             return True
+        if os.path.exists(self._unsealed_marker(object_id)):
+            return False  # another process is still writing it
         try:
             shm = shared_memory.SharedMemory(name=_shm_name(object_id))
             _unregister_tracker(shm)

@@ -141,9 +141,42 @@ class StreamingExecutor:
 
     # --------------------------------------------------------- all-to-all
     def repartition(self, refs: list, n: int) -> list:
-        blocks = rt.get(list(refs))
-        all_rows = concat_blocks(blocks)
-        return [rt.put(b) for b in split_block(all_rows, n)]
+        """Distributed repartition: count -> per-block slice tasks ->
+        per-output concat tasks. No block ever lands on the driver (ref:
+        data/_internal/planner/exchange/ split+merge task pattern)."""
+        m = len(refs)
+        if m == 0:
+            return [rt.put([]) for _ in range(n)]
+
+        def count(block: Block) -> int:
+            return len(block_rows(block))
+
+        count_task = rt.remote(num_cpus=0)(count)
+        counts = rt.get([count_task.remote(r) for r in refs])
+        total = sum(counts)
+        # global row range of output partition j: [j*total//n, (j+1)*...)
+        bounds = [(j * total) // n for j in range(n + 1)]
+        offsets = [0]
+        for c in counts:
+            offsets.append(offsets[-1] + c)
+
+        def slice_block(block: Block, start: int, cuts: list) -> list:
+            rows = block_rows(block)
+            return [rows[max(0, lo - start):max(0, hi - start)]
+                    for lo, hi in cuts]
+
+        slice_task = rt.remote(num_cpus=1, num_returns=n)(slice_block)
+        parts = []
+        for i, ref in enumerate(refs):
+            cuts = [(bounds[j], bounds[j + 1]) for j in range(n)]
+            result = slice_task.remote(ref, offsets[i], cuts)
+            parts.append(result if isinstance(result, list) else [result])
+
+        def merge(*shards: Block) -> Block:
+            return concat_blocks(shards)
+
+        merge_task = rt.remote(num_cpus=1)(merge)
+        return [merge_task.remote(*[p[j] for p in parts]) for j in range(n)]
 
     def random_shuffle(self, refs: list, seed: Optional[int] = None) -> list:
         """Distributed shuffle: map each block into N shards, then N
@@ -177,9 +210,76 @@ class StreamingExecutor:
         return out
 
     def sort(self, refs: list, key: Callable, descending: bool) -> list:
-        blocks = rt.get(list(refs))
-        rows = block_rows(concat_blocks(blocks))
-        rows = list(rows)
-        rows.sort(key=key, reverse=descending)
+        """Distributed sample sort (ref: planner/exchange/sort_task_spec.py
+        TaskBasedShuffle): per-block local sort + key sampling, driver sees
+        ONLY the samples (tiny), range-partition tasks split each sorted
+        block at the sample quantiles, merge tasks heapq-merge shards."""
         n = max(1, len(refs))
-        return [rt.put(b) for b in split_block(rows, n)]
+        if not refs:
+            return []
+
+        def sort_and_sample(block: Block, s: int) -> tuple:
+            rows = sorted(block_rows(block), key=key, reverse=descending)
+            step = max(1, len(rows) // s)
+            return rows, [key(r) for r in rows[::step]]
+
+        sas_task = rt.remote(num_cpus=1, num_returns=2)(sort_and_sample)
+        sorted_refs, sample_refs = [], []
+        for ref in refs:
+            b, s = sas_task.remote(ref, 16)
+            sorted_refs.append(b)
+            sample_refs.append(s)
+        samples = sorted(
+            (x for sub in rt.get(sample_refs) for x in sub),
+            reverse=descending)
+        if not samples:  # every block empty
+            return sorted_refs
+        # n-1 partition boundaries at the sample quantiles
+        bounds = [samples[(len(samples) * j) // n] for j in range(1, n)] \
+            if samples else []
+
+        def partition(rows: Block, bounds: list) -> list:
+            import bisect
+
+            keys = [key(r) for r in rows]
+            if descending:  # bisect needs ascending; flip
+                keys = [_Neg(k) for k in keys]
+                bounds = [_Neg(b) for b in bounds]
+            shards, lo = [], 0
+            for b in bounds:
+                hi = bisect.bisect_right(keys, b, lo)
+                shards.append(rows[lo:hi])
+                lo = hi
+            shards.append(rows[lo:])
+            return shards
+
+        part_task = rt.remote(num_cpus=1, num_returns=n)(partition)
+        parts = []
+        for ref in sorted_refs:
+            result = part_task.remote(ref, bounds)
+            parts.append(result if isinstance(result, list) else [result])
+
+        def merge(*shards: Block) -> Block:
+            import heapq
+
+            return list(heapq.merge(
+                *[block_rows(s) for s in shards], key=key,
+                reverse=descending))
+
+        merge_task = rt.remote(num_cpus=1)(merge)
+        return [merge_task.remote(*[p[j] for p in parts]) for j in range(n)]
+
+
+class _Neg:
+    """Order-reversing key wrapper for descending range partitioning."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v

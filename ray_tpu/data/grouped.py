@@ -56,20 +56,20 @@ class GroupedData:
         return [combine_task.remote(*[p[j] for p in parts])
                 for j in range(n)]
 
-    def _grouped_rows(self, ref) -> dict[Any, Block]:
-        groups: dict[Any, Block] = {}
-        for row in iter_rows(rt.get(ref)):
-            groups.setdefault(row[self._key], []).append(row)
-        return groups
-
     def aggregate(self, **named_aggs: tuple[str, Callable]):
         """named_aggs: out_col=(in_col, reducer over list of values).
-        Returns a Dataset of one row per group."""
+        Returns a Dataset of one row per group. Aggregation runs as one
+        task per partition — partitions never land on the driver, so the
+        group stage scales past one node's store (ref: planner/exchange
+        reduce-side aggregation)."""
         from ray_tpu.data.dataset import Dataset
 
         key = self._key
 
-        def agg_partition(groups: dict[Any, Block]) -> Block:
+        def agg_partition(part: Block) -> Block:
+            groups: dict[Any, Block] = {}
+            for row in iter_rows(part):
+                groups.setdefault(row[key], []).append(row)
             out: Block = []
             for gkey, rows in groups.items():
                 row = {key: gkey}
@@ -78,9 +78,8 @@ class GroupedData:
                 out.append(row)
             return out
 
-        out_refs = [rt.put(agg_partition(self._grouped_rows(ref)))
-                    for ref in self._partitions()]
-        return Dataset(out_refs)
+        agg_task = rt.remote(num_cpus=1)(agg_partition)
+        return Dataset([agg_task.remote(ref) for ref in self._partitions()])
 
     def count(self):
         return self.aggregate(count=(self._key, len))
@@ -101,13 +100,18 @@ class GroupedData:
     def map_groups(self, fn: Callable):
         from ray_tpu.data.dataset import Dataset
 
-        def apply(groups: dict[Any, Block]) -> Block:
+        key = self._key
+
+        def apply(part: Block) -> Block:
+            groups: dict[Any, Block] = {}
+            for row in iter_rows(part):
+                groups.setdefault(row[key], []).append(row)
             out: Block = []
             for _, rows in groups.items():
                 result = fn(rows)
                 out.extend(result if isinstance(result, list) else [result])
             return out
 
-        out_refs = [rt.put(apply(self._grouped_rows(ref)))
-                    for ref in self._partitions()]
-        return Dataset(out_refs)
+        apply_task = rt.remote(num_cpus=1)(apply)
+        return Dataset([apply_task.remote(ref)
+                        for ref in self._partitions()])

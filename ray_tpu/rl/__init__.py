@@ -11,3 +11,5 @@ from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.impala import (IMPALA, AggregatorActor,  # noqa: F401
                                IMPALAConfig, IMPALALearner)
 from ray_tpu.rl.vtrace import vtrace  # noqa: F401
+from ray_tpu.rl.dqn import DQN, DQNConfig, DQNRunner  # noqa: F401
+from ray_tpu.rl.replay import ReplayBuffer  # noqa: F401

@@ -49,6 +49,11 @@ class IMPALAConfig:
     train_batch_size: int = 1024
     max_requests_in_flight: int = 2
     broadcast_interval: int = 1     # learner updates between broadcasts
+    boot_wave: int = 0              # stagger runner creation (0 = all at once)
+    # RPC budget for control-plane calls (aggregate/learner/broadcast):
+    # raise on oversubscribed hosts where a saturated core stretches
+    # actor-call latency far past the defaults
+    call_timeout_s: float = 120.0
     seed: int = 0
 
     def build(self) -> "IMPALA":
@@ -202,10 +207,25 @@ class IMPALA:
         cfg_blob = cloudpickle.dumps(config)
 
         runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
-        self._runners = FaultTolerantActorManager([
-            runner_cls.remote(config.env, config.num_envs_per_runner,
-                              config.seed + i, module_blob)
-            for i in range(config.num_env_runners)])
+        runners = []
+        wave = config.boot_wave or config.num_env_runners
+        for lo in range(0, config.num_env_runners, wave):
+            batch = [
+                runner_cls.remote(config.env, config.num_envs_per_runner,
+                                  config.seed + i, module_blob)
+                for i in range(lo, min(lo + wave, config.num_env_runners))]
+            if config.boot_wave:
+                # stagger fleet boot: each wave's workers finish importing
+                # before the next spawns (a 256-runner gang booting at
+                # once floods worker startup on small hosts; ref analog:
+                # worker-pool prestart throttling in the raylet)
+                for r in batch:
+                    try:
+                        rt.get(r.ping.remote(), timeout=900)
+                    except Exception:
+                        pass  # FaultTolerantActorManager handles stragglers
+            runners.extend(batch)
+        self._runners = FaultTolerantActorManager(runners)
         agg_cls = rt.remote(num_cpus=1)(AggregatorActor)
         self._aggregators = [agg_cls.remote()
                              for _ in range(config.num_aggregators)]
@@ -213,7 +233,8 @@ class IMPALA:
         self._learner = learner_cls.remote(module_blob, cfg_blob,
                                            config.seed)
         self._weights_ref = rt.put(
-            rt.get(self._learner.get_weights.remote(), timeout=120))
+            rt.get(self._learner.get_weights.remote(),
+                   timeout=self.config.call_timeout_s))
         self._runners.foreach(
             lambda a: a.set_weights.remote(self._weights_ref))
         self._inflight: dict = {}   # sample ref -> runner
@@ -241,7 +262,7 @@ class IMPALA:
         t0 = time.perf_counter()
         aux_last: dict = {}
         updates = 0
-        deadline = time.monotonic() + 120.0
+        deadline = time.monotonic() + 4 * cfg.call_timeout_s
         while updates == 0 and time.monotonic() < deadline:
             self._pump_runners()
             if not self._inflight:
@@ -257,7 +278,7 @@ class IMPALA:
                 self._agg_rr += 1
                 try:
                     batch = rt.get(agg.add.remote(ref, cfg.train_batch_size),
-                                   timeout=60)
+                                   timeout=cfg.call_timeout_s)
                 except Exception:
                     self._runners.probe_unhealthy()
                     continue
@@ -270,7 +291,7 @@ class IMPALA:
                 T, B = batch["rewards"].shape
                 self._total_steps += T * B
                 aux_last = rt.get(self._learner.update.remote(batch),
-                                  timeout=300)
+                                  timeout=max(300.0, cfg.call_timeout_s))
                 updates += 1
                 self._updates_since_broadcast += 1
             if self._updates_since_broadcast >= cfg.broadcast_interval:
@@ -288,7 +309,8 @@ class IMPALA:
 
     def _broadcast_weights(self):
         self._weights_ref = rt.put(
-            rt.get(self._learner.get_weights.remote(), timeout=120))
+            rt.get(self._learner.get_weights.remote(),
+                   timeout=self.config.call_timeout_s))
         self._runners.foreach(
             lambda a: a.set_weights.remote(self._weights_ref))
         self._updates_since_broadcast = 0
@@ -299,7 +321,8 @@ class IMPALA:
         import pickle
 
         os.makedirs(path, exist_ok=True)
-        weights = rt.get(self._learner.get_weights.remote(), timeout=120)
+        weights = rt.get(self._learner.get_weights.remote(),
+                         timeout=self.config.call_timeout_s)
         with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
             pickle.dump({"weights": weights, "iteration": self._iteration,
                          "config": self.config}, f)
@@ -313,7 +336,7 @@ class IMPALA:
             state = pickle.load(f)
         self._iteration = state["iteration"]
         rt.get(self._learner.set_weights.remote(state["weights"]),
-               timeout=120)
+               timeout=self.config.call_timeout_s)
         self._broadcast_weights()
 
     def stop(self):

@@ -178,6 +178,17 @@ def cmd_list(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_timeline(args):
+    """Chrome-trace export of the GCS task-event ring (ref analog:
+    `ray timeline`, scripts/scripts.py)."""
+    from ray_tpu import state_api
+
+    _attach(args)
+    n = state_api.export_timeline(args.out)
+    print(f"wrote {n} events to {args.out} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+
+
 def cmd_microbenchmark(args):
     import ray_tpu as rt
     from ray_tpu._internal.perf import run_microbenchmarks
@@ -279,6 +290,12 @@ def main(argv=None):
     sp.add_argument("--duration", type=float, default=2.0)
     sp.add_argument("--num-cpus", type=int)
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("timeline",
+                        help="export executed-task Chrome trace")
+    sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
 
     args = p.parse_args(argv)
     args.fn(args)

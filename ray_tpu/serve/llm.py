@@ -115,7 +115,15 @@ class LLMEngine:
     async def generate(self, tokens: list[int], *,
                        max_new_tokens: int = 32,
                        temperature: float = 0.0):
-        """Async generator of generated token ids."""
+        """Async generator of generated token ids. Raises ValueError for
+        prompts longer than the largest prefill bucket — silent front-
+        truncation would return plausible-but-wrong output."""
+        limit = max(self.prompt_buckets)
+        if len(tokens) > limit:
+            raise ValueError(
+                f"prompt is {len(tokens)} tokens; this engine's largest "
+                f"prefill bucket is {limit} (raise prompt_buckets / "
+                f"max_seq_len)")
         await self.ensure_started()
         req = _Request(list(tokens), int(max_new_tokens), float(temperature),
                        loop=asyncio.get_running_loop())

@@ -123,3 +123,16 @@ def summary() -> dict:
         "resources_total": total,
         "resources_available": avail,
     }
+
+
+def task_events() -> list[dict]:
+    """Raw task-event records from the GCS ring (ref: state API tasks)."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.conn.call("get_task_events"))
+
+
+def export_timeline(path: str) -> int:
+    """Write a Chrome trace of executed tasks (ref: `ray timeline`)."""
+    from ray_tpu._internal.tracing import export_chrome_trace
+
+    return export_chrome_trace(task_events(), path)

@@ -1,9 +1,12 @@
 """TuneController — event-driven trial lifecycle management (ref analog:
-python/ray/tune/execution/tune_controller.py:68).
+python/ray/tune/execution/tune_controller.py:68 + the PG-backed trial
+resources of tune/execution/placement_groups.py).
 
-Each trial runs in its own threaded actor (the same TrainWorker host used
-by ray_tpu.train, with world_size=1); the controller polls run futures,
-drains reported rows, and applies scheduler decisions (ASHA stops, PBT
+Each trial owns a WorkerGroup (the same actors ray_tpu.train uses): the
+default is one world_size=1 worker, but a ScalingConfig turns every
+trial into a multi-worker (placement-grouped, mesh-rendezvous'd)
+training run — tuning the multi-chip jobs this framework exists for.
+Rank 0's reported rows drive scheduler decisions (ASHA stops, PBT
 exploit/explore restarts from a donor checkpoint).
 """
 
@@ -18,7 +21,6 @@ from typing import Callable, Optional
 import cloudpickle
 
 import ray_tpu as rt
-from ray_tpu.train.worker_group import TrainWorker
 from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
 from ray_tpu.tune.trial import Trial, TrialStatus
 
@@ -29,7 +31,8 @@ class TuneController:
                  scheduler: Optional[FIFOScheduler],
                  experiment_path: str, experiment_name: str,
                  max_concurrent: int, max_failures_per_trial: int = 0,
-                 resources_per_trial: Optional[dict] = None):
+                 resources_per_trial: Optional[dict] = None,
+                 scaling_config=None, search_alg=None):
         self.trainable = trainable
         self.trials = trials
         self.metric = metric
@@ -39,12 +42,17 @@ class TuneController:
         self.experiment_name = experiment_name
         self.max_concurrent = max_concurrent
         self.max_failures = max_failures_per_trial
-        self.resources = resources_per_trial or {"CPU": 1}
+        from ray_tpu.train.config import ScalingConfig
+
+        if scaling_config is None:
+            scaling_config = ScalingConfig(
+                num_workers=1,
+                resources_per_worker=resources_per_trial or {"CPU": 1})
+        self.scaling = scaling_config
+        self.search_alg = search_alg
+        self._group_seq = 0
         if hasattr(self.scheduler, "set_population"):
             self.scheduler.set_population(self.trials)
-        from ray_tpu._internal.serialization import dumps_code
-
-        self._fn_blob = dumps_code(trainable)
         self._dirty = False
 
     # ------------------------------------------------------------------ run
@@ -89,31 +97,38 @@ class TuneController:
         return os.path.join(self.experiment_path, trial.trial_id)
 
     def _launch(self, trial: Trial, from_checkpoint: Optional[str] = None):
-        from ray_tpu.train.worker_group import actor_options_from_resources
+        from ray_tpu.train.worker_group import WorkerGroup
 
-        opts = actor_options_from_resources(self.resources)
-        actor = rt.remote(TrainWorker).options(**opts).remote()
-        trial.actor = actor  # set early: _stop_trial_actor reaps on failure
+        if self.search_alg is not None and trial.config is None:
+            trial.config = self.search_alg.suggest(trial.trial_id)
+        self._group_seq += 1
+        group = WorkerGroup(
+            self.scaling, None, self._trial_dir(trial),
+            f"{self.experiment_name}-{trial.trial_id}", self._group_seq)
+        trial.actor = group  # set early: _stop_trial_actor reaps on failure
         ckpt = from_checkpoint or trial.checkpoint_dir
-        rt.get(actor.setup.remote(
-            0, 1, self._trial_dir(trial), self.experiment_name, ckpt,
-            None, f"tune-{trial.trial_id}"), timeout=120)
-        trial.run_ref = actor.run.remote(self._fn_blob, trial.config)
+        group.start(ckpt)
+        trial.run_refs = group.run_async(self.trainable, trial.config)
+        trial.run_ref = trial.run_refs[0]
         trial.status = TrialStatus.RUNNING
         self._dirty = True
 
     def _stop_trial_actor(self, trial: Trial):
         if trial.actor is not None:
             try:
-                rt.kill(trial.actor)
+                trial.actor.shutdown()
             except Exception:
                 pass
         trial.actor = None
         trial.run_ref = None
+        trial.run_refs = None
 
     def _drain(self, running: list[Trial], pending: list[Trial]):
-        refs = {t.trial_id: t.actor.drain_results.remote()
-                for t in running if t.actor is not None}
+        # rank 0's reports drive scheduling (ref: tune reads the rank-0
+        # session; other ranks report for checkpoint sync only)
+        refs = {t.trial_id: t.actor.workers[0].drain_results.remote()
+                for t in running
+                if t.actor is not None and t.actor.workers}
         for trial in running:
             ref = refs.get(trial.trial_id)
             if ref is None:
@@ -140,6 +155,9 @@ class TuneController:
         if decision == STOP:
             self._stop_trial_actor(trial)
             trial.status = TrialStatus.TERMINATED
+            if self.search_alg is not None:
+                self.search_alg.on_trial_complete(trial.trial_id,
+                                                  trial.last_result)
             return
         instruction = self.scheduler.exploit_instruction(trial)
         if instruction is not None:
@@ -154,8 +172,11 @@ class TuneController:
     def _finish(self, trial: Trial, pending: list[Trial]):
         self._dirty = True
         try:
-            rt.get(trial.run_ref)
+            rt.get(list(trial.run_refs or [trial.run_ref]))
             trial.status = TrialStatus.TERMINATED
+            if self.search_alg is not None:
+                self.search_alg.on_trial_complete(trial.trial_id,
+                                                  trial.last_result)
         except Exception as e:
             trial.num_failures += 1
             if trial.num_failures <= self.max_failures:

@@ -27,6 +27,7 @@ class Trial:
     # runtime handles (not persisted)
     actor: Any = dataclasses.field(default=None, repr=False)
     run_ref: Any = dataclasses.field(default=None, repr=False)
+    run_refs: Any = dataclasses.field(default=None, repr=False)
     iteration: int = 0
 
     def metric(self, name: str) -> Optional[float]:

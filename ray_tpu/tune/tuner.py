@@ -24,6 +24,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[FIFOScheduler] = None
+    # sequential searcher (e.g. tune.TPESearcher); when set, trial
+    # configs are suggested at launch time instead of pre-expanded
+    search_alg: Optional[object] = None
     seed: Optional[int] = None
 
 
@@ -32,12 +35,17 @@ class Tuner:
                  tune_config: Optional[TuneConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  resources_per_trial: Optional[dict] = None,
+                 scaling_config=None,
                  _restored_trials: Optional[list[Trial]] = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
+        # a ScalingConfig makes every trial a multi-worker (PG-backed,
+        # mesh-rendezvous'd) training run (ref:
+        # tune/execution/placement_groups.py trial resources)
+        self.scaling_config = scaling_config
         self._restored_trials = _restored_trials
 
     def fit(self) -> ResultGrid:
@@ -48,6 +56,10 @@ class Tuner:
         os.makedirs(experiment_path, exist_ok=True)
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif tc.search_alg is not None:
+            trials = [Trial(trial_id=f"{i:05d}_{new_trial_id()}",
+                            config=None)
+                      for i in range(tc.num_samples)]
         else:
             variants = BasicVariantGenerator(
                 self.param_space, tc.num_samples, tc.seed).variants()
@@ -60,7 +72,9 @@ class Tuner:
             experiment_path=experiment_path, experiment_name=name,
             max_concurrent=max_concurrent,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
-            resources_per_trial=self.resources_per_trial)
+            resources_per_trial=self.resources_per_trial,
+            scaling_config=self.scaling_config,
+            search_alg=tc.search_alg)
         controller.run()
         return ResultGrid(trials, metric=tc.metric, mode=tc.mode,
                           experiment_path=experiment_path)

@@ -205,3 +205,49 @@ def test_impala_learns_cartpole(local_cluster):
         assert best >= 100.0, f"IMPALA failed to learn: best={best}"
     finally:
         algo.stop()
+
+
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rl.replay import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, seed=0)
+    buf.add({"x": np.arange(6, dtype=np.float32),
+             "a": np.arange(6, dtype=np.int32)})
+    assert buf.size() == 6
+    buf.add({"x": np.arange(6, 14, dtype=np.float32),
+             "a": np.arange(6, 14, dtype=np.int32)})
+    assert buf.size() == 10  # capacity-capped ring
+    s = buf.sample(4)
+    assert s["x"].shape == (4,) and s["a"].shape == (4,)
+    np.testing.assert_array_equal(s["x"].astype(np.int32), s["a"])
+    # the oldest entries (0..3) were overwritten by the wrap
+    many = buf.sample(10)
+    assert many["x"].min() >= 4.0
+    assert buf.sample(11) is None
+
+
+def test_dqn_learns_cartpole(local_cluster):
+    """Learning gate (ref: rllib tuned_examples --as-test thresholds)."""
+    from ray_tpu.rl.dqn import DQNConfig
+
+    algo = DQNConfig(
+        env="CartPole-v1", num_env_runners=2, num_envs_per_runner=8,
+        rollout_fragment_length=32, learning_starts=500,
+        train_batch_size=128, updates_per_iteration=48,
+        target_update_freq=50, epsilon_decay_steps=4000,
+        lr=1e-3, seed=0).build()
+    first, best = None, -1.0
+    try:
+        for i in range(70):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if ret is not None:
+                if first is None:
+                    first = ret
+                best = max(best, ret)
+            if best >= 120.0:
+                break
+    finally:
+        algo.stop()
+    assert first is not None, "no episodes completed"
+    assert best >= 120.0, f"DQN failed to learn: first={first} best={best}"

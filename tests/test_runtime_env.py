@@ -61,7 +61,7 @@ def test_working_dir_shipped(local_cluster, tmp_path):
 
 
 def test_unsupported_key_raises(local_cluster):
-    @rt.remote(runtime_env={"pip": ["requests"]})
+    @rt.remote(runtime_env={"container": {"image": "x"}})
     def f():
         return 1
 
@@ -76,3 +76,73 @@ def test_bad_env_vars_type_raises(local_cluster):
 
     with pytest.raises(TypeError):
         f.remote()
+
+
+def _build_wheel(dest_dir, name="testpkg_rayt", version="1.0"):
+    """Minimal local wheel so `pip install --no-index` works offline."""
+    import base64
+    import hashlib
+    import zipfile
+
+    dist = f"{name}-{version}.dist-info"
+    code = f'VERSION = "{version}"\n'
+    metadata = (f"Metadata-Version: 2.1\nName: {name}\n"
+                f"Version: {version}\n")
+    wheel_meta = ("Wheel-Version: 1.0\nGenerator: rayt-test\n"
+                  "Root-Is-Purelib: true\nTag: py3-none-any\n")
+
+    def rec(path, data):
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data.encode()).digest()).rstrip(b"=").decode()
+        return f"{path},sha256={digest},{len(data)}"
+
+    record = "\n".join([
+        rec(f"{name}/__init__.py", code),
+        rec(f"{dist}/METADATA", metadata),
+        rec(f"{dist}/WHEEL", wheel_meta),
+        f"{dist}/RECORD,,",
+    ]) + "\n"
+    path = os.path.join(dest_dir, f"{name}-{version}-py3-none-any.whl")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", code)
+        zf.writestr(f"{dist}/METADATA", metadata)
+        zf.writestr(f"{dist}/WHEEL", wheel_meta)
+        zf.writestr(f"{dist}/RECORD", record)
+    return path
+
+
+def test_pip_env_installs_wheel_visible_only_in_task(local_cluster,
+                                                     tmp_path):
+    """The pip key builds a cached venv; the package imports inside the
+    task and is absent outside (ref: _private/runtime_env/pip.py)."""
+    _build_wheel(str(tmp_path))
+    renv = {"pip": {"packages": ["testpkg-rayt"],
+                    "pip_install_options": [
+                        "--no-index", "--find-links", str(tmp_path)]}}
+
+    @rt.remote(runtime_env=renv)
+    def use_pkg():
+        import testpkg_rayt
+
+        return testpkg_rayt.VERSION
+
+    assert rt.get(use_pkg.remote(), timeout=120) == "1.0"
+
+    # not visible outside the runtime env
+    @rt.remote
+    def without_env():
+        try:
+            import testpkg_rayt  # noqa: F401
+
+            return "visible"
+        except ImportError:
+            return "absent"
+
+    assert rt.get(without_env.remote(), timeout=60) == "absent"
+
+    # second use hits the cached venv (marker exists, still works)
+    import time as _t
+
+    t0 = _t.monotonic()
+    assert rt.get(use_pkg.remote(), timeout=60) == "1.0"
+    assert _t.monotonic() - t0 < 30.0

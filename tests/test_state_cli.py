@@ -90,3 +90,46 @@ def test_cli_microbenchmark():
     assert r.returncode == 0, r.stderr
     assert "tasks_per_second" in r.stdout
     assert "put_get_gigabytes_per_second" in r.stdout
+
+
+def test_task_event_timeline(local_cluster, tmp_path):
+    """Executed tasks land in the GCS event ring and export as a Chrome
+    trace (ref analogs: task_event_buffer.cc, `ray timeline`)."""
+    import json
+    import time
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    @rt.remote
+    def traced_work(x):
+        return x + 1
+
+    @rt.remote(num_cpus=0)
+    class TracedActor:
+        def method(self):
+            return "m"
+
+    assert rt.get([traced_work.remote(i) for i in range(3)]) == [1, 2, 3]
+    a = TracedActor.remote()
+    assert rt.get(a.method.remote()) == "m"
+
+    events = []
+    for _ in range(40):  # flush loop ships events every ~1s
+        events = state_api.task_events()
+        names = {e["name"] for e in events}
+        if "traced_work" in names and "method" in names:
+            break
+        time.sleep(0.25)
+    names = {e["name"] for e in events}
+    assert "traced_work" in names and "method" in names
+    kinds = {e["kind"] for e in events}
+    assert "task" in kinds and "actor_task" in kinds
+
+    out = str(tmp_path / "trace.json")
+    n = state_api.export_timeline(out)
+    assert n >= 4
+    with open(out) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"][0]["ph"] == "X"
+    assert any(ev["name"] == "traced_work" for ev in trace["traceEvents"])

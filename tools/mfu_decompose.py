@@ -13,9 +13,20 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 PEAK = 197e12  # v5e bf16 peak
 
-B, S, D, H, KV, HID, L, V = 8, 2048, 1024, 16, 16, 2816, 24, 32000
+B = int(os.environ.get("MFU_B", 8))
+S = int(os.environ.get("MFU_S", 2048))
+D = int(os.environ.get("MFU_D", 1024))
+H = int(os.environ.get("MFU_H", 16))
+KV = int(os.environ.get("MFU_KV", H))
+HID = int(os.environ.get("MFU_HID", 2816))
+L = int(os.environ.get("MFU_L", 24))
+V = int(os.environ.get("MFU_V", 32000))
+BLOCK_Q = int(os.environ.get("MFU_BLOCK_Q", 512))
+BLOCK_K = int(os.environ.get("MFU_BLOCK_K", 512))
 
 
 def _time(f, *args, steps=20):
@@ -55,8 +66,10 @@ def leg_attn_flash():
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, KV, hd), jnp.bfloat16)
 
     def f(q, k, v):
-        return dot_product_attention(
-            q, k, v, causal=True, impl="flash").astype(jnp.float32).sum()
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(
+            q, k, v, True, None, BLOCK_Q, BLOCK_K).astype(
+                jnp.float32).sum()
 
     dt = _time(f, q, k, v)
     # causal attention flops (fwd 2 matmuls + bwd 4): per layer-call
