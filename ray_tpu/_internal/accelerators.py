@@ -150,7 +150,10 @@ def detect_tpu_slice(env: Optional[dict] = None,
     else:
         if gen in ("v2", "v3", "v4", "v5p"):
             # those accelerator-type suffixes count TensorCores (2/chip),
-            # not chips (ref tpu.py halves for pre-v5e generations)
+            # not chips (ref tpu.py halves for pre-v5e generations). Only
+            # the CHIP COUNT is halved — the accelerator-type string stays
+            # exactly what the platform exports ("v4-16"), since that's
+            # the name users target in resource requests.
             total = max(1, total // 2)
     per_host = _CHIPS_PER_HOST.get(gen, 4)
     num_workers = max(1, -(-total // per_host))
@@ -159,9 +162,8 @@ def detect_tpu_slice(env: Optional[dict] = None,
                           len([h for h in hostnames.split(",") if h.strip()]))
     if not chips_on_host:
         chips_on_host = devfs_chips or min(total, per_host)
-    # normalize accel_type to "<gen>-<total>" (v5litepod-8 -> v5e-8)
-    accel_type = f"{gen}-{total}"
-    return TpuSliceInfo(accel_type=accel_type, gen=gen, total_chips=total,
+    return TpuSliceInfo(accel_type=accel.lower(), gen=gen,
+                        total_chips=total,
                         chips_on_host=chips_on_host, worker_id=worker_id,
                         num_workers=num_workers, slice_name=slice_name,
                         topology=topology, source=source)

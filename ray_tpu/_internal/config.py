@@ -46,12 +46,19 @@ class Config:
     # GCS gives up placing a PENDING actor after this (ref: actor
     # scheduling; raise on oversubscribed hosts where fleet boot is slow)
     actor_scheduling_deadline_s: float = 300.0
+    # GCS -> node start_actor push timeout. The node bounds its own
+    # worker-startup wait + create call strictly BELOW this so a timed-out
+    # push can't leave a ghost actor instance holding leased resources.
+    actor_creation_push_timeout_s: float = 330.0
     worker_startup_timeout_s: float = 60.0
     # Keep a granted lease (worker + resources) cached for this long after
     # a task finishes so back-to-back tasks with the same resource shape
     # skip the lease round-trip (ref: normal_task_submitter.cc:291 lease
     # reuse). 0 disables caching.
     lease_reuse_idle_s: float = 1.0
+    # Max workers booting (spawned, not yet registered) at once per
+    # node; further creations queue (boot-storm throttle for fleets).
+    max_concurrent_worker_boots: int = 8
     # Number of pre-forked idle workers kept per node.
     idle_worker_pool_size: int = 1
     idle_worker_ttl_s: float = 300.0

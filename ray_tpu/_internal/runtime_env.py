@@ -193,31 +193,65 @@ def ensure_pip_venv(pip_spec: dict) -> str:
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-_VENV_GC_MIN_AGE_S = 3600.0
+def mark_pip_venv_in_use(venv_dir: str):
+    """Pin a venv against GC while this process has it on sys.path: a
+    pid file under <venv>.inuse/ (liveness-checked by the collector, so
+    a crashed worker can't pin forever)."""
+    d = venv_dir + ".inuse"
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, str(os.getpid())), "w"):
+            pass
+    except OSError:
+        pass
+
+
+def release_pip_venv(pip_spec: dict):
+    venv_dir = os.path.join(_VENV_ROOT, pip_spec["hash"])
+    try:
+        os.remove(os.path.join(venv_dir + ".inuse", str(os.getpid())))
+    except OSError:
+        pass
+
+
+def _venv_in_use(venv_dir: str) -> bool:
+    d = venv_dir + ".inuse"
+    try:
+        pids = os.listdir(d)
+    except OSError:
+        return False
+    alive = False
+    for p in pids:
+        try:
+            os.kill(int(p), 0)
+            alive = True
+        except (ProcessLookupError, ValueError):
+            try:
+                os.remove(os.path.join(d, p))  # stale pin: crashed worker
+            except OSError:
+                pass
+        except OSError:
+            alive = True
+    return alive
 
 
 def _gc_venvs(keep: int):
-    """Drop the oldest cached venvs beyond `keep` (LRU by mtime). Venvs
-    touched within the last hour are never collected — a running task may
-    still have the venv spliced into sys.path (mtime is refreshed on
-    every ensure), so only cold entries are safe to rmtree."""
+    """Drop the oldest cached venvs beyond `keep` (LRU by mtime), never
+    collecting one a LIVE worker still has spliced into sys.path."""
     import shutil
-    import time
 
     try:
         entries = [os.path.join(_VENV_ROOT, e) for e in os.listdir(_VENV_ROOT)
-                   if os.path.isdir(os.path.join(_VENV_ROOT, e))]
+                   if os.path.isdir(os.path.join(_VENV_ROOT, e))
+                   and not e.endswith(".inuse")]
     except OSError:
         return
     entries.sort(key=lambda p: os.path.getmtime(p), reverse=True)
-    cutoff = time.time() - _VENV_GC_MIN_AGE_S
     for stale in entries[keep:]:
-        try:
-            if os.path.getmtime(stale) > cutoff:
-                continue
-        except OSError:
-            pass
+        if _venv_in_use(stale):
+            continue
         shutil.rmtree(stale, ignore_errors=True)
+        shutil.rmtree(stale + ".inuse", ignore_errors=True)
 
 
 def _extract(key: str, data: bytes, subdir: str | None) -> str:
@@ -257,6 +291,7 @@ def materialize(spec: dict, kv_get) -> None:
     pip_spec = spec.get("pip")
     if pip_spec:
         venv_dir = ensure_pip_venv(pip_spec)
+        mark_pip_venv_in_use(venv_dir)
         site = _venv_site_packages(venv_dir)
         if site not in sys.path:
             sys.path.insert(0, site)

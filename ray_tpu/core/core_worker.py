@@ -920,6 +920,7 @@ class CoreWorker:
                 pass
             sys.path[:] = saved_path
             if spec.runtime_env.get("pip"):
+                renv_mod.release_pip_venv(spec.runtime_env["pip"])
                 # modules imported from the venv must not satisfy later
                 # imports on this pooled worker (sys.modules outlives the
                 # sys.path splice)

@@ -516,7 +516,9 @@ class GcsServer:
                 # node_manager rpc_start_actor): timing out first would make
                 # this retry loop create a duplicate actor while the first
                 # create is still running, leaking its worker + lease.
-                result = await conn.call("start_actor", spec, timeout=330.0)
+                result = await conn.call(
+                    "start_actor", spec,
+                    timeout=get_config().actor_creation_push_timeout_s)
             except Exception as e:
                 logger.warning("start_actor on %s failed: %s", node_id, e)
                 await asyncio.sleep(0.2)

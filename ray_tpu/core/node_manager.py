@@ -451,13 +451,28 @@ class NodeManager:
         for _ in range(target - idle - starting):
             self._spawn_worker()
 
-    async def _get_idle_worker(self) -> _Worker:
+    async def _get_idle_worker(self, timeout_s: float | None = None
+                               ) -> _Worker:
         w = self._try_claim_idle()
         if w is not None:
             return w
-        spawned = self._spawn_worker()
         cfg = get_config()
-        deadline = time.monotonic() + cfg.worker_startup_timeout_s
+        deadline = time.monotonic() + (
+            cfg.worker_startup_timeout_s if timeout_s is None
+            else min(timeout_s, cfg.worker_startup_timeout_s))
+        # Boot-storm throttle (ref analog: raylet worker-pool prestart
+        # throttling): bound CONCURRENTLY-BOOTING workers so a fleet of
+        # actor creations doesn't fork N jax-importing processes at once
+        # and thrash small hosts; queued creations claim workers as they
+        # register.
+        while len(self._unregistered) >= cfg.max_concurrent_worker_boots:
+            if time.monotonic() >= deadline:
+                raise TimeoutError("worker startup queue timed out")
+            await asyncio.sleep(0.05)
+            cand = self._try_claim_idle()
+            if cand is not None:
+                return cand
+        spawned = self._spawn_worker()
         while time.monotonic() < deadline:
             if spawned.info is not None and spawned.conn is not None \
                     and not spawned.busy:
@@ -591,8 +606,14 @@ class NodeManager:
         elif any(self.resources_available.get(r, 0.0) < amt
                  for r, amt in placement_demand.items()):
             return None
+        # The WHOLE creation (worker startup + create call) must finish
+        # inside the GCS's push timeout, or the GCS reschedules while this
+        # instance still materializes — a ghost holding leased resources.
+        budget = time.monotonic() + \
+            get_config().actor_creation_push_timeout_s - 15.0
         try:
-            w = await self._get_idle_worker()
+            w = await self._get_idle_worker(
+                timeout_s=budget - time.monotonic())
         except Exception as e:
             self._release_resources(demand)
             return (None, f"worker startup failed: {e}")
@@ -600,7 +621,9 @@ class NodeManager:
         w.actor_id = spec.actor_id
         w.lease_resources = dict(demand)
         try:
-            err = await w.conn.call("create_actor", spec, timeout=300)
+            err = await w.conn.call(
+                "create_actor", spec,
+                timeout=max(5.0, budget - time.monotonic()))
         except Exception as e:
             # Creation not committed: the GCS _schedule_actor loop owns the
             # retry (returning None). Keep this the ONLY recovery path:

@@ -143,21 +143,51 @@ class ShmObjectStore:
 
     def create_unsealed(self, object_id: ObjectID, size: int) -> bool:
         """Allocate an object to be filled by write_at + seal. False if
-        the object already exists (created or being created elsewhere)."""
+        the object already exists (created or being created elsewhere).
+
+        The marker file is the CREATION LOCK (O_EXCL, written before the
+        segment exists) so no other process can attach a half-written
+        segment; it carries the writer pid so a crashed writer's stale
+        marker is detected and cleaned instead of hiding the id forever.
+        """
+        marker = self._unsealed_marker(object_id)
+        try:
+            with open(marker, "x") as f:
+                f.write(str(os.getpid()))
+        except FileExistsError:
+            return False  # another creator owns it (or stale: see below)
         try:
             shm = shared_memory.SharedMemory(
                 name=_shm_name(object_id), create=True, size=max(size, 1))
         except FileExistsError:
+            # sealed object already existed: our marker must not hide it
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
             return False
         _unregister_tracker(shm)
-        try:
-            with open(self._unsealed_marker(object_id), "w"):
-                pass
-        except OSError:
-            pass
         self._unsealed.add(object_id)
         self._open[object_id] = shm
         return True
+
+    @staticmethod
+    def _marker_stale(marker: str) -> bool:
+        """True when the writer recorded in the marker is dead."""
+        try:
+            with open(marker) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return False
+        if pid <= 0:
+            return True
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+        except OSError:
+            return False
 
     def write_at(self, object_id: ObjectID, offset: int, data):
         shm = self._open[object_id]
@@ -199,8 +229,25 @@ class ShmObjectStore:
             return False
         if object_id in self._open:
             return True
-        if os.path.exists(self._unsealed_marker(object_id)):
-            return False  # another process is still writing it
+        marker = self._unsealed_marker(object_id)
+        if os.path.exists(marker):
+            if not self._marker_stale(marker):
+                return False  # another process is still writing it
+            # the writer died mid-write: drop the partial so a re-pull
+            # can recreate the object
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
+            try:
+                stale = shared_memory.SharedMemory(
+                    name=_shm_name(object_id))
+                _unregister_tracker(stale)
+                stale.close()
+                stale.unlink()
+            except FileNotFoundError:
+                pass
+            return False
         try:
             shm = shared_memory.SharedMemory(name=_shm_name(object_id))
             _unregister_tracker(shm)

@@ -103,9 +103,18 @@ class Dataset:
         return GroupedData(self, key)
 
     # ------------------------------------------------------------ execution
+    def explain(self) -> list[str]:
+        """The optimized plan as stage descriptions (ref analog:
+        logical-plan printout in data/_internal/plan.py)."""
+        from ray_tpu.data.plan import describe, optimize
+
+        return describe(optimize(list(self._stages)))
+
     def _iter_block_refs(self) -> Iterator:
+        from ray_tpu.data.plan import optimize
+
         refs: Iterator = iter(self._source_refs)
-        for stage in self._stages:
+        for stage in optimize(list(self._stages)):
             if isinstance(stage, MapSpec):
                 refs = self._executor.stream_map(refs, stage)
             elif isinstance(stage, _AllToAll):

@@ -42,6 +42,12 @@ def apply_map_spec(spec: MapSpec, fn, block: Block) -> Block:
     """Run one map stage over one block (inside a task/actor)."""
     from ray_tpu.data.block import batch_iter
 
+    if spec.kind == "fused":
+        # planner-fused chain: run every sub-stage in this one task
+        for sub in spec.fn:
+            block = apply_map_spec(sub, sub.fn, block)
+        return block
+
     if spec.kind == "map":
         return [fn(row, **spec.fn_kwargs) for row in iter_rows(block)]
     if spec.kind == "filter":

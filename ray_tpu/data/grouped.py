@@ -26,6 +26,13 @@ def _stable_hash(value: Any) -> int:
     return zlib.crc32(data)
 
 
+def _group_rows(part: Block, key: str) -> dict[Any, Block]:
+    groups: dict[Any, Block] = {}
+    for row in iter_rows(part):
+        groups.setdefault(row[key], []).append(row)
+    return groups
+
+
 class GroupedData:
     def __init__(self, dataset, key: str):
         self._dataset = dataset
@@ -67,9 +74,7 @@ class GroupedData:
         key = self._key
 
         def agg_partition(part: Block) -> Block:
-            groups: dict[Any, Block] = {}
-            for row in iter_rows(part):
-                groups.setdefault(row[key], []).append(row)
+            groups = _group_rows(part, key)
             out: Block = []
             for gkey, rows in groups.items():
                 row = {key: gkey}
@@ -103,9 +108,7 @@ class GroupedData:
         key = self._key
 
         def apply(part: Block) -> Block:
-            groups: dict[Any, Block] = {}
-            for row in iter_rows(part):
-                groups.setdefault(row[key], []).append(row)
+            groups = _group_rows(part, key)
             out: Block = []
             for _, rows in groups.items():
                 result = fn(rows)

@@ -16,6 +16,8 @@ from ray_tpu.serve.schema import build_app, deploy_config  # noqa: F401
 
 _proxy = None
 _proxy_port: Optional[int] = None
+_grpc_proxy = None
+_grpc_port: Optional[int] = None
 
 
 def _controller(create: bool = True):
@@ -91,6 +93,8 @@ def run(app: Application, *, name: str = "default",
             raise TimeoutError(f"app {name!r} did not become ready")
     if _proxy is not None:
         rt.get(_proxy.register_app.remote(name, ingress), timeout=30)
+    if _grpc_proxy is not None:
+        rt.get(_grpc_proxy.register_app.remote(name, ingress), timeout=30)
     return DeploymentHandle(ingress, name)
 
 
@@ -112,6 +116,8 @@ def delete(name: str = "default"):
     rt.get(controller.delete_application.remote(name), timeout=60)
     if _proxy is not None:
         rt.get(_proxy.unregister_app.remote(name), timeout=30)
+    if _grpc_proxy is not None:
+        rt.get(_grpc_proxy.unregister_app.remote(name), timeout=30)
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 0) -> int:
@@ -129,8 +135,35 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 0) -> int:
     return _proxy_port
 
 
+def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0) -> int:
+    """Start the gRPC ingress (generic byte service /rayt.serve.Serve;
+    ref analog: serve's gRPC proxy data plane)."""
+    global _grpc_proxy, _grpc_port
+    import ray_tpu as rt
+    from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+    controller = _controller()
+    if _grpc_proxy is None:
+        _grpc_proxy = rt.remote(GrpcProxyActor).options(
+            name="serve_grpc_proxy", num_cpus=0).remote(grpc_host,
+                                                        grpc_port)
+        _grpc_port = rt.get(_grpc_proxy.start.remote(), timeout=60)
+        # register existing apps so a late-started ingress still routes
+        for app_name in rt.get(controller.list_applications.remote(),
+                               timeout=30):
+            try:
+                deps = rt.get(controller.get_deployments.remote(app_name),
+                              timeout=30)
+                if deps:
+                    rt.get(_grpc_proxy.register_app.remote(
+                        app_name, deps[-1]["name"]), timeout=30)
+            except Exception:
+                pass
+    return _grpc_port
+
+
 def shutdown():
-    global _proxy, _proxy_port
+    global _proxy, _proxy_port, _grpc_proxy, _grpc_port
     import ray_tpu as rt
 
     try:
@@ -147,5 +180,12 @@ def shutdown():
             rt.kill(_proxy)
         except Exception:
             pass
+    if _grpc_proxy is not None:
+        try:
+            rt.kill(_grpc_proxy)
+        except Exception:
+            pass
+    _grpc_proxy = None
+    _grpc_port = None
     _proxy = None
     _proxy_port = None

@@ -29,6 +29,11 @@ class ServeController:
         # in-flight requests: [(handle, drain_deadline)] (graceful rolling
         # replace, ref deployment_state.py replica draining)
         self._draining: list[tuple] = []
+        # cross-handle router signal: (app, dep) -> {replica_idx: ongoing}
+        # refreshed each reconcile tick (ref: replica_scheduler/common.py
+        # queue-length cache — here controller-mediated so every handle
+        # in every process sees the same load view)
+        self._replica_load: dict[tuple, dict[int, float]] = {}
         self._loop_task = None  # started via ensure_loop (needs the
         # actor's asyncio loop, which doesn't exist during __init__)
 
@@ -113,6 +118,14 @@ class ServeController:
             table[f"{app}/{dep}"] = list(handles)
         return {"version": self.version, "table": table}
 
+    def get_route_info(self, known_version: int, key: str) -> dict:
+        """One-RPC handle refresh: routing-table delta (None when the
+        version is current) + this deployment's replica load snapshot
+        (cross-handle pow-2 signal; ref: replica queue-length cache)."""
+        app, _, dep = key.partition("/")
+        return {"update": self.get_routing_table(known_version),
+                "load": self._replica_load.get((app, dep), {})}
+
     async def wait_ready(self, app_name: str, timeout: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -178,7 +191,12 @@ class ServeController:
                 if len(live) != len(self.replicas.get(key, [])):
                     changed = True
                 self.replicas[key] = live
-                target = await self._target_replicas(key, spec, len(live))
+                stats = await self._collect_stats(key)
+                self._replica_load[key] = {
+                    i: v for i, v in enumerate(stats or [])
+                    if v is not None}
+                target = await self._target_replicas(key, spec, len(live),
+                                                     stats)
                 while len(live) < target:
                     handle = self._start_replica(app_name, spec)
                     live.append(handle)
@@ -220,15 +238,16 @@ class ServeController:
                           spec.get("user_config"))
 
     async def _target_replicas(self, key: tuple, spec: dict,
-                               live: int) -> int:
+                               live: int, stats=None) -> int:
         auto = spec.get("autoscaling_config")
         if auto is None:
             return spec.get("num_replicas", 1)
         auto = cloudpickle.loads(auto) if isinstance(auto, bytes) else auto
-        stats = await self._collect_stats(key)
+        if stats is None:
+            stats = await self._collect_stats(key)
         if stats is None:
             return max(live, auto.min_replicas)
-        ongoing = sum(stats)
+        ongoing = sum(v for v in stats if v is not None)
         desired = max(
             auto.min_replicas,
             min(auto.max_replicas,
@@ -254,13 +273,17 @@ class ServeController:
         self._scale_marks.pop((mark_key, "down"), None)
         return live
 
-    async def _collect_stats(self, key: tuple) -> Optional[list[float]]:
+    async def _collect_stats(self, key: tuple) -> Optional[list]:
+        """Per-replica ongoing counts, POSITION-ALIGNED with
+        self.replicas[key]; an unreachable replica yields None at its slot
+        (dropping it would shift later replicas' loads onto earlier ones
+        in the router's index-keyed view)."""
         import ray_tpu as rt
 
         handles = self.replicas.get(key, [])
         if not handles:
             return None
-        out = []
+        out: list = []
         for h in handles:
             try:
                 stats = await asyncio.get_running_loop().run_in_executor(
@@ -268,5 +291,5 @@ class ServeController:
                                              timeout=5))
                 out.append(float(stats["ongoing"]))
             except Exception:
-                pass
-        return out or None
+                out.append(None)
+        return out if any(v is not None for v in out) else None

@@ -1,0 +1,122 @@
+"""gRPC ingress proxy (ref analog: python/ray/serve/_private/proxy.py
+gRPC data plane + grpc_util/: the reference serves user-defined proto
+services; this ingress exposes a generic byte-level service so callers
+don't need generated stubs).
+
+Service (full method names):
+  /rayt.serve.Serve/Predict        unary-unary
+  /rayt.serve.Serve/PredictStream  unary-stream
+
+Request bytes: JSON {"app": <name>, "payload": <json value>,
+"model_id": <optional>}; response bytes: JSON value per result (one per
+stream message for PredictStream). Runs inside an async actor next to
+the HTTP proxy, sharing the same DeploymentHandle routing path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+_SERVICE = "rayt.serve.Serve"
+
+
+class GrpcProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handles: dict[str, Any] = {}
+        self._ingress: dict[str, str] = {}
+        self._server = None
+
+    # ------------------------------------------------------------- control
+    def register_app(self, app_name: str, ingress_deployment: str) -> bool:
+        self._ingress[app_name] = ingress_deployment
+        self._handles.pop(app_name, None)
+        return True
+
+    def unregister_app(self, app_name: str) -> bool:
+        self._ingress.pop(app_name, None)
+        self._handles.pop(app_name, None)
+        return True
+
+    async def start(self) -> int:
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == f"/{_SERVICE}/Predict":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._predict)
+                if details.method == f"/{_SERVICE}/PredictStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._predict_stream)
+                return None
+
+        self._server = grpc.server(
+            __import__("concurrent.futures", fromlist=["f"])
+            .ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.so_reuseport", 0)])
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        self._server.start()
+        return self.port
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+    # --------------------------------------------------------------- data
+    def _resolve(self, request_bytes: bytes):
+        import grpc
+
+        req = json.loads(request_bytes)
+        app_name = req.get("app")
+        ingress = self._ingress.get(app_name)
+        if ingress is None:
+            raise _Abort(grpc.StatusCode.NOT_FOUND,
+                         f"no app {app_name!r}")
+        handle = self._handles.get(app_name)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(ingress, app_name)
+            self._handles[app_name] = handle
+        model_id = req.get("model_id") or ""
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        return handle, req.get("payload")
+
+    def _predict(self, request_bytes: bytes, context) -> bytes:
+        try:
+            handle, payload = self._resolve(request_bytes)
+            result = handle.remote(payload).result(timeout=300)
+            return json.dumps(result, default=str).encode()
+        except _Abort as e:
+            context.abort(e.code, e.detail)
+        except Exception as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def _predict_stream(self, request_bytes: bytes, context):
+        try:
+            handle, payload = self._resolve(request_bytes)
+            for item in handle.options(stream=True).remote(payload):
+                yield json.dumps(item, default=str).encode()
+        except _Abort as e:
+            context.abort(e.code, e.detail)
+        except Exception as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+
+class _Abort(Exception):
+    def __init__(self, code, detail):
+        self.code = code
+        self.detail = detail

@@ -128,6 +128,10 @@ class DeploymentHandle:
         self._replicas: list = []
         self._table_ts = 0.0
         self._inflight: dict[Any, int] = {}
+        # controller-reported per-replica ongoing counts (index-aligned
+        # with _replicas): the cross-handle signal missing from a purely
+        # handle-local pow-2 (ref: replica_scheduler/common.py cache)
+        self._load: dict[int, float] = {}
         self._controller = None
 
     # picklable: runtime state rebuilds lazily in the new process
@@ -161,14 +165,16 @@ class DeploymentHandle:
         if self._controller is None:
             self._controller = _get_controller()
         known = -1 if force else self._table_version
-        update = rt.get(self._controller.get_routing_table.remote(known),
-                        timeout=30)
+        key = f"{self.app_name}/{self.deployment_name}"
+        info = rt.get(self._controller.get_route_info.remote(known, key),
+                      timeout=30)
+        update = info["update"]
         with self._lock:
             self._table_ts = now
+            self._load = dict(info.get("load") or {})
             if update is None:
                 return
             self._table_version = update["version"]
-            key = f"{self.app_name}/{self.deployment_name}"
             self._replicas = update["table"].get(key, [])
             live = set(id(r) for r in self._replicas)
             self._inflight = {r: c for r, c in self._inflight.items()
@@ -190,10 +196,15 @@ class DeploymentHandle:
             self._refresh(force=True)
         if len(replicas) == 1:
             return replicas[0]
-        a, b = random.sample(replicas, 2)
+        i, j = random.sample(range(len(replicas)), 2)
+        a, b = replicas[i], replicas[j]
         with self._lock:
-            return a if self._inflight.get(a, 0) <= self._inflight.get(
-                b, 0) else b
+            # pow-2 choice over reported (cross-handle) + local in-flight
+            # load — other clients' traffic is visible via the controller
+            # snapshot, so handles can't all pile onto one replica
+            sa = self._load.get(i, 0.0) + self._inflight.get(a, 0)
+            sb = self._load.get(j, 0.0) + self._inflight.get(b, 0)
+            return a if sa <= sb else b
 
     def _pick_replica_for_model(self, model_id: str):
         """Model-affinity routing: repeat traffic for a model id goes to

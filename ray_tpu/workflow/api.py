@@ -1,0 +1,241 @@
+"""Workflow DAG construction + durable executor (ref analogs:
+python/ray/workflow/workflow_executor.py:32 — step scheduling loop;
+workflow_state_from_dag.py — DAG -> steps; storage/ — checkpoint layout).
+
+Storage layout (one dir per workflow under the workflow root):
+  <root>/<workflow_id>/
+    meta.json                  {"status": ..., "output_step": id}
+    steps/<step_id>.pkl        checkpointed step result
+    steps/<step_id>.json       {"name", "upstream": [...]}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+DEFAULT_ROOT = os.path.expanduser(
+    os.environ.get("RAYT_WORKFLOW_ROOT", "/tmp/rayt_workflows"))
+
+
+@dataclass
+class StepNode:
+    fn: Callable
+    args: tuple
+    kwargs: dict
+    name: str
+    max_retries: int = 3
+    num_cpus: float = 1.0
+    _step_id: Optional[str] = field(default=None, repr=False)
+
+    def options(self, *, name: Optional[str] = None,
+                max_retries: Optional[int] = None,
+                num_cpus: Optional[float] = None) -> "StepNode":
+        if name is not None:
+            self.name = name
+        if max_retries is not None:
+            self.max_retries = max_retries
+        if num_cpus is not None:
+            self.num_cpus = num_cpus
+        return self
+
+    # ------------------------------------------------------------ identity
+    def step_id(self) -> str:
+        """Content-derived id: function name + plain-arg repr + upstream
+        step ids, so editing a step invalidates its own and downstream
+        checkpoints only (ref: workflow step id semantics)."""
+        if self._step_id is None:
+            h = hashlib.sha256()
+            h.update(self.name.encode())
+            for a in list(self.args) + sorted(
+                    self.kwargs.items(), key=lambda kv: kv[0]):
+                if isinstance(a, tuple):  # kwargs item
+                    h.update(repr(a[0]).encode())
+                    a = a[1]
+                if isinstance(a, StepNode):
+                    h.update(a.step_id().encode())
+                else:
+                    h.update(repr(a).encode())
+            self._step_id = f"{self.name}-{h.hexdigest()[:16]}"
+        return self._step_id
+
+    def upstream(self) -> list["StepNode"]:
+        out = [a for a in self.args if isinstance(a, StepNode)]
+        out += [v for v in self.kwargs.values() if isinstance(v, StepNode)]
+        return out
+
+
+def step(fn: Callable = None, **opts):
+    """Decorator: `fn.bind(*args)` builds a StepNode DAG."""
+    def wrap(f):
+        class _Builder:
+            def __init__(self):
+                self.__name__ = f.__name__
+
+            def bind(self, *args, **kwargs) -> StepNode:
+                node = StepNode(f, args, kwargs, name=f.__name__)
+                return node.options(**opts) if opts else node
+
+            def __call__(self, *args, **kwargs):
+                return f(*args, **kwargs)
+
+        return _Builder()
+    return wrap(fn) if fn is not None else wrap
+
+
+# ----------------------------------------------------------------- storage
+def _wf_dir(workflow_id: str, root: Optional[str]) -> str:
+    return os.path.join(root or DEFAULT_ROOT, workflow_id)
+
+
+def _write_json(path: str, data: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+
+
+class _Store:
+    def __init__(self, workflow_id: str, root: Optional[str]):
+        self.dir = _wf_dir(workflow_id, root)
+        self.steps_dir = os.path.join(self.dir, "steps")
+
+    def _ensure(self):
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.steps_dir,
+                                           step_id + ".pkl"))
+
+    def load(self, step_id: str) -> Any:
+        with open(os.path.join(self.steps_dir, step_id + ".pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value: Any, meta: dict):
+        self._ensure()
+        path = os.path.join(self.steps_dir, step_id + ".pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f, protocol=4)
+        os.replace(tmp, path)
+        _write_json(os.path.join(self.steps_dir, step_id + ".json"), meta)
+
+    def set_meta(self, **kv):
+        self._ensure()
+        path = os.path.join(self.dir, "meta.json")
+        meta = self.meta()
+        meta.update(kv)
+        _write_json(path, meta)
+
+    def meta(self) -> dict:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except OSError:
+            return {}
+
+
+# ---------------------------------------------------------------- executor
+def _topo(final: StepNode) -> list[StepNode]:
+    order: list[StepNode] = []
+    seen: set[str] = set()
+
+    def visit(node: StepNode):
+        if node.step_id() in seen:
+            return
+        seen.add(node.step_id())
+        for up in node.upstream():
+            visit(up)
+        order.append(node)
+
+    visit(final)
+    return order
+
+
+def _execute(final: StepNode, store: _Store) -> Any:
+    """Run the DAG over cluster tasks, checkpointing every step result
+    (ref: workflow_executor.py step loop — here checkpoint-per-step with
+    dependency-parallel submission within checkpoint barriers)."""
+    import ray_tpu as rt
+
+    results: dict[str, Any] = {}
+    for node in _topo(final):
+        sid = node.step_id()
+        if store.has(sid):
+            results[sid] = store.load(sid)
+            continue
+
+        def resolve(a):
+            return results[a.step_id()] if isinstance(a, StepNode) else a
+
+        args = [resolve(a) for a in node.args]
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        task = rt.remote(num_cpus=node.num_cpus,
+                         max_retries=node.max_retries)(node.fn)
+        value = rt.get(task.remote(*args, **kwargs))
+        store.save(sid, value, {
+            "name": node.name,
+            "upstream": [u.step_id() for u in node.upstream()],
+            "finished_at": time.time()})
+        results[sid] = value
+    return results[final.step_id()]
+
+
+def run(final: StepNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute a workflow durably; returns the final step's result."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    store = _Store(workflow_id, storage)
+    store.set_meta(status="RUNNING", workflow_id=workflow_id,
+                   output_step=final.step_id(), started_at=time.time())
+    try:
+        out = _execute(final, store)
+    except Exception as e:
+        store.set_meta(status="FAILED", error=repr(e))
+        raise
+    store.set_meta(status="SUCCESSFUL", finished_at=time.time())
+    return out
+
+
+def resume(workflow_id: str, final: StepNode, *,
+           storage: Optional[str] = None) -> Any:
+    """Re-run an interrupted workflow: checkpointed steps are loaded,
+    the rest execute (ref: workflow resume semantics)."""
+    store = _Store(workflow_id, storage)
+    store.set_meta(status="RUNNING")
+    try:
+        out = _execute(final, store)
+    except Exception as e:
+        store.set_meta(status="FAILED", error=repr(e))
+        raise
+    store.set_meta(status="SUCCESSFUL", finished_at=time.time())
+    return out
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    store = _Store(workflow_id, storage)
+    meta = store.meta()
+    if meta.get("status") != "SUCCESSFUL":
+        raise RuntimeError(
+            f"workflow {workflow_id} is {meta.get('status', 'UNKNOWN')}")
+    return store.load(meta["output_step"])
+
+
+def list_workflows(*, storage: Optional[str] = None) -> list[dict]:
+    root = storage or DEFAULT_ROOT
+    out = []
+    try:
+        ids = os.listdir(root)
+    except OSError:
+        return out
+    for wid in sorted(ids):
+        meta = _Store(wid, storage).meta()
+        if meta:
+            out.append(meta)
+    return out

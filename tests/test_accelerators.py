@@ -11,26 +11,29 @@ def test_detect_from_gke_env():
            "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3",
            "TPU_VISIBLE_CHIPS": "0,1,2,3", "TPU_NAME": "my-slice"}
     info = detect_tpu_slice(env, use_metadata=False)
-    # "v4-16" counts TensorCores (2/chip): 8 chips across 2 hosts
-    assert info.accel_type == "v4-8"
+    # "v4-16" counts TensorCores (2/chip): 8 chips — but the advertised
+    # type string stays what the platform exports (users target it)
+    assert info.accel_type == "v4-16"
     assert info.gen == "v4"
     assert info.total_chips == 8
     assert info.chips_on_host == 4
     assert info.worker_id == 1
     assert info.num_workers == 4  # TPU_WORKER_HOSTNAMES wins over chips/host
     res = info.resources()
-    assert res == {"TPU": 4.0, "TPU-v4-8": 4.0}  # not worker 0: no head
+    assert res == {"TPU": 4.0, "TPU-v4-16": 4.0}  # not worker 0: no head
     assert info.labels()["tpu-slice"] == "my-slice"
 
 
-def test_detect_normalizes_v5litepod_and_head_resource():
+def test_detect_v5litepod_head_resource():
     env = {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0",
            "TPU_VISIBLE_CHIPS": "0,1,2,3,4,5,6,7"}
     info = detect_tpu_slice(env, use_metadata=False)
-    assert info.accel_type == "v5e-8"
+    # raw platform type string preserved; gen normalized for labels
+    assert info.accel_type == "v5litepod-8"
+    assert info.gen == "v5e"
     assert info.num_workers == 1
     res = info.resources()
-    assert res["TPU-v5e-8-head"] == 1.0
+    assert res["TPU-v5litepod-8-head"] == 1.0
     assert res["TPU"] == 8.0
 
 

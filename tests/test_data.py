@@ -227,3 +227,28 @@ def test_arrow_csv_reader(local_cluster, tmp_path):
     rows = ds.take_all()
     assert rows == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"},
                     {"a": 3, "b": "z"}]
+
+
+def test_plan_fuses_maps_and_pushes_limit(local_cluster):
+    """Logical-plan rewrite rules (ref analogs: data/_internal/plan.py,
+    logical/rules operator fusion + limit pushdown)."""
+    from ray_tpu import data
+
+    ds = (data.range(100)
+          .map(lambda r: {"id": r["id"] + 1})
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] % 4 == 0)
+          .limit(5))
+    plan = ds.explain()
+    # three task maps fused into one stage; limit hopped before the
+    # 1:1 maps but NOT before the filter (which changes row counts)
+    assert any(p.startswith("Fused[") for p in plan)
+    assert plan.index("limit[5]") == len(plan) - 1
+    rows = ds.take_all()
+    assert rows == [{"id": v} for v in (4, 8, 12, 16, 20)]
+
+    # redundant shuffle before sort is dropped
+    ds2 = data.range(20).random_shuffle(seed=1).sort("id")
+    plan2 = ds2.explain()
+    assert "all_to_all:shuffle" not in plan2
+    assert [r["id"] for r in ds2.take_all()] == list(range(20))

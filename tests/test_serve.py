@@ -307,3 +307,66 @@ def test_rolling_replace_drains_inflight(serve_cluster):
     # the in-flight v1 request completes instead of dying with the replica
     t.join(timeout=60)
     assert result.get("value") == "v1", result
+
+
+def test_router_sees_cross_handle_load(serve_cluster):
+    """The controller-reported replica load reaches fresh handles, so
+    pow-2 isn't blind to other clients' traffic (ADVICE r2 weak #5; ref:
+    replica_scheduler/common.py queue-length cache)."""
+    @serve.deployment(num_replicas=2)
+    class Sleeper:
+        def __call__(self, t):
+            time.sleep(t)
+            return "ok"
+
+    h = serve.run(Sleeper.bind(), name="loadapp")
+    pending = [h.remote(2.5) for _ in range(3)]
+    time.sleep(1.5)  # reconcile tick collects replica stats
+
+    h2 = serve.get_app_handle("loadapp")
+    h2._refresh(force=True)
+    assert sum(h2._load.values()) >= 1.0, h2._load
+    assert all(p.result(timeout=30) == "ok" for p in pending)
+
+
+def test_grpc_ingress_unary_and_stream(serve_cluster):
+    """Generic gRPC data plane (ref analog: serve gRPC proxy)."""
+    import grpc
+
+    port = serve.start_grpc(grpc_port=0)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            if isinstance(payload, dict) and payload.get("n"):
+                def gen():
+                    for i in range(int(payload["n"])):
+                        yield {"tok": i}
+                return gen()
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), name="gapp")
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = chan.unary_unary(
+        "/rayt.serve.Serve/Predict",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    resp = json.loads(predict(
+        json.dumps({"app": "gapp", "payload": "hi"}).encode(), timeout=30))
+    assert resp == {"echo": "hi"}
+
+    stream = chan.unary_stream(
+        "/rayt.serve.Serve/PredictStream",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    items = [json.loads(m) for m in stream(
+        json.dumps({"app": "gapp", "payload": {"n": 3}}).encode(),
+        timeout=30)]
+    assert items == [{"tok": 0}, {"tok": 1}, {"tok": 2}]
+
+    # unknown app -> NOT_FOUND
+    try:
+        predict(json.dumps({"app": "nope", "payload": 1}).encode(),
+                timeout=30)
+        raise AssertionError("expected NOT_FOUND")
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.NOT_FOUND
+    chan.close()
