@@ -502,11 +502,21 @@ class GcsServer:
 
         deadline = time.monotonic() + \
             get_config().actor_scheduling_deadline_s
+        tries = 0
         while time.monotonic() < deadline:
             if info.state == ActorState.DEAD:
                 return  # killed while pending placement
             node_id = self._pick_node_for(demand, spec.scheduling_strategy)
             if node_id is None or node_id not in self.node_conns:
+                tries += 1
+                if tries % 150 == 0:  # ~every 30s of spinning
+                    logger.warning(
+                        "actor %s unplaceable after %d tries: demand=%s "
+                        "picked=%s conns=%s view=%s", actor_id, tries,
+                        demand, node_id,
+                        [n.hex()[:8] for n in self.node_conns],
+                        {n.hex()[:8]: self.node_resources_available.get(n)
+                         for n in self.nodes})
                 await asyncio.sleep(0.2)
                 continue
             conn = self.node_conns[node_id]

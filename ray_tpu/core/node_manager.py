@@ -611,6 +611,8 @@ class NodeManager:
         # instance still materializes — a ghost holding leased resources.
         budget = time.monotonic() + \
             get_config().actor_creation_push_timeout_s - 15.0
+        logger.info("start_actor %s (%s): acquiring worker",
+                    spec.actor_id, spec.name or "")
         try:
             w = await self._get_idle_worker(
                 timeout_s=budget - time.monotonic())
@@ -620,6 +622,8 @@ class NodeManager:
         w.busy = True
         w.actor_id = spec.actor_id
         w.lease_resources = dict(demand)
+        logger.info("start_actor %s: pushing create to worker pid=%s",
+                    spec.actor_id, w.proc.pid)
         try:
             err = await w.conn.call(
                 "create_actor", spec,

@@ -111,9 +111,23 @@ class IMPALALearner:
             # unreachable TPU plugin probe can hang indefinitely)
             jax.config.update("jax_platforms", "cpu")
         else:
+            # probe the configured backend WITH A DEADLINE — in a CHILD
+            # process: an unreachable TPU tunnel blocks jax.devices()
+            # forever while holding jax's backend-init lock (observed: the
+            # worker's create_actor hangs and the whole fleet stalls). A
+            # subprocess probe times out cleanly before any in-process
+            # backend init, and a failed probe pins CPU.
+            import subprocess
+            import sys as _sys
+
             try:
-                jax.devices()
+                r = subprocess.run(
+                    [_sys.executable, "-c", "import jax; jax.devices()"],
+                    capture_output=True, timeout=90)
+                healthy = r.returncode == 0
             except Exception:
+                healthy = False
+            if not healthy:
                 jax.config.update("jax_platforms", "cpu")
         import jax.numpy as jnp
         import optax
@@ -206,6 +220,19 @@ class IMPALA:
         module_blob = cloudpickle.dumps(self.module_cfg)
         cfg_blob = cloudpickle.dumps(config)
 
+        # control-plane actors FIRST: on a loaded host the worker-boot
+        # queue is FIFO, and a learner created after a 256-runner fleet
+        # would sit behind every runner's interpreter boot
+        agg_cls = rt.remote(num_cpus=1)(AggregatorActor)
+        self._aggregators = [agg_cls.remote()
+                             for _ in range(config.num_aggregators)]
+        learner_cls = rt.remote(num_cpus=1)(IMPALALearner)
+        self._learner = learner_cls.remote(module_blob, cfg_blob,
+                                           config.seed)
+        self._weights_ref = rt.put(
+            rt.get(self._learner.get_weights.remote(),
+                   timeout=self.config.call_timeout_s))
+
         runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
         runners = []
         wave = config.boot_wave or config.num_env_runners
@@ -226,15 +253,6 @@ class IMPALA:
                         pass  # FaultTolerantActorManager handles stragglers
             runners.extend(batch)
         self._runners = FaultTolerantActorManager(runners)
-        agg_cls = rt.remote(num_cpus=1)(AggregatorActor)
-        self._aggregators = [agg_cls.remote()
-                             for _ in range(config.num_aggregators)]
-        learner_cls = rt.remote(num_cpus=1)(IMPALALearner)
-        self._learner = learner_cls.remote(module_blob, cfg_blob,
-                                           config.seed)
-        self._weights_ref = rt.put(
-            rt.get(self._learner.get_weights.remote(),
-                   timeout=self.config.call_timeout_s))
         self._runners.foreach(
             lambda a: a.set_weights.remote(self._weights_ref))
         self._inflight: dict = {}   # sample ref -> runner

@@ -12,7 +12,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # ambient env pins axon; setdefault would keep it
 # A 1-core CI box boots ~40 jax-importing worker processes serially; the
 # production timeouts would declare them dead mid-boot and thrash.
 os.environ.setdefault("RAYT_WORKER_STARTUP_TIMEOUT_S", "900")
