@@ -1095,6 +1095,22 @@ class NodeManager:
                                              remote_addr)
 
     # ------------------------------------------------------------ debugging
+    def rpc_list_objects(self, conn, arg=None):
+        """Object-directory dump for `rayt memory` (ref analog:
+        `ray memory` / _private/internal_api.py memory summary)."""
+        out = []
+        for oid, meta in list(self.object_dir.items()):
+            owner = meta.get("owner")
+            out.append({
+                "object_id": oid.hex(),
+                "size": meta.get("size", 0),
+                "spilled": bool(meta.get("spilled")),
+                "pinned": bool(meta.get("pinned")),
+                "owner_worker": (owner.worker_id.hex()
+                                 if owner is not None else None),
+            })
+        return out
+
     def rpc_node_stats(self, conn, arg=None):
         return {
             "node_id": self.node_id.hex(),

@@ -178,6 +178,20 @@ def cmd_list(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_memory(args):
+    """Object report (ref analog: `ray memory`)."""
+    from ray_tpu import state_api
+
+    _attach(args)
+    s = state_api.memory_summary()
+    print(f"{s['num_objects']} objects, {s['total_bytes'] / 1e6:.1f} MB "
+          f"({s['spilled_objects']} spilled, {s['pinned_objects']} pinned)")
+    for o in s["objects"][:50]:
+        flags = ("S" if o["spilled"] else "-") +             ("P" if o["pinned"] else "-")
+        print(f"  {o['object_id'][:16]}  {o['size']:>12}  {flags}  "
+              f"node={o['node_id'][:8]}")
+
+
 def cmd_timeline(args):
     """Chrome-trace export of the GCS task-event ring (ref analog:
     `ray timeline`, scripts/scripts.py)."""
@@ -290,6 +304,10 @@ def main(argv=None):
     sp.add_argument("--duration", type=float, default=2.0)
     sp.add_argument("--num-cpus", type=int)
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("memory", help="object store contents per node")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline",
                         help="export executed-task Chrome trace")

@@ -136,3 +136,42 @@ def export_timeline(path: str) -> int:
     from ray_tpu._internal.tracing import export_chrome_trace
 
     return export_chrome_trace(task_events(), path)
+
+
+def list_objects() -> list[dict]:
+    """Per-node object directory dump (ref analog: `ray memory`)."""
+    import asyncio
+
+    from ray_tpu._internal.rpc import connect
+
+    cw = _cw()
+    out = []
+    for n in cw.io.run(cw.gcs.get_all_nodes()):
+        if not n.alive:
+            continue
+
+        async def fetch(n=n):
+            conn = await connect(n.address.host, n.address.port)
+            try:
+                return await conn.call("list_objects", timeout=30)
+            finally:
+                await conn.close()
+
+        try:
+            for entry in cw.io.run(fetch()):
+                entry["node_id"] = n.node_id.hex()
+                out.append(entry)
+        except Exception:
+            pass
+    return out
+
+
+def memory_summary() -> dict:
+    objs = list_objects()
+    return {
+        "num_objects": len(objs),
+        "total_bytes": sum(o["size"] for o in objs),
+        "spilled_objects": sum(1 for o in objs if o["spilled"]),
+        "pinned_objects": sum(1 for o in objs if o["pinned"]),
+        "objects": objs,
+    }

@@ -133,3 +133,20 @@ def test_task_event_timeline(local_cluster, tmp_path):
         trace = json.load(f)
     assert trace["traceEvents"][0]["ph"] == "X"
     assert any(ev["name"] == "traced_work" for ev in trace["traceEvents"])
+
+
+def test_memory_report_lists_shm_objects(local_cluster):
+    """`rayt memory` analog (ref: `ray memory`): shm objects appear with
+    sizes and spill/pin flags."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    refs = [rt.put(np.zeros(300_000, np.uint8)) for _ in range(3)]
+    s = state_api.memory_summary()
+    assert s["num_objects"] >= 3
+    assert s["total_bytes"] >= 3 * 300_000
+    assert all({"object_id", "size", "spilled", "pinned",
+                "node_id"} <= set(o) for o in s["objects"])
+    del refs
