@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import os
 import socket
+import sys
 import threading
 import time
 import traceback
@@ -1603,6 +1604,23 @@ class CoreWorker:
             os._exit(0)
         threading.Timer(0.1, _die).start()
         return True
+
+    def rpc_dump_stacks(self, conn, arg=None):
+        """All-thread stack dump (ref analog: `ray stack` via py-spy —
+        here cooperative via sys._current_frames, no ptrace needed)."""
+        import traceback as tb
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            out.append({
+                "thread": names.get(ident, str(ident)),
+                "stack": "".join(tb.format_stack(frame)),
+            })
+        return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
+                "actor_id": self.actor_id.hex() if self.actor_id else None,
+                "threads": out}
 
     def rpc_worker_stats(self, conn, arg=None):
         return {

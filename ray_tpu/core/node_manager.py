@@ -677,6 +677,8 @@ class NodeManager:
                 "pid": w.proc.pid,
                 "busy": w.busy,
                 "actor_id": w.actor_id.hex() if w.actor_id else None,
+                "address": (f"{w.info.address.host}:{w.info.address.port}"
+                            if w.info else None),
             })
         out.extend({"worker_id": None, "pid": w.proc.pid,
                     "busy": False, "actor_id": None, "starting": True}

@@ -178,6 +178,19 @@ def cmd_list(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_stack(args):
+    """All-worker thread dump (ref analog: `ray stack`)."""
+    from ray_tpu import state_api
+
+    _attach(args)
+    for d in state_api.dump_stacks():
+        who = d.get("actor_id") or d.get("worker_id", "")[:12]
+        print(f"=== pid {d['pid']} ({who}) node={d['node_id'][:8]}")
+        for t in d["threads"]:
+            print(f"-- thread {t['thread']}")
+            print(t["stack"].rstrip())
+
+
 def cmd_memory(args):
     """Object report (ref analog: `ray memory`)."""
     from ray_tpu import state_api
@@ -304,6 +317,10 @@ def main(argv=None):
     sp.add_argument("--duration", type=float, default=2.0)
     sp.add_argument("--num-cpus", type=int)
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("stack", help="stack traces of all workers")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("memory", help="object store contents per node")
     sp.add_argument("--address")

@@ -175,3 +175,49 @@ def memory_summary() -> dict:
         "pinned_objects": sum(1 for o in objs if o["pinned"]),
         "objects": objs,
     }
+
+
+def dump_stacks() -> list[dict]:
+    """Stack traces of every registered worker on every node (ref
+    analog: `ray stack`, scripts.py:1934 py-spy dump — cooperative
+    sys._current_frames here, no ptrace)."""
+    import asyncio
+
+    from ray_tpu._internal.rpc import connect
+
+    cw = _cw()
+    out = []
+    for n in cw.io.run(cw.gcs.get_all_nodes()):
+        if not n.alive:
+            continue
+
+        async def fetch(n=n):
+            conn = await connect(n.address.host, n.address.port)
+            try:
+                workers = await conn.call("list_workers", timeout=10)
+            finally:
+                await conn.close()
+            dumps = []
+            for w in workers:
+                addr = w.get("address")
+                if not addr:
+                    continue
+                host, _, port = addr.partition(":")
+                try:
+                    wc = await connect(host, int(port))
+                    try:
+                        dumps.append(await wc.call("dump_stacks",
+                                                   timeout=10))
+                    finally:
+                        await wc.close()
+                except Exception:
+                    pass
+            return dumps
+
+        try:
+            for d in cw.io.run(fetch()):
+                d["node_id"] = n.node_id.hex()
+                out.append(d)
+        except Exception:
+            pass
+    return out

@@ -150,3 +150,28 @@ def test_memory_report_lists_shm_objects(local_cluster):
     assert all({"object_id", "size", "spilled", "pinned",
                 "node_id"} <= set(o) for o in s["objects"])
     del refs
+
+
+def test_stack_dump_reaches_workers(local_cluster):
+    """`rayt stack` analog: cooperative all-thread dumps from live
+    workers (ref: `ray stack` py-spy path, scripts.py:1934)."""
+    import time as _t
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    @rt.remote(num_cpus=0)
+    class Sleeper:
+        def nap(self, t):
+            _t.sleep(t)
+            return "ok"
+
+    s = Sleeper.remote()
+    assert rt.get(s.nap.remote(0), timeout=60) == "ok"  # actor is up
+    ref = s.nap.remote(3.0)
+    _t.sleep(0.5)
+    dumps = state_api.dump_stacks()
+    assert dumps, "no worker dumps"
+    text = "\n".join(t["stack"] for d in dumps for t in d["threads"])
+    assert "nap" in text  # the in-flight actor method is visible
+    assert rt.get(ref, timeout=30) == "ok"
