@@ -28,6 +28,7 @@ import hashlib
 import io
 import os
 import sys
+import time
 import zipfile
 
 SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
@@ -160,8 +161,11 @@ def ensure_pip_venv(pip_spec: dict) -> str:
     venv_dir = os.path.join(_VENV_ROOT, pip_spec["hash"])
     marker = os.path.join(venv_dir, ".complete")
     if os.path.exists(marker):
-        os.utime(venv_dir)  # LRU touch
-        return venv_dir
+        try:
+            os.utime(venv_dir)  # LRU touch + GC grace-window refresh
+            return venv_dir
+        except OSError:
+            pass  # lost a GC race: fall through to the locked build path
     os.makedirs(_VENV_ROOT, exist_ok=True)
     lock_path = venv_dir + ".lock"
     with open(lock_path, "w") as lock:
@@ -250,8 +254,43 @@ def _gc_venvs(keep: int):
     for stale in entries[keep:]:
         if _venv_in_use(stale):
             continue
-        shutil.rmtree(stale, ignore_errors=True)
-        shutil.rmtree(stale + ".inuse", ignore_errors=True)
+        # Grace window: ensure_pip_venv's marker fast path utime()s the dir
+        # before returning, but the caller pins .inuse only afterwards — a
+        # recently-touched venv may be on a reader's sys.path already.
+        try:
+            if time.time() - os.path.getmtime(stale) < 600.0:
+                continue
+        except OSError:
+            continue
+        # A mid-build venv has no .complete marker and no .inuse pins yet;
+        # the builder holds LOCK_EX on <venv>.lock for the whole build, so
+        # only delete if we can take the lock ourselves (non-blocking).
+        import fcntl
+        try:
+            lock = open(stale + ".lock", "w")
+        except OSError:
+            continue
+        try:
+            try:
+                fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                continue  # builder active: skip this round
+            try:
+                # re-validate under the lock: a reader's utime or a fresh
+                # .inuse pin may have landed since the pre-lock checks
+                try:
+                    if time.time() - os.path.getmtime(stale) < 600.0:
+                        continue
+                except OSError:
+                    continue
+                if _venv_in_use(stale):
+                    continue
+                shutil.rmtree(stale, ignore_errors=True)
+                shutil.rmtree(stale + ".inuse", ignore_errors=True)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+        finally:
+            lock.close()
 
 
 def _extract(key: str, data: bytes, subdir: str | None) -> str:

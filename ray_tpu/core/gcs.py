@@ -38,6 +38,16 @@ CH_LOG = "log_events"
 _DEAD_WORKER_TTL_S = 600.0
 
 
+def _replay_error(payload: str) -> Exception:
+    """Rebuild a dedup-cached handler error as an exception whose type NAME
+    matches the original, so a replayed failure crosses the RPC boundary
+    with the same "ClassName: message" rendering as the first execution."""
+    name, sep, msg = payload.partition(": ")
+    if sep and name.isidentifier():
+        return type(name, (RuntimeError,), {})(msg)
+    return RuntimeError(payload)
+
+
 class GcsServer:
     def __init__(self, persist_path: str | None = None):
         from ray_tpu._internal.config import get_config
@@ -61,12 +71,15 @@ class GcsServer:
         self._actors_placing: set[ActorID] = set()
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
-        # at-most-once envelope for client-retried mutations: req_id ->
-        # ("ok", result) | ("err", msg); bounded LRU, snapshotted so a
-        # replay across a GCS restart still dedupes
+        # at-most-once envelope for client-retried mutations, keyed
+        # per-client so one chatty client can't evict another client's
+        # record before its retry lands: client_id -> (seq -> (ok,
+        # payload)); each client's table is a bounded LRU, snapshotted so
+        # a replay across a GCS restart still dedupes
         from collections import OrderedDict, deque
-        self._dedup_results: OrderedDict[str, tuple] = OrderedDict()
-        self._dedup_inflight: dict[str, asyncio.Future] = {}
+        self._dedup_results: OrderedDict[str, OrderedDict] = OrderedDict()
+        self._dedup_total = 0
+        self._dedup_inflight: dict[tuple, asyncio.Future] = {}
         # task-event ring for `rayt timeline` (ref: gcs_task_manager.h)
         self._task_events: deque = deque(maxlen=50_000)
         # channel -> set of subscribed connections
@@ -126,7 +139,8 @@ class GcsServer:
             "named_actors": self.named_actors,
             "jobs": self.jobs,
             "placement_groups": self.placement_groups,
-            "dedup_results": dict(self._dedup_results),
+            "dedup_results": {c: dict(t)
+                              for c, t in self._dedup_results.items()},
         }
 
     def _write_snapshot(self):
@@ -183,7 +197,16 @@ class GcsServer:
         self.jobs = state.get("jobs", {})
         self.placement_groups = state.get("placement_groups", {})
         from collections import OrderedDict
-        self._dedup_results = OrderedDict(state.get("dedup_results", {}))
+        saved = state.get("dedup_results", {})
+        self._dedup_results = OrderedDict()
+        for c, t in saved.items():
+            if isinstance(t, dict):
+                self._dedup_results[c] = OrderedDict(t)
+            else:  # pre-r4 flat snapshot: req_id -> outcome
+                self._dedup_results.setdefault(
+                    "_legacy", OrderedDict())[c] = t
+        self._dedup_total = sum(
+            len(t) for t in self._dedup_results.values())
         # nodes must re-register (their conns died with the old process);
         # give them a heartbeat grace window before declaring them dead
         for nid in self.nodes:
@@ -276,7 +299,18 @@ class GcsServer:
         return True
 
     # --------------------------------------------------------- dedup envelope
-    _DEDUP_CAP = 4096
+    _DEDUP_CAP_PER_CLIENT = 512   # records per client (retry window is short)
+    _DEDUP_CAP_LEGACY = 4096      # shared bucket for bare-uuid req_ids
+    _DEDUP_CLIENT_CAP = 4096      # distinct clients tracked (LRU)
+    _DEDUP_TOTAL_CAP = 16384      # global record budget: bounds what every
+    # snapshot flush deep-copies + re-pickles on the event-loop thread
+
+    @staticmethod
+    def _dedup_key(req_id):
+        # new clients send (client_id, seq); legacy sends a bare uuid str
+        if isinstance(req_id, (tuple, list)) and len(req_id) == 2:
+            return req_id[0], req_id[1]
+        return "_legacy", req_id
 
     async def rpc_dedup_call(self, conn: Connection, arg):
         """At-most-once execution for client-retried mutations.
@@ -285,19 +319,25 @@ class GcsServer:
         happen *after* the handler executed (and the 100ms snapshot flush
         preserves that execution across a GCS restart). The client sends
         non-idempotent mutations through this envelope with a stable
-        req_id; a replay returns the first execution's cached outcome
-        instead of running the handler twice (ref analog: gRPC server-side
-        idempotency for GCS mutations, ADVICE r2 #2).
+        (client_id, seq) req_id; a replay returns the first execution's
+        cached outcome instead of running the handler twice (ref analog:
+        gRPC server-side idempotency for GCS mutations, ADVICE r2 #2).
+        Records are kept per client so sustained mutation traffic from
+        other clients cannot evict a record before its owner's retry lands
+        (ADVICE r3 #3).
         """
         req_id, method, inner = arg
-        cached = self._dedup_results.get(req_id)
+        client_id, seq = self._dedup_key(req_id)
+        table = self._dedup_results.get(client_id)
+        cached = table.get(seq) if table is not None else None
         if cached is not None:
-            self._dedup_results.move_to_end(req_id)
+            table.move_to_end(seq)
+            self._dedup_results.move_to_end(client_id)
             ok, payload = cached
             if ok:
                 return payload
-            raise RuntimeError(payload)
-        inflight = self._dedup_inflight.get(req_id)
+            raise _replay_error(payload)
+        inflight = self._dedup_inflight.get((client_id, seq))
         if inflight is not None:
             # replay raced the still-running first execution
             return await asyncio.shield(inflight)
@@ -305,32 +345,55 @@ class GcsServer:
         if handler is None:
             raise RuntimeError(f"dedup_call: no handler {method!r}")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._dedup_inflight[req_id] = fut
+        self._dedup_inflight[(client_id, seq)] = fut
         try:
             result = handler(conn, inner)
             if asyncio.iscoroutine(result):
                 result = await result
         except Exception as e:
-            self._record_dedup(req_id, (False, f"{type(e).__name__}: {e}"))
+            self._record_dedup(client_id, seq,
+                               (False, f"{type(e).__name__}: {e}"))
             if not fut.done():
                 fut.set_exception(e)
             fut.exception()  # mark retrieved: no un-awaited error warnings
             raise
         else:
-            self._record_dedup(req_id, (True, result))
+            self._record_dedup(client_id, seq, (True, result))
             if not fut.done():
                 fut.set_result(result)
             return result
         finally:
-            self._dedup_inflight.pop(req_id, None)
+            self._dedup_inflight.pop((client_id, seq), None)
 
-    def _record_dedup(self, req_id: str, outcome: tuple):
+    def _record_dedup(self, client_id: str, seq, outcome: tuple):
         # No mark_dirty here: a mutation that changed the tables already
         # set the dirty flag, so its dedup record rides the same snapshot
         # flush; records for no-op handlers aren't worth a full re-pickle.
-        self._dedup_results[req_id] = outcome
-        while len(self._dedup_results) > self._DEDUP_CAP:
-            self._dedup_results.popitem(last=False)
+        from collections import OrderedDict
+        table = self._dedup_results.get(client_id)
+        if table is None:
+            table = self._dedup_results[client_id] = OrderedDict()
+        self._dedup_results.move_to_end(client_id)
+        if seq not in table:
+            self._dedup_total += 1
+        table[seq] = outcome
+        # the shared legacy bucket (bare-uuid req_ids / pre-r4 snapshot
+        # replays) keeps the old server-wide cap so mixed-version traffic
+        # doesn't shrink its dedup window 8x
+        cap = self._DEDUP_CAP_LEGACY if client_id == "_legacy" \
+            else self._DEDUP_CAP_PER_CLIENT
+        while len(table) > cap:
+            table.popitem(last=False)
+            self._dedup_total -= 1
+        while len(self._dedup_results) > self._DEDUP_CLIENT_CAP:
+            _, dropped = self._dedup_results.popitem(last=False)
+            self._dedup_total -= len(dropped)
+        # global budget: evict whole idle clients (oldest first) so the
+        # 100ms snapshot flush never re-pickles an unbounded record pile
+        while self._dedup_total > self._DEDUP_TOTAL_CAP and \
+                len(self._dedup_results) > 1:
+            _, dropped = self._dedup_results.popitem(last=False)
+            self._dedup_total -= len(dropped)
 
     # ----------------------------------------------------------------- KV
     def rpc_kv_put(self, conn, arg):
@@ -893,10 +956,16 @@ class GcsClient:
     survive a head restart."""
 
     def __init__(self, conn: Connection, address: Address | None = None):
+        import itertools
+        import uuid
+
         self.conn = conn
         self.address = address
         self._subs: dict[str, list] = {}
         self._closing = False
+        # stable identity for the server's per-client dedup tables
+        self._client_id = uuid.uuid4().hex
+        self._dedup_seq = itertools.count()
         if address is not None:
             conn.on_close.append(self._schedule_reconnect)
 
@@ -966,12 +1035,10 @@ class GcsClient:
         (kv_put overwrite=False, register_actor, ...) are wrapped in the
         server's at-most-once ``dedup_call`` envelope: the retry carries
         the same req_id and gets the first execution's cached outcome."""
-        import uuid
-
         from ray_tpu._internal.rpc import ConnectionLost
 
         if method not in self._REPLAY_SAFE:
-            arg = (uuid.uuid4().hex, method, arg)
+            arg = ((self._client_id, next(self._dedup_seq)), method, arg)
             method = "dedup_call"
         try:
             return await self.conn.call(method, arg, timeout=timeout)

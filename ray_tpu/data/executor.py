@@ -87,6 +87,19 @@ class _MapActor:
         return apply_map_spec(self.spec, self.fn, block)
 
 
+def _ship_spec_code(spec: MapSpec) -> None:
+    """Register the spec's user code for by-value pickling. Fused specs hold
+    a list of sub-specs in `fn`, so recurse rather than handing the list to
+    ship_code_by_value (a list has no __module__ and would silently no-op)."""
+    from ray_tpu._internal.serialization import ship_code_by_value
+
+    if spec.kind == "fused":
+        for sub in spec.fn:
+            _ship_spec_code(sub)
+    else:
+        ship_code_by_value(spec.fn)
+
+
 class StreamingExecutor:
     def __init__(self, max_in_flight: int = 8):
         self.max_in_flight = max_in_flight
@@ -97,9 +110,7 @@ class StreamingExecutor:
         if spec.compute is not None:
             yield from self._stream_map_actors(refs, spec)
             return
-        from ray_tpu._internal.serialization import ship_code_by_value
-
-        ship_code_by_value(spec.fn)
+        _ship_spec_code(spec)
         remote_fn = rt.remote(num_cpus=1)(_map_task)
         window = collections.deque()
         for ref in refs:
@@ -110,9 +121,7 @@ class StreamingExecutor:
             yield window.popleft()
 
     def _stream_map_actors(self, refs: Iterator, spec: MapSpec) -> Iterator:
-        from ray_tpu._internal.serialization import ship_code_by_value
-
-        ship_code_by_value(spec.fn)
+        _ship_spec_code(spec)
         n = spec.compute.size
         actor_cls = rt.remote(num_cpus=1)(_MapActor)
         actors = [actor_cls.remote(spec) for _ in range(n)]

@@ -159,31 +159,76 @@ def _topo(final: StepNode) -> list[StepNode]:
 
 
 def _execute(final: StepNode, store: _Store) -> Any:
-    """Run the DAG over cluster tasks, checkpointing every step result
-    (ref: workflow_executor.py step loop — here checkpoint-per-step with
-    dependency-parallel submission within checkpoint barriers)."""
+    """Run the DAG over cluster tasks, checkpointing every step result.
+    Independent branches execute concurrently: every step whose upstreams
+    are resolved is submitted immediately, and results are checkpointed as
+    they arrive (ref: workflow_executor.py step scheduling loop)."""
     import ray_tpu as rt
 
+    nodes = {n.step_id(): n for n in _topo(final)}
     results: dict[str, Any] = {}
-    for node in _topo(final):
-        sid = node.step_id()
+    for sid in nodes:
         if store.has(sid):
             results[sid] = store.load(sid)
-            continue
+    submitted: set[str] = set(results)
+    inflight: dict[Any, str] = {}  # ObjectRef -> step_id
 
-        def resolve(a):
-            return results[a.step_id()] if isinstance(a, StepNode) else a
+    def resolve(a):
+        return results[a.step_id()] if isinstance(a, StepNode) else a
 
-        args = [resolve(a) for a in node.args]
-        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
-        task = rt.remote(num_cpus=node.num_cpus,
-                         max_retries=node.max_retries)(node.fn)
-        value = rt.get(task.remote(*args, **kwargs))
-        store.save(sid, value, {
-            "name": node.name,
-            "upstream": [u.step_id() for u in node.upstream()],
-            "finished_at": time.time()})
+    def submit_ready():
+        for sid, node in nodes.items():
+            if sid in submitted:
+                continue
+            if any(u.step_id() not in results for u in node.upstream()):
+                continue
+            args = [resolve(a) for a in node.args]
+            kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            task = rt.remote(num_cpus=node.num_cpus,
+                             max_retries=node.max_retries)(node.fn)
+            inflight[task.remote(*args, **kwargs)] = sid
+            submitted.add(sid)
+
+    def harvest(ref) -> Exception | None:
+        """Checkpoint one finished ref; return its error instead of raising
+        so a failing branch can't discard completed siblings' results."""
+        sid = inflight.pop(ref)
+        try:
+            value = rt.get(ref)
+            node = nodes[sid]
+            store.save(sid, value, {
+                "name": node.name,
+                "upstream": [u.step_id() for u in node.upstream()],
+                "finished_at": time.time()})
+        except Exception as e:  # incl. save errors (ENOSPC, ...): the
+            return e            # drain loop must never lose first_error
         results[sid] = value
+        return None
+
+    first_error: Exception | None = None
+    submit_ready()  # nothing in flight yet: a submit error may propagate
+    while final.step_id() not in results:
+        if not inflight:
+            raise RuntimeError("workflow has unrunnable steps (cycle?)")
+        done, _ = rt.wait(list(inflight), num_returns=1)
+        for ref in done:
+            first_error = first_error or harvest(ref)
+        if first_error is None:
+            try:
+                submit_ready()
+            except Exception as e:  # submission failure: drain like a
+                first_error = e     # failed step so siblings checkpoint
+        if first_error is not None:
+            # drain still-running siblings so their work is checkpointed
+            # before the failure propagates (resume won't redo it)
+            while inflight:
+                done, _ = rt.wait(list(inflight),
+                                  num_returns=len(inflight), timeout=300.0)
+                if not done:
+                    break
+                for ref in done:
+                    harvest(ref)
+            raise first_error
     return results[final.step_id()]
 
 
