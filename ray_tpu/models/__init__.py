@@ -2,5 +2,7 @@
 torch; here the model layer is co-designed with sharding, see
 models/llama.py docstring)."""
 
-from ray_tpu.models import llama  # noqa: F401
+from ray_tpu.models import llama, lora  # noqa: F401
+from ray_tpu.models.lora import (LoraConfig, init_lora_params,  # noqa: F401
+                                 lora_logical_axes, merge_lora)
 from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init, mlp_loss  # noqa: F401

@@ -61,6 +61,9 @@ class LlamaConfig:
     # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "auto"
     seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
+    # LoRA: scale numerator for the low-rank path (scale = alpha / rank,
+    # rank inferred from the adapter's shape; see models/lora.py)
+    lora_alpha: float = 16.0
     # MoE: >0 replaces every dense FFN with a mixture of this many experts
     # (EP over the `expert` mesh axis; see ops/moe.py)
     moe_num_experts: int = 0
@@ -226,15 +229,30 @@ def _attention(cfg: LlamaConfig, q, k, v):
 
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     """One transformer block. x: [b, s, d] (cfg.dtype).
-    Returns (x, moe_aux_loss) — aux is 0 for the dense path."""
+    Returns (x, moe_aux_loss) — aux is 0 for the dense path.
+
+    When the layer dict carries LoRA adapters ("<w>_a"/"<w>_b", stacked
+    like the base weights — see models/lora.py), the low-rank path
+    ``h @ A @ B * (alpha/r)`` is added next to the frozen matmul; the
+    full-rank delta is never materialized.
+    """
     b, s, d = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     dt = cfg.dtype
 
+    def proj(name, h):
+        out = h @ layer[name].astype(dt)
+        a = layer.get(name + "_a")
+        if a is not None:
+            scale = cfg.lora_alpha / a.shape[-1]
+            out = out + ((h @ a.astype(dt)) @ layer[name + "_b"].astype(dt)
+                         ) * jnp.asarray(scale, dt)
+        return out
+
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(b, s, nh, hd)
-    kk = (h @ layer["wk"].astype(dt)).reshape(b, s, nkv, hd)
-    vv = (h @ layer["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = proj("wq", h).reshape(b, s, nh, hd)
+    kk = proj("wk", h).reshape(b, s, nkv, hd)
+    vv = proj("wv", h).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     kk = apply_rope(kk, cos, sin, positions)
     attn = _attention(cfg, q, kk, vv).reshape(b, s, nh * hd)
@@ -243,7 +261,7 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     # the whole flash kernel forward inside the backward pass (~+33% on
     # the attention budget) to rebuild this one activation.
     attn = checkpoint_name(attn, "attn_out")
-    x = x + attn @ layer["wo"].astype(dt)
+    x = x + proj("wo", attn)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.moe:
@@ -253,9 +271,9 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
                       "w_up": layer["w_up"], "w_down": layer["w_down"]}
         out, aux = moe_ffn(moe_params, h, cfg.moe_config())
         return x + out, aux
-    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-    up = h @ layer["w_up"].astype(dt)
-    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    gate = jax.nn.silu(proj("w_gate", h))
+    up = proj("w_up", h)
+    x = x + proj("w_down", gate * up)
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -271,6 +289,11 @@ def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     dt = cfg.dtype
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    scanned_layers = params["layers"]
+    if "lora" in params:
+        # adapters are stacked on the same leading [n_layers] axis, so
+        # they ride the same scan as the base weights (models/lora.py)
+        scanned_layers = {**scanned_layers, **params["lora"]["layers"]}
 
     def step(carry, layer):
         x, aux_sum = carry
@@ -288,7 +311,7 @@ def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
                 jax.checkpoint_policies.save_only_these_names("attn_out"))
         step = jax.checkpoint(step, policy=policy)
     (x, aux_sum), _ = jax.lax.scan(
-        step, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        step, (x, jnp.zeros((), jnp.float32)), scanned_layers)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if with_aux:
         return x, aux_sum

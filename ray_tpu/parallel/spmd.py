@@ -50,16 +50,30 @@ def shard_batch(batch: Any, mesh: Mesh, seq_axis: bool = False) -> Any:
 def build_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                      params: Any, logical_axes: Any, mesh: Mesh,
                      rules: dict | None = None, seq_sharded_batch: bool = False,
-                     grad_accum: int = 1):
+                     grad_accum: int = 1,
+                     trainable_keys: tuple | None = None):
     """Returns (compiled_step, sharded_initial_state).
 
     loss_fn(params, batch) -> (loss, aux_dict). State = {params, opt_state,
     step}. The step donates the state buffers (in-place update in HBM).
+
+    trainable_keys: top-level param-dict keys to train (e.g. ("lora",) for
+    adapter fine-tuning). The rest move to state["frozen"]: the backward
+    pass never computes their gradients and the optimizer holds no moments
+    for them — the LoRA FLOP/memory win, not a zero-masked imitation.
     """
     rules = rules or DEFAULT_RULES
     param_shardings = shard_params(params, logical_axes, mesh, rules)
     params = jax.tree.map(
         lambda x, s: jax.device_put(jnp.asarray(x), s), params, param_shardings)
+    frozen = {}
+    if trainable_keys is not None:
+        missing = [k for k in trainable_keys if k not in params]
+        if missing:
+            raise ValueError(f"trainable_keys {missing} not in params")
+        frozen = {k: v for k, v in params.items() if k not in trainable_keys}
+        params = {k: params[k] for k in trainable_keys}
+        param_shardings = {k: param_shardings[k] for k in trainable_keys}
     opt_state = jax.jit(
         optimizer.init,
         out_shardings=_opt_state_shardings(optimizer, params, param_shardings,
@@ -67,13 +81,17 @@ def build_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     state = {"params": params, "opt_state": opt_state,
              "step": jax.device_put(jnp.zeros((), jnp.int32),
                                     NamedSharding(mesh, P()))}
+    if frozen:
+        state["frozen"] = frozen
     state_shardings = jax.tree.map(
         lambda x: x.sharding, state,
         is_leaf=lambda x: isinstance(x, jax.Array))
 
     def one_step(state, batch):
         def compute(p, b):
-            loss, aux = loss_fn(p, b)
+            # params stay an arbitrary pytree unless a frozen split exists
+            full = {**state["frozen"], **p} if "frozen" in state else p
+            loss, aux = loss_fn(full, b)
             return loss, aux
 
         if grad_accum > 1:
@@ -103,8 +121,11 @@ def build_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         # keep param dtype stable (optax promotes on mixed dtypes)
         new_params = jax.tree.map(
             lambda new, old: new.astype(old.dtype), new_params, state["params"])
-        return ({"params": new_params, "opt_state": new_opt,
-                 "step": state["step"] + 1}, aux)
+        out = {"params": new_params, "opt_state": new_opt,
+               "step": state["step"] + 1}
+        if "frozen" in state:
+            out["frozen"] = state["frozen"]  # donated buffers pass through
+        return (out, aux)
 
     b_shard = batch_sharding(mesh, seq_sharded_batch)
     step = jax.jit(

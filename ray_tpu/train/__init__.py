@@ -7,6 +7,7 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
                                   Result, RunConfig, ScalingConfig)
 from ray_tpu.train.controller import (FailurePolicy, ScalingPolicy,  # noqa: F401
                                       TrainController, TrainingFailedError)
+from ray_tpu.train.recipes import lora_finetune_loop  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
                                    report)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

@@ -1,0 +1,120 @@
+"""Reusable train-loop recipes for JaxTrainer.
+
+The reference ships fine-tuning as free-standing torch/DeepSpeed example
+scripts (ref: doc/source/train/examples/deepspeed/,
+release/air_examples/dolly_v2_lightning_fsdp_finetuning/); here the
+canonical loops are library code so tests, benches, and users share one
+implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def lora_finetune_loop(config: dict):
+    """LoRA fine-tune a Llama-family model (BASELINE.json config #3).
+
+    Runs inside each TrainWorker: builds the mesh from ScalingConfig,
+    initializes (or loads) frozen base params + LoRA adapters, and trains
+    ONLY the adapters (build_train_step(trainable_keys=("lora",)) — the
+    backward computes no base-weight gradients and the optimizer holds
+    moments only for A/B).
+
+    config keys:
+      preset        — llama preset name (default "debug")
+      model_overrides — dict merged into the preset config
+      lora_rank / lora_alpha / lora_targets
+      lr, steps, batch_size, seq_len, grad_accum
+      report_every  — steps between train.report calls (default 10)
+      batch_fn      — optional callable (step, rank) -> {"tokens","targets"}
+                      (defaults to synthetic LM data)
+      init_params_fn — optional callable (cfg) -> base params (defaults to
+                      random init; real runs pass a checkpoint loader)
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.models import llama, lora
+    from ray_tpu.parallel.spmd import build_train_step, shard_batch
+    from ray_tpu.train.checkpoint import Checkpoint, save_pytree
+
+    ctx = train.get_context()
+    mesh = ctx.get_mesh()
+
+    overrides = dict(config.get("model_overrides") or {})
+    overrides.setdefault("lora_alpha", config.get("lora_alpha", 16.0))
+    cfg = llama.config_for(config.get("preset", "debug"), **overrides)
+    lcfg = lora.LoraConfig(
+        # cfg.lora_alpha is the single source of truth for the scale (the
+        # forward and merge_lora both read it); mirror it here for repr
+        rank=config.get("lora_rank", 8),
+        alpha=cfg.lora_alpha,
+        targets=tuple(config.get("lora_targets", lora.DEFAULT_TARGETS)))
+
+    key = jax.random.PRNGKey(config.get("seed", 0))
+    init_fn: Callable[[Any], Any] = config.get("init_params_fn") \
+        or (lambda c: llama.init_params(c, key))
+    base = init_fn(cfg)
+    adapters = lora.init_lora_params(cfg, lcfg, jax.random.fold_in(key, 1))
+    params = {**base, "lora": adapters}
+    axes = {**llama.param_logical_axes(cfg),
+            "lora": lora.lora_logical_axes(cfg, lcfg)}
+
+    loss = lambda p, b: llama.loss_fn(p, b, cfg)
+    step, state = build_train_step(
+        loss, optax.adamw(config.get("lr", 1e-3)), params, axes, mesh,
+        grad_accum=config.get("grad_accum", 1),
+        trainable_keys=("lora",))
+
+    rank = ctx.get_world_rank()
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        # failure-policy restart: reload the adapters + step so retries
+        # resume instead of re-randomizing (optimizer moments reset — the
+        # adapters-only artifact stays small and serving-loadable)
+        from ray_tpu.train.checkpoint import load_pytree
+
+        restored = load_pytree(ckpt.subdir(f"rank_{rank}").path)
+        loaded = jax.tree.map(jnp.asarray, restored["lora"])
+        state["params"]["lora"] = jax.tree.map(
+            lambda x, cur: jax.device_put(x.astype(cur.dtype), cur.sharding),
+            loaded, state["params"]["lora"])
+        start_step = int(restored["step"])
+
+    bsz = config.get("batch_size", 8)
+    seq = config.get("seq_len", min(128, cfg.max_seq_len))
+    batch_fn = config.get("batch_fn")
+
+    def synthetic(i, rank):
+        k = jax.random.PRNGKey(1000 * rank + i)
+        toks = jax.random.randint(k, (bsz, seq), 0, cfg.vocab_size)
+        return {"tokens": toks,
+                "targets": jnp.roll(toks, -1, axis=1)}
+
+    make_batch = batch_fn or synthetic
+    report_every = config.get("report_every", 10)
+    steps = config.get("steps", 50)
+
+    import tempfile
+
+    last_loss = first_loss = None
+    for i in range(start_step, steps):
+        batch = shard_batch(make_batch(i, rank), mesh)
+        state, aux = step(state, batch)
+        if (i + 1) % report_every == 0 or i == steps - 1:
+            last_loss = float(aux["loss"])
+            if first_loss is None:
+                first_loss = last_loss
+            with tempfile.TemporaryDirectory() as d:
+                # adapters-only checkpoint: the LoRA artifact is the
+                # deliverable (base stays wherever it was loaded from)
+                save_pytree({"lora": state["params"]["lora"],
+                             "step": i + 1}, d)
+                train.report({"loss": last_loss, "first_loss": first_loss,
+                              "step": i + 1},
+                             checkpoint=Checkpoint(d))
+    return last_loss

@@ -149,3 +149,81 @@ def test_mlp_trains():
         params, state, loss = step(params, state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5
+
+
+# ------------------------------------------------------------------ LoRA
+def test_lora_zero_init_is_identity(cfg, params):
+    """B=0 at init: forward with adapters matches the base model exactly
+    (models/lora.py init contract)."""
+    from ray_tpu.models import lora
+
+    lcfg = lora.LoraConfig(rank=4, targets=("wq", "wo", "w_up"))
+    lp = lora.init_lora_params(cfg, lcfg, jax.random.PRNGKey(3))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                cfg.vocab_size)
+    base = llama.forward(params, tokens, cfg)
+    with_lora = llama.forward({**params, "lora": lp}, tokens, cfg)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(with_lora))
+
+
+def test_lora_merge_matches_lowrank_path(cfg, params):
+    """After training-style perturbation of A/B, folding the adapters into
+    the base weights (merge_lora) reproduces the low-rank forward."""
+    from ray_tpu.models import lora
+
+    lcfg = lora.LoraConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+    lp = lora.init_lora_params(cfg, lcfg, jax.random.PRNGKey(5))
+    # make the adapters non-trivial
+    lp = jax.tree.map(
+        lambda x: x + 0.02 * jax.random.normal(
+            jax.random.PRNGKey(6), x.shape, x.dtype), lp)
+    cfg_l = llama.LlamaConfig(**{**cfg.__dict__, "lora_alpha": lcfg.alpha})
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0,
+                                cfg.vocab_size)
+    low_rank = llama.forward({**params, "lora": lp}, tokens, cfg_l)
+    merged = lora.merge_lora({**params, "lora": lp}, cfg_l, lcfg)
+    assert "lora" not in merged
+    folded = llama.forward(merged, tokens, cfg_l)
+    # bf16 low-rank path vs f32-folded delta: per-layer rounding compounds
+    np.testing.assert_allclose(np.asarray(low_rank), np.asarray(folded),
+                               atol=0.15, rtol=0.1)
+
+
+def test_lora_train_step_freezes_base(cfg, params):
+    """build_train_step(trainable_keys=("lora",)): loss falls, adapters
+    move, and every frozen base leaf stays bit-identical (VERDICT r3 #2)."""
+    from ray_tpu.models import lora
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    lcfg = lora.LoraConfig(rank=4, targets=("wq", "wk", "wv", "wo"))
+    lp = lora.init_lora_params(cfg, lcfg, jax.random.PRNGKey(8))
+    full = {**params, "lora": lp}
+    axes = {**llama.param_logical_axes(cfg),
+            "lora": lora.lora_logical_axes(cfg, lcfg)}
+    mesh = MeshConfig(data=2, fsdp=2, tensor=2).build(
+        jax.devices("cpu")[:8])
+    loss = lambda p, b: llama.loss_fn(p, b, cfg)
+    step, state = build_train_step(
+        loss, optax.adamw(1e-2), full, axes, mesh,
+        trainable_keys=("lora",))
+    base_before = jax.tree.map(np.asarray, state["frozen"])
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(9), (4, 32), 0,
+                                     cfg.vocab_size),
+        "targets": jax.random.randint(jax.random.PRNGKey(10), (4, 32), 0,
+                                      cfg.vocab_size),
+    }
+    batch = shard_batch(batch, mesh)
+    losses = []
+    for _ in range(8):
+        state, aux = step(state, batch)
+        losses.append(float(aux["loss"]))
+    assert losses[-1] < losses[0], losses
+    # adapters moved
+    b_leaf = np.asarray(state["params"]["lora"]["layers"]["wq_b"])
+    assert np.abs(b_leaf).max() > 0
+    # base params bit-identical
+    jax.tree.map(
+        lambda before, after: np.testing.assert_array_equal(
+            before, np.asarray(after)),
+        base_before, state["frozen"])
