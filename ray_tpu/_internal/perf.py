@@ -85,6 +85,30 @@ def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
     r["rate_per_s"] = round(r["rate_per_s"] * big.nbytes / (1 << 30), 3)
     results.append(r)
 
-    for a in (c, ac):
+    # compiled-DAG per-tick cost: per-call executor vs pre-allocated shm
+    # channel loops (ref: compiled_dag_node.py fast path; VERDICT r3 #3)
+    @rt.remote
+    class Echo:
+        def apply(self, x):
+            return x
+
+    e1, e2 = Echo.remote(), Echo.remote()
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag_out = e2.apply.bind(e1.apply.bind(inp))
+    legacy = dag_out.experimental_compile(channels=False)
+    legacy.execute(0).get(timeout=60)
+    results.append(_timeit(
+        "dag_percall_ticks_per_second",
+        lambda: legacy.execute(1).get(timeout=60), 1, duration))
+    chan = dag_out.experimental_compile(channels=True)
+    chan.execute(0).get(timeout=60)
+    results.append(_timeit(
+        "dag_channel_ticks_per_second",
+        lambda: chan.execute(1).get(timeout=60), 1, duration))
+    chan.teardown()
+
+    for a in (c, ac, e1, e2):
         rt.kill(a)
     return results

@@ -1572,6 +1572,13 @@ class CoreWorker:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
             method = getattr(self.actor_instance, spec.method_name, None)
+            if method is None and spec.method_name == "__rayt_apply__":
+                # runtime escape hatch: run fn(actor_instance, *args) on
+                # the actor without requiring the user class to define it
+                # (the compiled-DAG executor loop rides this; ref analog:
+                # __ray_call__ in python/ray/actor.py)
+                inst = self.actor_instance
+                method = lambda fn, *a, **k: fn(inst, *a, **k)  # noqa: E731
             if method is None:
                 raise AttributeError(
                     f"actor has no method {spec.method_name!r}")

@@ -1,0 +1,174 @@
+"""Pre-allocated mutable channels for compiled DAGs.
+
+Ref analog: python/ray/experimental/channel/ — shared_memory_channel.py
+(mutable shm ring written per-tick), intra_process_channel.py. The point
+of the compiled-DAG fast path is that per-tick values move through
+pre-negotiated fixed buffers instead of the task-submission control plane
+(ref compiled_dag_node.py:757): no task spec, no lease, no object-store
+churn per call.
+
+`ShmChannel` is a single-producer single-consumer ring over POSIX shared
+memory (multiprocessing.shared_memory). Cross-process visibility relies
+on the SPSC discipline: the producer writes the payload bytes first and
+publishes by bumping ``write_seq`` last; the consumer reads ``write_seq``
+before the payload and releases the slot by bumping ``read_seq`` last
+(x86/ARM64 total-store-order through the kernel-shared mapping is enough
+for this protocol at Python speeds; each seq has one writer).
+
+Capacity gives pipelining: a ring of N slots lets N ticks be in flight
+between two stages before the producer blocks (GPipe-style microbatch
+overlap over host edges).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+
+_HDR = struct.Struct("<QQQQB")  # write_seq, read_seq, slot_size, n_slots, closed
+_LEN = struct.Struct("<Q")      # per-slot payload length prefix
+_HDR_SIZE = 64                  # one cache line; header never shares a slot
+
+
+def _open_untracked(**kwargs) -> shared_memory.SharedMemory:
+    """Open a SharedMemory segment WITHOUT resource_tracker registration:
+    the channel owner unlinks deterministically in close()/teardown(),
+    and 3.12's unconditional registration would otherwise let an exiting
+    attacher's tracker unlink a live ring (or double-unlink noise when
+    several attachers share one tracker). SharedMemory(track=False)
+    replaces this from 3.13."""
+    from multiprocessing import resource_tracker
+
+    orig = resource_tracker.register
+    resource_tracker.register = lambda *a, **k: None
+    try:
+        return shared_memory.SharedMemory(**kwargs)
+    finally:
+        resource_tracker.register = orig
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class ChannelSpec:
+    """Serializable descriptor shipped to actors inside the DAG schedule."""
+    name: str
+    slot_size: int
+    n_slots: int
+
+
+class ShmChannel:
+    """SPSC mutable ring channel. One side calls create(), the schedule
+    carries the ChannelSpec, the other side attach()es."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, spec: ChannelSpec,
+                 owner: bool):
+        self._shm = shm
+        self.spec = spec
+        self._owner = owner
+        self._buf = shm.buf
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def create(cls, slot_size: int = 1 << 20, n_slots: int = 8,
+               name: str | None = None) -> "ShmChannel":
+        size = _HDR_SIZE + n_slots * (_LEN.size + slot_size)
+        shm = _open_untracked(create=True, size=size, name=name)
+        _HDR.pack_into(shm.buf, 0, 0, 0, slot_size, n_slots, 0)
+        spec = ChannelSpec(shm.name, slot_size, n_slots)
+        return cls(shm, spec, owner=True)
+
+    @classmethod
+    def attach(cls, spec: ChannelSpec) -> "ShmChannel":
+        shm = _open_untracked(name=spec.name)
+        return cls(shm, spec, owner=False)
+
+    def close(self):
+        try:
+            self._mark_closed()
+        except Exception:
+            pass
+        try:
+            self._buf = None
+            self._shm.close()
+        except Exception:
+            pass
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except Exception:
+                pass
+
+    # -------------------------------------------------------------- protocol
+    def _seqs(self) -> tuple[int, int, bool]:
+        w, r, _, _, closed = _HDR.unpack_from(self._buf, 0)
+        return w, r, bool(closed)
+
+    def _set_write_seq(self, w: int):
+        struct.pack_into("<Q", self._buf, 0, w)
+
+    def _set_read_seq(self, r: int):
+        struct.pack_into("<Q", self._buf, 8, r)
+
+    def _mark_closed(self):
+        if self._buf is not None:
+            struct.pack_into("<B", self._buf, 32, 1)
+
+    def _slot_off(self, seq: int) -> int:
+        i = seq % self.spec.n_slots
+        return _HDR_SIZE + i * (_LEN.size + self.spec.slot_size)
+
+    def write_bytes(self, payload: bytes, timeout: float | None = None):
+        if len(payload) > self.spec.slot_size:
+            # non-retryable (unlike a transiently-full ring, which blocks)
+            raise ValueError(
+                f"item of {len(payload)} bytes exceeds the channel slot "
+                f"size {self.spec.slot_size}; recompile the DAG with a "
+                f"larger buffer_size_bytes")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pause = 0.0
+        while True:
+            w, r, closed = self._seqs()
+            if closed:
+                raise ChannelClosed()
+            if w - r < self.spec.n_slots:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out (ring full)")
+            time.sleep(pause)
+            pause = min(0.001, pause + 0.00005)
+        off = self._slot_off(w)
+        _LEN.pack_into(self._buf, off, len(payload))
+        self._buf[off + _LEN.size:off + _LEN.size + len(payload)] = payload
+        self._set_write_seq(w + 1)  # publish LAST
+
+    def read_bytes(self, timeout: float | None = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pause = 0.0
+        while True:
+            w, r, closed = self._seqs()
+            if w > r:
+                break
+            if closed:
+                raise ChannelClosed()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out (ring empty)")
+            time.sleep(pause)
+            pause = min(0.001, pause + 0.00005)
+        off = self._slot_off(r)
+        (length,) = _LEN.unpack_from(self._buf, off)
+        payload = bytes(self._buf[off + _LEN.size:off + _LEN.size + length])
+        self._set_read_seq(r + 1)  # release LAST
+        return payload
+
+    # ----------------------------------------------------------- object api
+    def write(self, value, timeout: float | None = None):
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def read(self, timeout: float | None = None):
+        return pickle.loads(self.read_bytes(timeout))

@@ -1,0 +1,48 @@
+"""Collective nodes for compiled DAGs (ref analog:
+python/ray/dag/collective_node.py:19, experimental/collective/allreduce.py).
+
+``allreduce.bind([n1, ..., nk])`` inserts one collective op per
+participating actor: each actor contributes its upstream node's value and
+receives the reduced result in-loop. On the channel fast path the
+reduction runs over the out-of-band collective group
+(util/collective, GCS-KV rendezvous — the NCCL-group analog); the
+per-call fallback executor reduces via the object store on the driver.
+
+For values living on a TPU mesh the right tool is usually an in-mesh
+``psum`` inside one jit — DAG collectives are the MPMD-level reduction
+between separate SPMD programs (e.g. pipeline stages exchanging host
+scalars/metrics, or data-parallel actors averaging host gradients).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from ray_tpu.dag.node import ClassMethodNode
+
+
+class _AllreduceBinder:
+    def bind(self, nodes: list, op: str = "sum",
+             group_name: str | None = None) -> list:
+        if not nodes:
+            raise ValueError("allreduce.bind needs at least one node")
+        if not all(isinstance(n, ClassMethodNode) for n in nodes):
+            raise TypeError("allreduce.bind takes actor-method nodes")
+        actors = {id(n.actor) for n in nodes}
+        if len(actors) != len(nodes):
+            raise ValueError(
+                "allreduce participants must be distinct actors")
+        name = group_name or f"dag-ar-{uuid.uuid4().hex[:8]}"
+        out = []
+        for rank, n in enumerate(nodes):
+            node = ClassMethodNode(n.actor, "__collective_allreduce__",
+                                   (n,), {})
+            node.collective = f"allreduce:{op}"
+            node.collective_group = name
+            node.collective_rank = rank
+            node.collective_world = len(nodes)
+            out.append(node)
+        return out
+
+
+allreduce = _AllreduceBinder()

@@ -1,5 +1,10 @@
-"""Compiled DAG execution (ref analog: python/ray/dag/compiled_dag_node.py:757
-`CompiledDAG`, dag_node_operation.py per-actor schedules).
+"""Per-call compiled DAG execution — the FALLBACK executor.
+
+Eligible DAGs compile onto pre-allocated shm channels with frozen
+per-actor schedules instead (dag/channel_exec.py — the fast path, ref
+analog: python/ray/dag/compiled_dag_node.py:757 + dag_node_operation.py);
+this module handles the rest: function nodes, device edges, multi-node
+actor graphs.
 
 compile() topologically sorts the graph once and freezes the submission
 plan; execute() replays it with object refs wired producer→consumer, so
@@ -27,6 +32,25 @@ from typing import Any
 
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode, FunctionNode,
                               InputAttributeNode, InputNode, MultiOutputNode)
+
+
+def _collective_apply_fallback(self, gname: str, world: int, rank: int,
+                               spec: str, value):
+    """Runs on the member actor via __rayt_apply__: one-shot out-of-band
+    reduction for the per-call executor (the channel executor keeps a
+    long-lived group instead)."""
+    from ray_tpu.util.collective import init_collective_group
+
+    kind, op = spec.split(":")
+    assert kind == "allreduce", spec
+    group = init_collective_group(world, rank, group_name=gname)
+    try:
+        return group.allreduce(value, op=op)
+    finally:
+        try:
+            group.destroy()
+        except Exception:
+            pass
 
 
 class CompiledDAGRef:
@@ -75,6 +99,11 @@ class CompiledDAG:
     def execute_async(self, *args, **kwargs) -> CompiledDAGRef:
         """Submit one pass through the DAG; returns immediately (pipeline
         microbatches by calling repeatedly)."""
+        import uuid
+
+        # unique per execution: collective members of THIS pass rendezvous
+        # under it, so overlapping/repeated executions never collide
+        exec_tag = uuid.uuid4().hex[:8]
         values: dict[int, Any] = {}
         for node in self.topo:
             if isinstance(node, InputNode):
@@ -93,6 +122,20 @@ class CompiledDAG:
                     values[id(node)] = getattr(parent_val, node.key)
                 else:
                     values[id(node)] = parent_val[node.key]
+            elif isinstance(node, ClassMethodNode) and \
+                    getattr(node, "collective", None):
+                # per-call fallback for collective nodes: each member actor
+                # joins a per-tick out-of-band group and reduces (slow path
+                # — the channel executor keeps one long-lived group)
+                from ray_tpu.api import ActorMethod
+
+                gname = f"{node.collective_group}-{exec_tag}"
+                val = self._resolve(node.args[0], values)
+                m = ActorMethod(node.actor, "__rayt_apply__")
+                values[id(node)] = m.remote(
+                    _collective_apply_fallback, gname,
+                    node.collective_world, node.collective_rank,
+                    node.collective, val)
             elif isinstance(node, ClassMethodNode):
                 call_args = tuple(self._resolve(a, values)
                                   for a in node.args)
@@ -137,4 +180,4 @@ class CompiledDAG:
         return rt.put(value)
 
     def teardown(self):
-        pass  # no persistent channels yet (see module docstring)
+        pass  # per-call path holds no persistent resources

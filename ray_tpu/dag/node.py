@@ -12,9 +12,28 @@ class DAGNode:
 
         return CompiledDAG(self).execute(*args, **kwargs)
 
-    def experimental_compile(self) -> "object":
+    def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
+                             max_inflight: int = 8,
+                             channels: object = "auto") -> "object":
+        """Compile the DAG. channels="auto" uses the pre-allocated shm
+        channel fast path (dag/channel_exec.py) when the graph is
+        eligible (actor-only, host edges, node-local), else falls back to
+        the per-call executor; True forces channels (raises if
+        ineligible); False forces the per-call executor."""
         from ray_tpu.dag.compiled import CompiledDAG
 
+        if channels in ("auto", True):
+            from ray_tpu.dag.channel_exec import (ChannelCompiledDAG,
+                                                  Ineligible)
+
+            try:
+                return ChannelCompiledDAG(
+                    self, CompiledDAG._topo_sort(self),
+                    buffer_size_bytes=buffer_size_bytes,
+                    max_inflight=max_inflight)
+            except Ineligible:
+                if channels is True:
+                    raise
         return CompiledDAG(self)
 
     def _upstream(self) -> list["DAGNode"]:

@@ -78,6 +78,13 @@ def init_lora_params(cfg: LlamaConfig, lora: LoraConfig,
                      key: jax.Array) -> dict:
     """A ~ N(0, 1/r) (Kaiming-style), B = 0 — the adapter starts as an
     exact no-op so step 0 matches the frozen base model bit-for-bit."""
+    if lora.alpha != cfg.lora_alpha:
+        # the forward pass and merge_lora read cfg.lora_alpha; a LoraConfig
+        # with a different alpha would silently train at the wrong scale
+        raise ValueError(
+            f"LoraConfig.alpha={lora.alpha} != LlamaConfig.lora_alpha="
+            f"{cfg.lora_alpha}; set them consistently (e.g. "
+            f"config_for(name, lora_alpha=...))")
     if cfg.moe and any(t in ("w_gate", "w_up", "w_down")
                        for t in lora.targets):
         raise ValueError("LoRA on MoE expert FFNs is not supported; "
@@ -111,15 +118,13 @@ def lora_logical_axes(cfg: LlamaConfig, lora: LoraConfig) -> dict:
     return {"layers": layers}
 
 
-def merge_lora(params: dict, cfg: LlamaConfig,
-               lora: LoraConfig | None = None) -> dict:
+def merge_lora(params: dict, cfg: LlamaConfig) -> dict:
     """Fold adapters into the base weights (for serving/decode paths that
     don't know about LoRA). Returns a NEW params dict without "lora".
 
     The scale comes from ``cfg.lora_alpha`` — the SAME source the forward
-    pass uses — so merged weights always match the trained model
-    regardless of what any LoraConfig floating around says. Targets are
-    inferred from the adapter keys themselves.
+    pass uses — so merged weights always match the trained model. Targets
+    are inferred from the adapter keys themselves.
     """
     if "lora" not in params:
         return params

@@ -157,6 +157,12 @@ class CollectiveGroup:
         return self.peer.recv(src_rank, tag, timeout)
 
     def destroy(self):
+        # drop the registry entry too, so the same group name can be
+        # re-initialized later (destroy_collective_group and direct
+        # group.destroy() behave identically)
+        with _groups_lock:
+            if _groups.get(self.name) is self:
+                _groups.pop(self.name)
         if self._store is not None:
             _kv_del(f"{self.name}/store")
             self._store.close()

@@ -90,3 +90,123 @@ def test_dag_node_direct_execute(local_cluster):
     with InputNode() as inp:
         node = inc.bind(inp)
     assert node.execute(41).get(timeout=60) == 42
+
+
+# ------------------------------------------------- channel fast path (r4)
+def test_channel_compile_is_default_and_pipelines(local_cluster):
+    """Eligible DAGs compile onto pre-allocated shm channels
+    (dag/channel_exec.py); ticks overlap through the rings."""
+    import time
+
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class Stage:
+        def work(self, x):
+            time.sleep(0.05)
+            return x + 1
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        out = s2.work.bind(s1.work.bind(inp))
+    dag = out.experimental_compile(channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    try:
+        # warm both loops
+        assert dag.execute(0).get(timeout=60) == 2
+        n = 8
+        t0 = time.monotonic()
+        refs = [dag.execute(i) for i in range(n)]
+        vals = [r.get(timeout=60) for r in refs]
+        elapsed = time.monotonic() - t0
+        assert vals == [i + 2 for i in range(n)]
+        # serial would be n*2*0.05 = 0.8s; pipelined ~ (n+1)*0.05 = 0.45s
+        assert elapsed < 0.75, f"stages did not overlap ({elapsed:.2f}s)"
+    finally:
+        dag.teardown()
+
+
+def test_channel_diamond_multi_output(local_cluster):
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class Mul:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            return x * self.k
+
+    @rt.remote
+    class Sum:
+        def combine(self, a, b):
+            return a + b
+
+    m2, m3, s = Mul.remote(2), Mul.remote(3), Sum.remote()
+    with InputNode() as inp:
+        left = m2.apply.bind(inp)
+        right = m3.apply.bind(inp)
+        total = s.combine.bind(left, right)
+        dag = MultiOutputNode([left, right, total]).experimental_compile(
+            channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    try:
+        assert dag.execute(4).get(timeout=60) == [8, 12, 20]
+        assert dag.execute(5).get(timeout=60) == [10, 15, 25]
+    finally:
+        dag.teardown()
+
+
+def test_dag_allreduce_channel_path(local_cluster):
+    """Collective allreduce nodes ride a long-lived out-of-band group
+    inside the actor loops (ref: dag/collective_node.py:19)."""
+    import numpy as np
+
+    from ray_tpu.dag import collective
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class W:
+        def __init__(self, k):
+            self.k = k
+
+        def grad(self, x):
+            return np.full((4,), float(x * self.k))
+
+    a, b = W.remote(1), W.remote(2)
+    with InputNode() as inp:
+        ga = a.grad.bind(inp)
+        gb = b.grad.bind(inp)
+        ra, rb = collective.allreduce.bind([ga, gb], op="sum")
+        dag = MultiOutputNode([ra, rb]).experimental_compile(channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    try:
+        va, vb = dag.execute(3).get(timeout=60)
+        np.testing.assert_allclose(va, np.full((4,), 9.0))
+        np.testing.assert_allclose(vb, np.full((4,), 9.0))
+        va, vb = dag.execute(5).get(timeout=60)
+        np.testing.assert_allclose(va, np.full((4,), 15.0))
+    finally:
+        dag.teardown()
+
+
+def test_dag_allreduce_fallback_path(local_cluster):
+    """The per-call executor supports the same collective nodes via
+    one-shot groups (used when the channel path is ineligible)."""
+    import numpy as np
+
+    from ray_tpu.dag import collective
+
+    @rt.remote
+    class W:
+        def val(self, x):
+            return np.asarray([float(x)])
+
+    a, b = W.remote(), W.remote()
+    with InputNode() as inp:
+        ra, rb = collective.allreduce.bind(
+            [a.val.bind(inp), b.val.bind(inp)], op="sum")
+        dag = MultiOutputNode([ra, rb]).experimental_compile(channels=False)
+    va, vb = dag.execute(2).get(timeout=60)
+    np.testing.assert_allclose(va, [4.0])
+    np.testing.assert_allclose(vb, [4.0])

@@ -171,17 +171,17 @@ def test_lora_merge_matches_lowrank_path(cfg, params):
     the base weights (merge_lora) reproduces the low-rank forward."""
     from ray_tpu.models import lora
 
+    cfg_l = llama.LlamaConfig(**{**cfg.__dict__, "lora_alpha": 8.0})
     lcfg = lora.LoraConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
-    lp = lora.init_lora_params(cfg, lcfg, jax.random.PRNGKey(5))
+    lp = lora.init_lora_params(cfg_l, lcfg, jax.random.PRNGKey(5))
     # make the adapters non-trivial
     lp = jax.tree.map(
         lambda x: x + 0.02 * jax.random.normal(
             jax.random.PRNGKey(6), x.shape, x.dtype), lp)
-    cfg_l = llama.LlamaConfig(**{**cfg.__dict__, "lora_alpha": lcfg.alpha})
     tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0,
                                 cfg.vocab_size)
     low_rank = llama.forward({**params, "lora": lp}, tokens, cfg_l)
-    merged = lora.merge_lora({**params, "lora": lp}, cfg_l, lcfg)
+    merged = lora.merge_lora({**params, "lora": lp}, cfg_l)
     assert "lora" not in merged
     folded = llama.forward(merged, tokens, cfg_l)
     # bf16 low-rank path vs f32-folded delta: per-layer rounding compounds

