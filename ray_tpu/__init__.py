@@ -21,7 +21,8 @@ from ray_tpu.api import (ActorClass, ActorHandle, PlacementGroup,  # noqa: F401
                          get_actor, kill, nodes, placement_group, put,
                          put_device, remote, remove_placement_group, wait)
 from ray_tpu.core.common import (ActorDiedError, GetTimeoutError,  # noqa: F401
-                                 NodeAffinitySchedulingStrategy, ObjectLostError,
+                                 NodeAffinitySchedulingStrategy,
+                                 NodeLabelSchedulingStrategy, ObjectLostError,
                                  PlacementGroupSchedulingStrategy, RayTpuError,
                                  TaskError, WorkerCrashedError)
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401

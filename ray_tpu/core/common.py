@@ -174,6 +174,16 @@ class NodeAffinitySchedulingStrategy:
     soft: bool = False
 
 
+@dataclasses.dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes by label (ref analog:
+    node_label_scheduling_strategy in scheduling/policy/). `hard` labels
+    must ALL match for a node to be feasible; `soft` labels rank matching
+    nodes first but don't exclude others."""
+    hard: dict = dataclasses.field(default_factory=dict)
+    soft: dict = dataclasses.field(default_factory=dict)
+
+
 def now() -> float:
     return time.time()
 

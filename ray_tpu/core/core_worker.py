@@ -986,8 +986,25 @@ class CoreWorker:
         return refs
 
     # --- lease management (ref: normal_task_submitter lease reuse) ---
-    def _lease_key(self, demand: dict[str, float]) -> tuple:
-        return tuple(sorted(demand.items()))
+    def _lease_key(self, demand: dict[str, float], strategy=None) -> tuple:
+        # the scheduling class includes the strategy (ref: SchedulingClass
+        # keyed by resource shape + strategy) so an affinity/SPREAD lease
+        # is never handed to a task with different placement constraints
+        from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
+                                         NodeLabelSchedulingStrategy)
+
+        if strategy is None:
+            skey = None
+        elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+            skey = ("affinity", strategy.node_id.hex(), strategy.soft)
+        elif isinstance(strategy, NodeLabelSchedulingStrategy):
+            # canonical: equal strategies share a pool regardless of dict
+            # insertion order
+            skey = ("label", tuple(sorted(strategy.hard.items())),
+                    tuple(sorted(strategy.soft.items())))
+        else:
+            skey = repr(strategy)
+        return (tuple(sorted(demand.items())), skey)
 
     def _lease_pool_for(self, key: tuple) -> "_LeasePool":
         pool = self._lease_cache.get(key)
@@ -996,13 +1013,13 @@ class CoreWorker:
             self._lease_cache[key] = pool
         return pool
 
-    async def _acquire_lease(self, demand: dict[str, float]):
+    async def _acquire_lease(self, demand: dict[str, float], strategy=None):
         """Get a leased worker for `demand`: reuse an idle cached lease if
         one exists, otherwise queue as a waiter and make sure enough lease
         fetches are in flight (ref: normal_task_submitter.cc:291 — one
         scheduling-key pipeline, workers handed task-to-task without a
         raylet round-trip)."""
-        key = self._lease_key(demand)
+        key = self._lease_key(demand, strategy)
         pool = self._lease_pool_for(key)
         if pool.idle:
             entry = pool.idle.pop()
@@ -1011,16 +1028,17 @@ class CoreWorker:
         pool.waiters.append(fut)
         if pool.inflight < len(pool.waiters):
             pool.inflight += 1
-            asyncio.ensure_future(self._fetch_lease(key, demand, pool))
+            asyncio.ensure_future(
+                self._fetch_lease(key, demand, pool, strategy))
         entry = await fut
         return entry[0], entry[1], entry[2]
 
     async def _fetch_lease(self, key: tuple, demand: dict[str, float],
-                           pool: "_LeasePool"):
+                           pool: "_LeasePool", strategy=None):
         """One in-flight lease request against the cluster; the grant goes
         to whichever waiter is first in line."""
         try:
-            entry = await self._request_cluster_lease(demand)
+            entry = await self._request_cluster_lease(demand, strategy)
         except Exception as e:
             pool.inflight -= 1
             # fetches and waiters are ~1:1 (one spawned per new waiter),
@@ -1068,7 +1086,8 @@ class CoreWorker:
                     return
         asyncio.ensure_future(_expire())
 
-    async def _request_cluster_lease(self, demand: dict[str, float]):
+    async def _request_cluster_lease(self, demand: dict[str, float],
+                                     strategy=None):
         nm_addr = Address(self.node_address.host, self.node_address.port)
         allow_spill = True
         infeasible_deadline: float | None = None
@@ -1079,7 +1098,8 @@ class CoreWorker:
                 conn = (self.node_conn
                         if nm_addr.key() == self.node_address.key()
                         else await self._conn_to(nm_addr))
-                res = await conn.call("request_lease", (demand, allow_spill),
+                res = await conn.call("request_lease",
+                                      (demand, allow_spill, strategy),
                                       timeout=_TASK_PUSH_TIMEOUT)
             except (ConnectionLost, RpcError, OSError):
                 if nm_addr.key() == self.node_address.key():
@@ -1129,19 +1149,27 @@ class CoreWorker:
         except Exception:
             pass
 
-    def _recycle_lease(self, demand: dict[str, float], winfo, token, nm_addr):
+    def _recycle_lease(self, demand: dict[str, float], winfo, token, nm_addr,
+                       strategy=None):
         """A task finished on this leased worker: hand the lease straight
         to the next queued task of the same shape, or keep it warm for
         lease_reuse_idle_s. Runs on the IO loop."""
-        key = self._lease_key(demand)
+        key = self._lease_key(demand, strategy)
         self._offer_lease(key, self._lease_pool_for(key),
                           (winfo, token, nm_addr), recycled=True)
 
     async def _run_normal_task(self, spec: TaskSpec):
+        from ray_tpu.core.common import PlacementGroupSchedulingStrategy
+
         pt = self.pending_tasks[spec.task_id]
+        # PG strategies were already rewritten into bundle-reserved demand
+        strat = spec.scheduling_strategy
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            strat = None
         while True:
             try:
-                winfo, token, nm_addr = await self._acquire_lease(spec.resources)
+                winfo, token, nm_addr = await self._acquire_lease(
+                    spec.resources, strat)
             except Exception as e:
                 self._fail_task(spec, TaskError(e, spec.name, ""))
                 return
@@ -1160,7 +1188,16 @@ class CoreWorker:
                 self._fail_task(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
                 return
-            self._recycle_lease(spec.resources, winfo, token, nm_addr)
+            if strat == "SPREAD":
+                # no sticky reuse for SPREAD: recycling would funnel the
+                # whole wave onto the first-granted node; releasing makes
+                # every task take the round-robin path at the node manager
+                # (fire-and-forget: no reply-latency cost per task)
+                asyncio.ensure_future(self._release_lease(
+                    winfo, token, nm_addr, reusable=False))
+            else:
+                self._recycle_lease(spec.resources, winfo, token, nm_addr,
+                                    strat)
             if reply[0] == "task_error":
                 _, err_blob, tb = reply
                 if spec.retry_exceptions and pt.retries_left > 0:

@@ -477,6 +477,7 @@ class GcsServer:
                 "available": self.node_resources_available.get(nid, {}),
                 "alive": self.nodes[nid].alive,
                 "address": self.nodes[nid].address,
+                "labels": self.nodes[nid].labels,
             }
             for nid in self.nodes
         }
@@ -534,26 +535,24 @@ class GcsServer:
 
     def _pick_node_for(self, demand: dict[str, float],
                        strategy=None) -> NodeID | None:
-        """Actor/PG placement against the synced resource view (ref:
-        gcs_actor_scheduler.h:111, simplified to best-fit over the view)."""
-        from ray_tpu.core.common import NodeAffinitySchedulingStrategy
-        if isinstance(strategy, NodeAffinitySchedulingStrategy):
-            info = self.nodes.get(strategy.node_id)
-            if info is not None and info.alive:
-                return strategy.node_id
-            if not strategy.soft:
-                return None
-        best, best_score = None, -1.0
+        """Actor/PG placement via the shared policy module (ref:
+        gcs_actor_scheduler.h:111 + scheduling/policy/ — hybrid top-k
+        scoring, SPREAD round-robin, node-affinity, label affinity)."""
+        from ray_tpu.core.scheduling_policy import pick_node
+
+        views, by_hex = {}, {}
         for nid, info in self.nodes.items():
-            if not info.alive or info.labels.get("draining"):
-                continue
-            avail = self.node_resources_available.get(nid, {})
-            if all(avail.get(r, 0.0) >= amt for r, amt in demand.items()):
-                # prefer nodes with more slack (spread-ish)
-                score = sum(avail.get(r, 0.0) - amt for r, amt in demand.items())
-                if score > best_score:
-                    best, best_score = nid, score
-        return best
+            h = nid.hex()
+            by_hex[h] = nid
+            views[h] = {
+                "total": info.resources_total,
+                "available": self.node_resources_available.get(nid, {}),
+                "alive": info.alive, "labels": info.labels,
+            }
+        self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+        nid_hex = pick_node(views, demand, strategy,
+                            spread_counter=self._spread_counter)
+        return by_hex.get(nid_hex)
 
     async def _schedule_actor(self, actor_id: ActorID):
         info = self.actors[actor_id]

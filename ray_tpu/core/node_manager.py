@@ -505,25 +505,27 @@ class NodeManager:
         return all(self.resources_total.get(r, 0.0) >= amt - 1e-9
                    for r, amt in demand.items())
 
-    def _pick_spillback(self, demand: dict[str, float]) -> Address | None:
-        """Hybrid policy: if another node has the resources available now,
-        send the caller there (ref: hybrid_scheduling_policy.h:85)."""
-        for nid_hex, view in self._cluster_view.items():
-            if nid_hex == self.node_id.hex() or not view.get("alive"):
-                continue
-            avail = view.get("available", {})
-            if all(avail.get(r, 0.0) >= amt for r, amt in demand.items()):
-                # address lookup via GCS node table is cached in the view
-                addr = view.get("address")
-                if addr is not None:
-                    return addr
-        return None
+    def _pick_spillback(self, demand: dict[str, float],
+                        strategy=None) -> Address | None:
+        """Spillback target via the shared hybrid top-k policy (ref:
+        hybrid_scheduling_policy.h:85): score by post-placement
+        critical-resource utilization, random choice among the best k."""
+        from ray_tpu.core.scheduling_policy import pick_node
 
-    async def _pick_spillback_fresh(self, demand) -> Address | None:
+        self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+        nid_hex = pick_node(self._cluster_view, demand, strategy,
+                            exclude={self.node_id.hex()},
+                            spread_counter=self._spread_counter)
+        if nid_hex is None or nid_hex == self.node_id.hex():
+            return None
+        return self._cluster_view[nid_hex].get("address")
+
+    async def _pick_spillback_fresh(self, demand,
+                                    strategy=None) -> Address | None:
         """Spillback against the heartbeat view; on a miss, refresh the view
         once from the GCS — a just-registered node may not have reached the
         periodic sync yet."""
-        target = self._pick_spillback(demand)
+        target = self._pick_spillback(demand, strategy)
         if target is not None:
             return target
         try:
@@ -531,7 +533,7 @@ class NodeManager:
                 "get_cluster_resources")
         except Exception:
             return None
-        return self._pick_spillback(demand)
+        return self._pick_spillback(demand, strategy)
 
     # --------------------------------------------------------------- leases
     async def rpc_request_lease(self, conn, arg):
@@ -540,18 +542,79 @@ class NodeManager:
         Returns ("granted", WorkerInfo, lease_token) |
                 ("spillback", Address) | ("infeasible", reason)
         """
-        demand, allow_spill = arg
+        demand, allow_spill, strategy = (arg if len(arg) == 3
+                                         else (*arg, None))
+        from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
+                                         NodeLabelSchedulingStrategy)
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            # affinity to ANOTHER node: redirect the caller there
+            if strategy.node_id != self.node_id:
+                view = self._cluster_view.get(strategy.node_id.hex())
+                if view is None or not view.get("alive"):
+                    # a just-registered node may not be in the heartbeat
+                    # view yet: refresh once before declaring it gone
+                    try:
+                        self._cluster_view = await self.gcs_conn.call(
+                            "get_cluster_resources")
+                    except Exception:
+                        pass
+                    view = self._cluster_view.get(strategy.node_id.hex())
+                if view is not None and view.get("alive"):
+                    return ("spillback", view.get("address"))
+                if not strategy.soft:
+                    return ("infeasible",
+                            f"affinity node {strategy.node_id} not alive")
+            strategy = None  # landed on (or soft-fell-back to) this node
+        elif isinstance(strategy, NodeLabelSchedulingStrategy) and \
+                strategy.hard and not all(
+                    self.labels.get(k) == v
+                    for k, v in strategy.hard.items()):
+            # this node fails the hard label constraint: redirect to a
+            # matching node — one with room now, else one that could EVER
+            # fit it (the target queues the lease until resources free)
+            target = await self._pick_spillback_fresh(demand, strategy)
+            if target is None:
+                from ray_tpu.core.scheduling_policy import pick_node
+
+                nid_hex = pick_node(self._cluster_view, demand, strategy,
+                                    exclude={self.node_id.hex()},
+                                    by_capacity=True)
+                if nid_hex is not None:
+                    target = self._cluster_view[nid_hex].get("address")
+            if target is not None:
+                return ("spillback", target)
+            return ("infeasible",
+                    f"no alive node matches hard labels {strategy.hard}")
+        elif strategy == "SPREAD" and allow_spill:
+            # round-robin over ALL feasible nodes incl. this one; only
+            # execute locally when it's this node's turn
+            from ray_tpu.core.scheduling_policy import spread_pick
+
+            self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+            nid_hex = spread_pick(self._cluster_view, demand,
+                                  self._spread_counter)
+            if nid_hex is None:
+                # everyone is saturated: round-robin by CAPACITY so the
+                # overflow wave queues evenly instead of herding onto
+                # this node's pending-lease queue
+                nid_hex = spread_pick(self._cluster_view, demand,
+                                      self._spread_counter,
+                                      by_capacity=True)
+            if nid_hex is not None and nid_hex != self.node_id.hex():
+                return ("spillback",
+                        self._cluster_view[nid_hex].get("address"))
         # PG-bundle demands translate to reserved-resource keys upstream.
         if not self._can_ever_satisfy(demand):
             if allow_spill:
-                target = await self._pick_spillback_fresh(demand)
+                target = await self._pick_spillback_fresh(demand, strategy)
                 if target is not None:
                     return ("spillback", target)
             return ("infeasible",
                     f"node cannot ever satisfy {demand} (total={self.resources_total})")
         if not self._try_acquire(demand):
             if allow_spill:
-                target = await self._pick_spillback_fresh(demand)
+                target = await self._pick_spillback_fresh(demand, strategy)
                 if target is not None:
                     return ("spillback", target)
             fut = asyncio.get_running_loop().create_future()

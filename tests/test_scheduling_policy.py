@@ -1,0 +1,181 @@
+"""Scheduler policy tests mirroring the reference matrix (ref:
+src/ray/raylet/scheduling/policy/scheduling_policy_test.cc — hybrid top-k
+scoring, SPREAD round-robin, node-affinity hard/soft, label affinity),
+plus end-to-end strategy placement on the in-process multi-node cluster.
+"""
+
+import os
+import random
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
+                                 NodeLabelSchedulingStrategy)
+from ray_tpu.core.scheduling_policy import (critical_utilization, feasible,
+                                            hybrid_pick, pick_node,
+                                            spread_pick)
+
+
+def _view(total, avail, alive=True, labels=None):
+    return {"total": total, "available": avail, "alive": alive,
+            "labels": labels or {}, "address": None}
+
+
+# ------------------------------------------------------------- pure units
+def test_feasibility_and_draining():
+    v = _view({"CPU": 4}, {"CPU": 1})
+    assert feasible(v, {"CPU": 1})
+    assert not feasible(v, {"CPU": 2})
+    assert not feasible(_view({"CPU": 4}, {"CPU": 4}, alive=False),
+                        {"CPU": 1})
+    assert not feasible(_view({"CPU": 4}, {"CPU": 4},
+                              labels={"draining": "1"}), {"CPU": 1})
+
+
+def test_critical_utilization_is_max_over_resources():
+    v = _view({"CPU": 4, "TPU": 4}, {"CPU": 4, "TPU": 1})
+    # TPU is the critical resource: (3 used + 1 demand) / 4 = 1.0
+    assert critical_utilization(v, {"TPU": 1}) == pytest.approx(1.0)
+    assert critical_utilization(v, {"CPU": 1}) == pytest.approx(0.75)
+
+
+def test_hybrid_prefers_under_threshold_then_packs():
+    # idle node (u=0.25 after placing) must beat the nearly-full one
+    views = {
+        "busy": _view({"CPU": 4}, {"CPU": 1}),   # u after = 1.0
+        "idle": _view({"CPU": 4}, {"CPU": 4}),   # u after = 0.25
+    }
+    picks = {hybrid_pick(views, {"CPU": 1}, top_k=1) for _ in range(10)}
+    assert picks == {"idle"}
+
+
+def test_hybrid_top_k_randomizes_among_best():
+    views = {f"n{i}": _view({"CPU": 8}, {"CPU": 8}) for i in range(6)}
+    rng = random.Random(0)
+    picks = {hybrid_pick(views, {"CPU": 1}, top_k=3, rng=rng)
+             for _ in range(50)}
+    assert len(picks) == 3  # spread over exactly the top k
+
+
+def test_hybrid_infeasible_returns_none():
+    views = {"a": _view({"CPU": 1}, {"CPU": 0})}
+    assert hybrid_pick(views, {"CPU": 1}) is None
+
+
+def test_spread_round_robins_over_feasible():
+    views = {
+        "a": _view({"CPU": 4}, {"CPU": 4}),
+        "b": _view({"CPU": 4}, {"CPU": 4}),
+        "c": _view({"CPU": 4}, {"CPU": 0}),   # infeasible: skipped
+    }
+    seq = [spread_pick(views, {"CPU": 1}, i) for i in range(4)]
+    assert seq == ["a", "b", "a", "b"]
+
+
+def test_node_affinity_hard_and_soft():
+    views = {
+        "a": _view({"CPU": 4}, {"CPU": 4}),
+        "b": _view({"CPU": 4}, {"CPU": 4}),
+    }
+
+    class _Id:
+        def __init__(self, h):
+            self._h = h
+
+        def hex(self):
+            return self._h
+
+    hard = NodeAffinitySchedulingStrategy(_Id("b"), soft=False)
+    assert pick_node(views, {"CPU": 1}, hard) == "b"
+    dead = NodeAffinitySchedulingStrategy(_Id("gone"), soft=False)
+    assert pick_node(views, {"CPU": 1}, dead) is None
+    soft = NodeAffinitySchedulingStrategy(_Id("gone"), soft=True)
+    assert pick_node(views, {"CPU": 1}, soft) in ("a", "b")
+
+
+def test_label_hard_filters_and_soft_prefers():
+    views = {
+        "cpu1": _view({"CPU": 4}, {"CPU": 4}, labels={"kind": "cpu"}),
+        "tpu1": _view({"CPU": 4}, {"CPU": 4}, labels={"kind": "tpu"}),
+        "tpu2": _view({"CPU": 4}, {"CPU": 1}, labels={"kind": "tpu"}),
+    }
+    hard = NodeLabelSchedulingStrategy(hard={"kind": "tpu"})
+    picks = {pick_node(views, {"CPU": 1}, hard, rng=random.Random(i))
+             for i in range(20)}
+    assert picks <= {"tpu1", "tpu2"}
+    none = NodeLabelSchedulingStrategy(hard={"kind": "gpu"})
+    assert pick_node(views, {"CPU": 1}, none) is None
+    soft = NodeLabelSchedulingStrategy(soft={"kind": "tpu"})
+    # soft labels prefer tpu nodes while cpu1 stays feasible as overflow
+    assert pick_node(views, {"CPU": 1}, soft,
+                     rng=random.Random(0)) in ("tpu1", "tpu2")
+    # soft label with no matching node falls back to the rest
+    only = NodeLabelSchedulingStrategy(soft={"kind": "gpu"})
+    assert pick_node(views, {"CPU": 1}, only,
+                     rng=random.Random(0)) in views
+
+
+# ------------------------------------------------------------ end-to-end
+@pytest.fixture
+def labeled_cluster():
+    cluster = Cluster(head_resources={"CPU": 2.0})
+    node_b = cluster.add_node(num_cpus=2, labels={"tier": "fast"})
+    cluster.connect()
+    try:
+        yield cluster, node_b
+    finally:
+        cluster.shutdown()
+
+
+def test_spread_tasks_use_both_nodes(labeled_cluster):
+    _, node_b = labeled_cluster
+
+    @rt.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def where():
+        import time
+
+        time.sleep(0.2)  # hold the slot so placements don't collapse
+        return os.environ["RAYT_NODE_ID"]
+
+    placed = rt.get([where.remote() for _ in range(8)], timeout=120)
+    counts = {n: placed.count(n) for n in set(placed)}
+    assert len(counts) == 2, f"SPREAD used only {counts}"
+    assert min(counts.values()) >= 2, f"SPREAD badly skewed: {counts}"
+
+
+def test_label_strategy_places_on_matching_node(labeled_cluster):
+    _, node_b = labeled_cluster
+
+    @rt.remote(num_cpus=1, scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"tier": "fast"}))
+    def where():
+        return os.environ["RAYT_NODE_ID"]
+
+    got = {rt.get(where.remote(), timeout=90) for _ in range(4)}
+    assert got == {node_b.node_id_hex}
+
+
+def test_label_strategy_infeasible_when_no_match(labeled_cluster):
+    @rt.remote(num_cpus=1, max_retries=0,
+               scheduling_strategy=NodeLabelSchedulingStrategy(
+                   hard={"tier": "does-not-exist"}))
+    def where():
+        return 1
+
+    with pytest.raises(Exception):
+        rt.get(where.remote(), timeout=90)
+
+
+def test_actor_label_strategy(labeled_cluster):
+    _, node_b = labeled_cluster
+
+    @rt.remote
+    class Where:
+        def node(self):
+            return os.environ["RAYT_NODE_ID"]
+
+    a = Where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"tier": "fast"})).remote()
+    assert rt.get(a.node.remote(), timeout=90) == node_b.node_id_hex
