@@ -79,6 +79,7 @@ class GcsServer:
         from collections import OrderedDict, deque
         self._dedup_results: OrderedDict[str, OrderedDict] = OrderedDict()
         self._dedup_total = 0
+        self._spread_counter = 0
         self._dedup_inflight: dict[tuple, asyncio.Future] = {}
         # task-event ring for `rayt timeline` (ref: gcs_task_manager.h)
         self._task_events: deque = deque(maxlen=50_000)
@@ -549,7 +550,7 @@ class GcsServer:
                 "available": self.node_resources_available.get(nid, {}),
                 "alive": info.alive, "labels": info.labels,
             }
-        self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+        self._spread_counter += 1
         nid_hex = pick_node(views, demand, strategy,
                             spread_counter=self._spread_counter)
         return by_hex.get(nid_hex)

@@ -249,6 +249,7 @@ class NodeManager:
         self._pg_reserved: dict[tuple, dict[str, float]] = {}
         self._pg_prepared: dict[tuple, dict[str, float]] = {}
         self._cluster_view: dict = {}
+        self._spread_counter = 0
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
         self._pull_manager = _PullManager(self)
@@ -359,6 +360,9 @@ class NodeManager:
         if w.lease_resources:
             self._release_resources(w.lease_resources)
             w.lease_resources = None
+            # queued lease requests may now fit (e.g. tasks submitted
+            # right after a fleet of pool actors was killed)
+            self._maybe_grant_pending()
         if w.actor_id is not None:
             try:
                 await self.gcs_conn.call(
@@ -512,7 +516,7 @@ class NodeManager:
         critical-resource utilization, random choice among the best k."""
         from ray_tpu.core.scheduling_policy import pick_node
 
-        self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+        self._spread_counter += 1
         nid_hex = pick_node(self._cluster_view, demand, strategy,
                             exclude={self.node_id.hex()},
                             spread_counter=self._spread_counter)
@@ -591,7 +595,7 @@ class NodeManager:
             # execute locally when it's this node's turn
             from ray_tpu.core.scheduling_policy import spread_pick
 
-            self._spread_counter = getattr(self, "_spread_counter", 0) + 1
+            self._spread_counter += 1
             nid_hex = spread_pick(self._cluster_view, demand,
                                   self._spread_counter)
             if nid_hex is None:
@@ -681,6 +685,7 @@ class NodeManager:
                 timeout_s=budget - time.monotonic())
         except Exception as e:
             self._release_resources(demand)
+            self._maybe_grant_pending()
             return (None, f"worker startup failed: {e}")
         w.busy = True
         w.actor_id = spec.actor_id
@@ -717,6 +722,7 @@ class NodeManager:
             w.actor_id = None
             self._release_resources(demand)
             w.lease_resources = None
+            self._maybe_grant_pending()
             return (w.info, err)
         return (w.info, None)
 
@@ -786,6 +792,7 @@ class NodeManager:
         demand = self._pg_prepared.pop((pg_id, bundle_index), None)
         if demand is not None:
             self._release_resources(demand)
+            self._maybe_grant_pending()
             return True
         demand = self._pg_reserved.pop((pg_id, bundle_index), None)
         if demand is None:

@@ -113,25 +113,39 @@ class Dataset:
     def _iter_block_refs(self) -> Iterator:
         from ray_tpu.data.plan import optimize
 
+        stages = optimize(list(self._stages))
         refs: Iterator = iter(self._source_refs)
-        for stage in optimize(list(self._stages)):
+        i = 0
+        while i < len(stages):
+            stage = stages[i]
             if isinstance(stage, MapSpec):
-                refs = self._executor.stream_map(refs, stage)
+                # consecutive map-family stages run as ONE pipelined
+                # operator topology with per-op queues + backpressure
+                # (data/streaming_executor.py)
+                segment = [stage]
+                while i + 1 < len(stages) and isinstance(stages[i + 1],
+                                                         MapSpec):
+                    i += 1
+                    segment.append(stages[i])
+                refs = self._executor.stream_pipeline(refs, segment)
             elif isinstance(stage, _AllToAll):
-                materialized = list(refs)
-                if stage.kind == "repartition":
-                    refs = iter(self._executor.repartition(
-                        materialized, stage.args["n"]))
-                elif stage.kind == "shuffle":
-                    refs = iter(self._executor.random_shuffle(
-                        materialized, stage.args["seed"]))
-                else:
-                    refs = iter(self._executor.sort(
-                        materialized, stage.args["key"],
-                        stage.args["descending"]))
+                refs = self._run_all_to_all(refs, stage)
             elif isinstance(stage, _Limit):
                 refs = self._limit_refs(refs, stage.n)
+            i += 1
         return refs
+
+    def _run_all_to_all(self, refs: Iterator, stage) -> Iterator:
+        """All-to-all stages are barriers: materialize, exchange."""
+        materialized = list(refs)
+        if stage.kind == "repartition":
+            return iter(self._executor.repartition(
+                materialized, stage.args["n"]))
+        if stage.kind == "shuffle":
+            return iter(self._executor.random_shuffle(
+                materialized, stage.args["seed"]))
+        return iter(self._executor.sort(
+            materialized, stage.args["key"], stage.args["descending"]))
 
     def _limit_refs(self, refs: Iterator, n: int) -> Iterator:
         remaining = n

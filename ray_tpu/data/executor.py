@@ -24,7 +24,23 @@ from ray_tpu.data.block import (Block, block_rows, concat_blocks,
 
 @dataclasses.dataclass
 class ActorPoolStrategy:
+    """Actor-pool compute for map_batches. `size` is the fixed size when
+    min/max are not given; with min_size/max_size the topology executor
+    autoscales the pool with input-queue depth (ref:
+    data/_internal/execution/autoscaler/)."""
     size: int = 2
+    min_size: int | None = None
+    max_size: int | None = None
+
+    def __post_init__(self):
+        if self.min_size is None:
+            self.min_size = self.size
+        if self.max_size is None:
+            self.max_size = max(self.size, self.min_size)
+        if self.min_size > self.max_size:
+            raise ValueError(
+                f"ActorPoolStrategy min_size={self.min_size} > "
+                f"max_size={self.max_size}")
 
 
 @dataclasses.dataclass
@@ -101,58 +117,30 @@ def _ship_spec_code(spec: MapSpec) -> None:
 
 
 class StreamingExecutor:
-    def __init__(self, max_in_flight: int = 8):
+    def __init__(self, max_in_flight: int = 8, execution_options=None):
         self.max_in_flight = max_in_flight
+        self.execution_options = execution_options
+        self.last_topology = None   # stats hook for tests/observability
+
+    # --------------------------------------------------------- map pipeline
+    def stream_pipeline(self, refs: Iterator, specs: list) -> Iterator:
+        """Run consecutive map-family stages as one operator topology with
+        per-op queues, backpressure budgets, and actor-pool autoscaling
+        (data/streaming_executor.py)."""
+        from ray_tpu.data.streaming_executor import (ExecutionOptions,
+                                                     StreamingTopology)
+
+        opts = self.execution_options or ExecutionOptions(
+            max_in_flight=self.max_in_flight)
+        topo = StreamingTopology(list(specs), iter(refs), opts)
+        self.last_topology = topo
+        return topo.run()
 
     # ------------------------------------------------------------- map stage
     def stream_map(self, refs: Iterator, spec: MapSpec) -> Iterator:
-        """Yield output block refs as inputs complete; bounded window."""
-        if spec.compute is not None:
-            yield from self._stream_map_actors(refs, spec)
-            return
-        _ship_spec_code(spec)
-        remote_fn = rt.remote(num_cpus=1)(_map_task)
-        window = collections.deque()
-        for ref in refs:
-            window.append(remote_fn.remote(ref, spec))
-            if len(window) >= self.max_in_flight:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
-
-    def _stream_map_actors(self, refs: Iterator, spec: MapSpec) -> Iterator:
-        _ship_spec_code(spec)
-        n = spec.compute.size
-        actor_cls = rt.remote(num_cpus=1)(_MapActor)
-        actors = [actor_cls.remote(spec) for _ in range(n)]
-        futures: collections.deque = collections.deque()
-        dispatched: list = []
-        try:
-            # round-robin: per-actor ordered queues serialize execution, the
-            # window bounds blocks in flight
-            for i, ref in enumerate(refs):
-                fut = actors[i % n].apply.remote(ref)
-                futures.append(fut)
-                dispatched.append(fut)
-                if len(futures) >= self.max_in_flight:
-                    yield futures.popleft()
-            while futures:
-                yield futures.popleft()
-        finally:
-            # Consumers may drain the yielded refs without resolving them
-            # (materialize / all-to-all stages do list(refs) first); killing
-            # the pool while tasks are still queued would fail later gets
-            # with ActorDiedError. Wait for every dispatched block first.
-            try:
-                rt.wait(dispatched, num_returns=len(dispatched),
-                        timeout=60.0)
-            except Exception:
-                pass
-            for a in actors:
-                try:
-                    rt.kill(a)
-                except Exception:
-                    pass
+        """Single-stage convenience wrapper over the topology executor
+        (kept as API; Dataset batches consecutive stages itself)."""
+        return self.stream_pipeline(refs, [spec])
 
     # --------------------------------------------------------- all-to-all
     def repartition(self, refs: list, n: int) -> list:
