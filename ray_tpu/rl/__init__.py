@@ -2,11 +2,15 @@
 analog: rllib new API stack; SURVEY.md §2.3/§3.6)."""
 
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager  # noqa: F401
-from ray_tpu.rl.env import (CartPoleVectorEnv, VectorEnv,  # noqa: F401
-                            make_vector_env, register_env)
+from ray_tpu.rl.connectors import (CastF32, Connector,  # noqa: F401
+                                   ConnectorPipeline, FlattenObs,
+                                   NormalizeImage)
+from ray_tpu.rl.env import (CartPoleVectorEnv, CatchVectorEnv,  # noqa: F401
+                            VectorEnv, make_vector_env, register_env)
 from ray_tpu.rl.learner import (JaxLearner, PPOLearnerConfig,  # noqa: F401
                                 compute_gae)
-from ray_tpu.rl.module import MLPModuleConfig  # noqa: F401
+from ray_tpu.rl.module import (CNNModuleConfig,  # noqa: F401
+                               MLPModuleConfig, make_module_config)
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.impala import (IMPALA, AggregatorActor,  # noqa: F401
                                IMPALAConfig, IMPALALearner)

@@ -92,7 +92,72 @@ class CartPoleVectorEnv(VectorEnv):
                 terminated, truncated, final_obs)
 
 
-_ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv}
+class CatchVectorEnv(VectorEnv):
+    """Pixel-observation catch game (the classic DeepMind toy pixel env;
+    stands in for ALE where gym/ALE isn't installable — same image-CNN
+    training path as config #4's Atari shape).
+
+    A fruit falls from a random top column of a GRID x GRID board; the
+    agent moves a paddle on the bottom row (left/stay/right). Episode ends
+    when the fruit reaches the bottom: reward +1 if caught, -1 if missed.
+    Observations are [GRID, GRID, 1] float32 images (0/1 pixels).
+
+    Committed learning curve (tools/rl_image_bench.py): random policy
+    averages ~0.0 (catch probability ~1/GRID gives ~-0.8); a trained CNN
+    exceeds +0.8 mean return within a few thousand episodes.
+    """
+
+    GRID = 10
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        g = self.GRID
+        self.num_envs = num_envs
+        self.observation_shape = (g, g, 1)
+        self.observation_size = g * g  # flat fallback for MLP paths
+        self.num_actions = 3           # left, stay, right
+        self._rng = np.random.RandomState(seed)
+        self._fruit_row = np.zeros(num_envs, np.int64)
+        self._fruit_col = np.zeros(num_envs, np.int64)
+        self._paddle = np.zeros(num_envs, np.int64)
+
+    def _spawn(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._fruit_row[mask] = 0
+            self._fruit_col[mask] = self._rng.randint(0, self.GRID, n)
+            self._paddle[mask] = self._rng.randint(0, self.GRID, n)
+
+    def _render(self) -> np.ndarray:
+        g = self.GRID
+        obs = np.zeros((self.num_envs, g, g, 1), np.float32)
+        idx = np.arange(self.num_envs)
+        obs[idx, self._fruit_row, self._fruit_col, 0] = 1.0
+        obs[idx, g - 1, self._paddle, 0] = 1.0
+        return obs
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._spawn(np.ones(self.num_envs, bool))
+        return self._render()
+
+    def step(self, actions: np.ndarray):
+        g = self.GRID
+        self._paddle = np.clip(self._paddle + (actions - 1), 0, g - 1)
+        self._fruit_row += 1
+        landed = self._fruit_row >= g - 1
+        caught = landed & (self._fruit_col == self._paddle)
+        reward = np.where(landed,
+                          np.where(caught, 1.0, -1.0), 0.0).astype(np.float32)
+        terminated = landed
+        truncated = np.zeros(self.num_envs, bool)
+        final_obs = self._render()
+        self._spawn(landed)
+        return self._render(), reward, terminated, truncated, final_obs
+
+
+_ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv,
+                 "Catch-v0": CatchVectorEnv}
 
 
 def register_env(name: str, creator):

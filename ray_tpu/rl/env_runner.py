@@ -12,7 +12,8 @@ import numpy as np
 
 class EnvRunner:
     def __init__(self, env_name: str, num_envs: int, seed: int,
-                 module_cfg_blob: bytes):
+                 module_cfg_blob: bytes,
+                 connector_blob: bytes | None = None):
         from ray_tpu._internal.spawn import wait_site_ready
 
         wait_site_ready()
@@ -20,12 +21,18 @@ class EnvRunner:
         import jax
 
         jax.config.update("jax_platforms", "cpu")  # sampling is host-side
+        from ray_tpu.rl.connectors import default_env_to_module
         from ray_tpu.rl.env import make_vector_env
 
         self.env = make_vector_env(env_name, num_envs, seed)
         self.module_cfg = cloudpickle.loads(module_cfg_blob)
+        # env->module connector pipeline (ref: connector_v2.py:31): the
+        # same transforms run here at sampling time and in the learner
+        self._to_module = (cloudpickle.loads(connector_blob)
+                           if connector_blob is not None
+                           else default_env_to_module(self.module_cfg))
         self._key = jax.random.PRNGKey(seed)
-        self._obs = self.env.reset(seed)
+        self._obs = self._to_module(self.env.reset(seed))
         self._params = None
         # per-env running episode returns (for metrics)
         self._ep_return = np.zeros(num_envs, np.float32)
@@ -44,7 +51,10 @@ class EnvRunner:
 
         assert self._params is not None, "set_weights first"
         T, N = num_steps, self.env.num_envs
-        obs_buf = np.zeros((T, N, self.env.observation_size), np.float32)
+        # buffer shape follows the CONNECTOR OUTPUT (self._obs already
+        # went through the env->module pipeline, which may reshape)
+        obs_buf = np.zeros((T, N) + tuple(np.shape(self._obs)[1:]),
+                           np.float32)
         act_buf = np.zeros((T, N), np.int32)
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
@@ -60,8 +70,10 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = logp
             val_buf[t] = value
-            (self._obs, reward, terminated, truncated,
+            (raw_obs, reward, terminated, truncated,
              final_obs) = self.env.step(action)
+            self._obs = self._to_module(raw_obs)
+            final_obs = self._to_module(final_obs)
             rew_buf[t] = reward
             truncated = truncated & ~terminated
             done = terminated | truncated

@@ -37,6 +37,13 @@ class IMPALAConfig:
     rollout_fragment_length: int = 64
     num_aggregators: int = 1
     hidden: tuple = (64, 64)
+    # connector pipelines (None = defaults chosen from the module type;
+    # ref: connector_v2.py:31 / connector_pipeline_v2.py:19)
+    env_to_module: object = None
+    learner_pipeline: object = None
+    # >1: shard each learner batch over a data-axis mesh of this many
+    # local devices (GSPMD DP; grads reduce over ICI automatically)
+    learner_devices: int = 0
     lr: float = 5e-4
     gamma: float = 0.99
     vf_coeff: float = 0.5
@@ -148,7 +155,9 @@ class IMPALALearner:
 
         def loss_fn(params, batch):
             T, B = batch["rewards"].shape
-            obs_flat = batch["obs"].reshape(T * B, -1)
+            # keep image dims: [T, B, H, W, C] -> [T*B, H, W, C]
+            obs_flat = batch["obs"].reshape(
+                (T * B,) + batch["obs"].shape[2:])
             logits, values = rlm.forward(params, obs_flat)
             logits = logits.reshape(T, B, -1)
             values = values.reshape(T, B)
@@ -179,11 +188,45 @@ class IMPALALearner:
 
         self._update = jax.jit(update)
 
+        from ray_tpu.rl.connectors import default_learner_pipeline
+
+        self._pipeline = (self.cfg.learner_pipeline
+                          or default_learner_pipeline(self.module_cfg))
+        self._mesh = None
+        if self.cfg.learner_devices > 1:
+            from jax.sharding import Mesh
+
+            devs = jax.devices()[:self.cfg.learner_devices]
+            if len(devs) == self.cfg.learner_devices:
+                self._mesh = Mesh(np.array(devs), ("data",))
+
+    def _place_batch(self, jb: dict) -> dict:
+        """DP-shard the batch over the learner mesh when one exists: the
+        env axis (B) splits across devices; params stay replicated and
+        GSPMD reduces grads over ICI."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh is None:
+            return jb
+        n = self._mesh.shape["data"]
+        out = {}
+        for k, v in jb.items():
+            axis = 0 if k == "last_obs" else 1  # [B,...] vs [T, B, ...]
+            if v.ndim > axis and v.shape[axis] % n == 0:
+                spec = P(*([None] * axis + ["data"]))
+            else:
+                spec = P()
+            out[k] = jax.device_put(v, NamedSharding(self._mesh, spec))
+        return out
+
     def update(self, batch: dict) -> dict:
         import jax.numpy as jnp
 
+        batch = self._pipeline(batch)
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k != "episode_returns"}
+        jb = self._place_batch(jb)
         self.params, self.opt_state, aux = self._update(
             self.params, self.opt_state, jb)
         self.num_updates += 1
@@ -212,13 +255,23 @@ class IMPALA:
     sampling never waits for the learner (async actor-learner)."""
 
     def __init__(self, config: IMPALAConfig):
+        from ray_tpu.rl.module import CNNModuleConfig
+
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
-        self.module_cfg = MLPModuleConfig(
-            observation_size=probe.observation_size,
-            num_actions=probe.num_actions, hidden=tuple(config.hidden))
+        obs_shape = getattr(probe, "observation_shape", None)
+        if obs_shape is not None:
+            # image env -> CNN module (config #4's Atari-shaped path)
+            self.module_cfg = CNNModuleConfig(
+                obs_shape=tuple(obs_shape), num_actions=probe.num_actions)
+        else:
+            self.module_cfg = MLPModuleConfig(
+                observation_size=probe.observation_size,
+                num_actions=probe.num_actions, hidden=tuple(config.hidden))
         module_blob = cloudpickle.dumps(self.module_cfg)
         cfg_blob = cloudpickle.dumps(config)
+        self._connector_blob = cloudpickle.dumps(
+            config.env_to_module) if config.env_to_module else None
 
         # control-plane actors FIRST: on a loaded host the worker-boot
         # queue is FIFO, and a learner created after a 256-runner fleet
@@ -239,7 +292,8 @@ class IMPALA:
         for lo in range(0, config.num_env_runners, wave):
             batch = [
                 runner_cls.remote(config.env, config.num_envs_per_runner,
-                                  config.seed + i, module_blob)
+                                  config.seed + i, module_blob,
+                                  self._connector_blob)
                 for i in range(lo, min(lo + wave, config.num_env_runners))]
             if config.boot_wave:
                 # stagger fleet boot: each wave's workers finish importing

@@ -1,7 +1,15 @@
 """RLModule — the jax policy/value network (ref analog:
 rllib/core/rl_module/rl_module.py `RLModule`; torch modules there, pure
 jax pytrees here so the learner jits end-to-end and shards over the
-mesh)."""
+mesh).
+
+Two architectures share one functional interface (`init_params` /
+`forward` / `sample_actions`): an MLP for vector observations and an
+IMPALA-style shallow CNN for image observations (ref analog: the conv
+nets in rllib/core/rl_module + rllib/models/; Espeholt et al. 2018's
+small tower). `forward` dispatches on the params structure, so env
+runners and learners are architecture-agnostic.
+"""
 
 from __future__ import annotations
 
@@ -21,8 +29,52 @@ class MLPModuleConfig:
     hidden: tuple = (64, 64)
 
 
-def init_params(cfg: MLPModuleConfig, key: jax.Array) -> dict:
-    """Shared torso + policy and value heads."""
+@dataclasses.dataclass(frozen=True)
+class CNNModuleConfig:
+    """Image policy: conv tower -> dense -> pi/vf heads. obs [B, H, W, C]
+    float32 (connectors normalize uint8 pixels upstream)."""
+    obs_shape: tuple          # (H, W, C)
+    num_actions: int
+    # (out_channels, kernel, stride) per conv layer — default is the
+    # classic small tower (fits Catch/MinAtar-scale; Atari uses the same
+    # shape with larger strides)
+    conv: tuple = ((16, 4, 2), (32, 3, 1))
+    hidden: int = 128
+
+
+def make_module_config(observation, num_actions: int, **kw):
+    """Pick the architecture from the observation spec: images (H, W, C)
+    get the CNN, flat vectors the MLP."""
+    if isinstance(observation, tuple) and len(observation) == 3:
+        return CNNModuleConfig(obs_shape=tuple(observation),
+                               num_actions=num_actions, **kw)
+    return MLPModuleConfig(observation_size=int(observation),
+                           num_actions=num_actions, **kw)
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class _ConvMeta:
+    """Static (non-leaf) conv metadata riding inside the params pytree:
+    tree.map / optimizers never see it, so grads and updates skip it."""
+    stride: int
+
+
+def _head_params(h: int, num_actions: int, k1, k2) -> dict:
+    return {
+        "pi": {"w": (jax.random.normal(k1, (h, num_actions))
+                     * 0.01).astype(jnp.float32),
+               "b": jnp.zeros((num_actions,), jnp.float32)},
+        "vf": {"w": (jax.random.normal(k2, (h, 1))
+                     * 1.0 / math.sqrt(h)).astype(jnp.float32),
+               "b": jnp.zeros((1,), jnp.float32)},
+    }
+
+
+def init_params(cfg, key: jax.Array) -> dict:
+    """Shared torso + policy and value heads (MLP or CNN by config)."""
+    if isinstance(cfg, CNNModuleConfig):
+        return _init_cnn(cfg, key)
     dims = (cfg.observation_size,) + tuple(cfg.hidden)
     keys = jax.random.split(key, len(dims) + 1)
     torso = [
@@ -32,22 +84,57 @@ def init_params(cfg: MLPModuleConfig, key: jax.Array) -> dict:
         for k, a, b in zip(keys, dims[:-1], dims[1:])
     ]
     h = dims[-1]
-    return {
-        "torso": torso,
-        "pi": {"w": (jax.random.normal(keys[-2], (h, cfg.num_actions))
-                     * 0.01).astype(jnp.float32),
-               "b": jnp.zeros((cfg.num_actions,), jnp.float32)},
-        "vf": {"w": (jax.random.normal(keys[-1], (h, 1))
-                     * 1.0 / math.sqrt(h)).astype(jnp.float32),
-               "b": jnp.zeros((1,), jnp.float32)},
-    }
+    return {"torso": torso,
+            **_head_params(h, cfg.num_actions, keys[-2], keys[-1])}
+
+
+def _init_cnn(cfg: CNNModuleConfig, key: jax.Array) -> dict:
+    H, W, C = cfg.obs_shape
+    keys = iter(jax.random.split(key, len(cfg.conv) + 3))
+    conv = []
+    in_ch = C
+    h, w = H, W
+    for out_ch, k, s in cfg.conv:
+        fan_in = k * k * in_ch
+        conv.append({
+            "w": (jax.random.normal(next(keys), (k, k, in_ch, out_ch))
+                  * math.sqrt(2.0 / fan_in)).astype(jnp.float32),
+            "b": jnp.zeros((out_ch,), jnp.float32),
+            "meta": _ConvMeta(s),
+        })
+        h = -(-h // s)   # SAME padding output size
+        w = -(-w // s)
+        in_ch = out_ch
+    flat = h * w * in_ch
+    dense = {"w": (jax.random.normal(next(keys), (flat, cfg.hidden))
+                   * math.sqrt(2.0 / flat)).astype(jnp.float32),
+             "b": jnp.zeros((cfg.hidden,), jnp.float32)}
+    return {"conv": conv, "dense": dense,
+            **_head_params(cfg.hidden, cfg.num_actions,
+                           next(keys), next(keys))}
+
+
+def _cnn_torso(params: dict, obs: jax.Array) -> jax.Array:
+    x = obs.astype(jnp.float32)
+    for layer in params["conv"]:
+        s = layer["meta"].stride
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(s, s), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + layer["b"])
+    x = x.reshape(x.shape[0], -1)
+    return jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
 
 
 def forward(params: dict, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """-> (action logits [B, A], value [B])"""
-    x = obs
-    for layer in params["torso"]:
-        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    """-> (action logits [B, A], value [B]). Dispatches on the params
+    structure so callers stay architecture-agnostic."""
+    if "conv" in params:
+        x = _cnn_torso(params, obs)
+    else:
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
     return logits, value

@@ -54,11 +54,18 @@ class PPO:
     sample → update → broadcast."""
 
     def __init__(self, config: PPOConfig):
+        from ray_tpu.rl.module import CNNModuleConfig
+
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
-        self.module_cfg = MLPModuleConfig(
-            observation_size=probe.observation_size,
-            num_actions=probe.num_actions, hidden=tuple(config.hidden))
+        obs_shape = getattr(probe, "observation_shape", None)
+        if obs_shape is not None:
+            self.module_cfg = CNNModuleConfig(
+                obs_shape=tuple(obs_shape), num_actions=probe.num_actions)
+        else:
+            self.module_cfg = MLPModuleConfig(
+                observation_size=probe.observation_size,
+                num_actions=probe.num_actions, hidden=tuple(config.hidden))
         module_blob = cloudpickle.dumps(self.module_cfg)
         learner_blob = cloudpickle.dumps(self.config.learner_config())
 
@@ -124,7 +131,7 @@ class PPO:
                 cfg.gamma, cfg.gae_lambda, s.get("trunc_values"))
             T, N = s["rewards"].shape
             steps += T * N
-            obs.append(s["obs"].reshape(T * N, -1))
+            obs.append(s["obs"].reshape((T * N,) + s["obs"].shape[2:]))
             acts.append(s["actions"].reshape(T * N))
             logps.append(s["logp"].reshape(T * N))
             advs.append(adv.reshape(T * N))

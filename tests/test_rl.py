@@ -251,3 +251,97 @@ def test_dqn_learns_cartpole(local_cluster):
         algo.stop()
     assert first is not None, "no episodes completed"
     assert best >= 120.0, f"DQN failed to learn: first={first} best={best}"
+
+
+# ----------------------------------------------------- image RL (round 4)
+def test_catch_env_mechanics():
+    from ray_tpu.rl.env import CatchVectorEnv
+
+    env = CatchVectorEnv(num_envs=4, seed=0)
+    obs = env.reset(0)
+    assert obs.shape == (4, 10, 10, 1)
+    assert obs.sum(axis=(1, 2, 3)).max() <= 2.0  # fruit + paddle pixels
+    total_reward = np.zeros(4)
+    dones = 0
+    for _ in range(30):
+        obs, r, term, trunc, _ = env.step(np.ones(4, np.int64))  # stay
+        total_reward += r
+        dones += int(term.sum())
+    assert dones >= 4  # fruit lands within GRID steps, episodes recycle
+    assert np.all(np.abs(total_reward) >= 1.0)  # every env saw an outcome
+
+
+def test_cnn_module_forward_and_grad():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rl import module as rlm
+
+    cfg = rlm.CNNModuleConfig(obs_shape=(10, 10, 1), num_actions=3)
+    params = rlm.init_params(cfg, jax.random.PRNGKey(0))
+    obs = jnp.zeros((5, 10, 10, 1), jnp.float32)
+    logits, value = rlm.forward(params, obs)
+    assert logits.shape == (5, 3) and value.shape == (5,)
+
+    # optimizer round-trip: conv stride metadata must be invisible to
+    # gradients/updates (static pytree node)
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    def loss(p):
+        lg, v = rlm.forward(p, obs)
+        return (lg ** 2).mean() + (v ** 2).mean()
+
+    grads = jax.grad(loss)(params)
+    updates, state = opt.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert new_params["conv"][0]["meta"].stride == 2
+
+    # sampling path used by env runners
+    a, logp, v = rlm.sample_actions(params, np.zeros((3, 10, 10, 1),
+                                                     np.float32),
+                                    jax.random.PRNGKey(1))
+    assert a.shape == (3,) and logp.shape == (3,)
+
+
+def test_connector_pipeline():
+    from ray_tpu.rl.connectors import (ConnectorPipeline, FlattenObs,
+                                       NormalizeImage)
+
+    pipe = ConnectorPipeline([NormalizeImage(), FlattenObs()])
+    obs = np.full((2, 4, 4, 1), 255, np.uint8)
+    out = pipe(obs)
+    assert out.shape == (2, 16)
+    assert out.dtype == np.float32 and float(out.max()) == 1.0
+
+
+def test_impala_learns_catch_with_cnn(local_cluster):
+    """Config #4 shape at CI scale: image observations stream from the
+    runner fleet into a CNN V-trace learner; mean return must clear a
+    committed threshold well above the random policy (~-0.8)."""
+    from ray_tpu.rl.impala import IMPALAConfig
+    from ray_tpu.rl.module import CNNModuleConfig
+
+    algo = IMPALAConfig(
+        env="Catch-v0", num_env_runners=2, num_envs_per_runner=16,
+        rollout_fragment_length=32, train_batch_size=1024,
+        lr=3e-3, entropy_coeff=0.01, seed=0).build()
+    assert isinstance(algo.module_cfg, CNNModuleConfig)
+    try:
+        first = None
+        best = -1.0
+        for _ in range(60):
+            result = algo.train()
+            if first is None and result["episode_return_mean"] != 0.0:
+                first = result["episode_return_mean"]
+            best = max(best, result["episode_return_mean"])
+            if best >= -0.2:
+                break
+        # random policy sits at ~-0.8; the committed CI threshold is a
+        # clear learning signal within the test budget (the full curve to
+        # >=+0.8 is committed by tools/rl_image_bench.py at bench scale)
+        assert best >= -0.2, \
+            f"CNN IMPALA failed to learn Catch: best={best} first={first}"
+    finally:
+        algo.stop()
