@@ -5,7 +5,8 @@ from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F4
                                       load_pytree, save_pytree)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
                                   Result, RunConfig, ScalingConfig)
-from ray_tpu.train.controller import (FailurePolicy, ScalingPolicy,  # noqa: F401
+from ray_tpu.train.controller import (ElasticScalingPolicy,  # noqa: F401
+                                      FailurePolicy, ScalingPolicy,
                                       TrainController, TrainingFailedError)
 from ray_tpu.train.recipes import lora_finetune_loop  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401

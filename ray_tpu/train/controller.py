@@ -51,9 +51,60 @@ class ScalingPolicy:
         return scaling
 
 
+class ElasticScalingPolicy(ScalingPolicy):
+    """Re-mesh at worker (slice) granularity on restart: size the group
+    to what the ALIVE cluster can hold right now, clamped to
+    [min_workers, max_workers]. On a node death the failure path
+    checkpoints, this policy shrinks the group, the surviving hosts
+    rebuild the collective group + mesh at the new world size, and the
+    user loop resumes from the latest checkpoint; when capacity returns a
+    later (re)start grows the group back (ref:
+    train/v2/_internal/execution/scaling_policy/scaling_policy.py:26).
+
+    One worker == one TPU host of a slice, so shrinking by whole workers
+    IS slice-granular — a worker never holds a fraction of a slice's
+    chips (ScalingConfig.worker_resources carries the per-host bundle).
+    """
+
+    def __init__(self, min_workers: int = 1,
+                 max_workers: Optional[int] = None,
+                 settle_timeout_s: float = 15.0):
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.settle_timeout_s = settle_timeout_s
+
+    def _capacity(self, per_worker: dict) -> int:
+        cluster = rt.cluster_resources()  # alive nodes only
+        cap = None
+        for r, amt in per_worker.items():
+            if amt <= 0:
+                continue
+            fit = int(cluster.get(r, 0.0) // amt)
+            cap = fit if cap is None else min(cap, fit)
+        return cap if cap is not None else 0
+
+    def on_start(self, scaling: ScalingConfig) -> ScalingConfig:
+        import dataclasses as _dc
+
+        want = self.max_workers or scaling.num_workers
+        per = scaling.worker_resources()
+        deadline = time.monotonic() + self.settle_timeout_s
+        cap = self._capacity(per)
+        # brief settle: right after a crash the dead node may not be
+        # reaped from the view yet (or a replacement may be mid-register)
+        while cap < self.min_workers and time.monotonic() < deadline:
+            time.sleep(0.5)
+            cap = self._capacity(per)
+        n = max(self.min_workers, min(want, cap))
+        if n == scaling.num_workers:
+            return scaling
+        return _dc.replace(scaling, num_workers=n)
+
+
 class TrainController:
     def __init__(self, train_fn: Callable, config: Optional[dict],
-                 scaling: ScalingConfig, run_config: RunConfig):
+                 scaling: ScalingConfig, run_config: RunConfig,
+                 scaling_policy: Optional[ScalingPolicy] = None):
         self.train_fn = train_fn
         self.config = config
         self.scaling = scaling
@@ -69,17 +120,20 @@ class TrainController:
             cc.checkpoint_score_order)
         self.failure_policy = FailurePolicy(
             run_config.failure_config.max_failures)
-        self.scaling_policy = ScalingPolicy()
+        self.scaling_policy = scaling_policy or ScalingPolicy()
         self.latest_metrics: Optional[dict] = None
         self._group_seq = 0
+        self._last_world_size = scaling.num_workers
         self._seen_checkpoints: set[str] = set()
 
     # ------------------------------------------------------------------ run
     def run(self) -> Result:
         error: Optional[BaseException] = None
         while True:
+            sized = self.scaling_policy.on_start(self.scaling)
+            self._last_world_size = sized.num_workers
             group = WorkerGroup(
-                self.scaling_policy.on_start(self.scaling), self.run_config,
+                sized, self.run_config,
                 self.experiment_path, self.experiment_name, self._group_seq)
             self._group_seq += 1
             latest = (self.checkpoint_manager.latest.path
@@ -116,7 +170,7 @@ class TrainController:
         complete ones (all ranks reported) the manager hasn't seen."""
         import glob
 
-        n = self.scaling.num_workers
+        n = self._last_world_size
         for step_dir in sorted(glob.glob(
                 os.path.join(self.experiment_path, "checkpoint_*"))):
             if step_dir in self._seen_checkpoints:

@@ -23,16 +23,19 @@ class JaxTrainer:
     def __init__(self, train_loop_per_worker: Callable, *,
                  train_loop_config: Optional[dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 scaling_policy=None):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.scaling_policy = scaling_policy
 
     def fit(self) -> Result:
         controller = TrainController(
             self.train_loop_per_worker, self.train_loop_config,
-            self.scaling_config, self.run_config)
+            self.scaling_config, self.run_config,
+            scaling_policy=self.scaling_policy)
         return controller.run()
 
 
