@@ -65,6 +65,11 @@ def _build_specs(app: Application) -> tuple[list[dict], str]:
             "autoscaling_config": d.autoscaling_config,
             "max_ongoing_requests": d.max_ongoing_requests,
             "user_config": d.user_config,
+            "health_check_period_s": d.health_check_period_s,
+            "health_check_timeout_s": d.health_check_timeout_s,
+            "health_check_failure_threshold":
+                d.health_check_failure_threshold,
+            "drain_timeout_s": d.drain_timeout_s,
         })
     return specs, app.deployment.name
 

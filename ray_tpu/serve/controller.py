@@ -34,8 +34,19 @@ class ServeController:
         # queue-length cache — here controller-mediated so every handle
         # in every process sees the same load view)
         self._replica_load: dict[tuple, dict[int, float]] = {}
+        # in-progress version replacements: (app, dep) -> {"old": [handles
+        # still routed], "warming": [new-version handles not yet routed]}
+        # (ref: deployment_state.py rolling update — old replicas keep
+        # serving until a new-version replica is READY, so the routing
+        # table never goes empty mid-update)
+        self._updating: dict[tuple, dict] = {}
+        # active health probing: actor_hex -> consecutive failures
+        # (ref: deployment_state.py replica health checks)
+        self._health_fails: dict[str, int] = {}
+        self._last_probe: dict[tuple, float] = {}
         self._loop_task = None  # started via ensure_loop (needs the
         # actor's asyncio loop, which doesn't exist during __init__)
+        self._reconcile_lock: asyncio.Lock | None = None  # lazy: needs loop
 
     async def ensure_loop(self) -> bool:
         if self._loop_task is None:
@@ -61,27 +72,53 @@ class ServeController:
 
         new = {spec["name"]: spec for spec in dep_specs}
         old = self.apps.get(app_name, {})
-        # Drop replicas of deployments removed from the new spec, and of
-        # deployments whose code/args changed (version replace) — otherwise
-        # stale replicas keep serving the old callable forever.
-        stale = set(old) - set(new)
-        stale |= {d for d in set(old) & set(new)
-                  if self._spec_version(old[d]) != self._spec_version(new[d])}
-        # Graceful rolling replace: drop stale replicas from the routing
-        # table immediately (so no NEW requests reach them) but let their
-        # in-flight requests drain before killing — the reconcile below
-        # starts new-version replicas right away.
-        for dep_name in stale:
+        removed = set(old) - set(new)
+        # deployments whose code/args changed: VERSION REPLACE. Old
+        # replicas STAY in the routing table and keep serving; the
+        # reconcile loop warms new-version replicas and retires one old
+        # replica per ready new one — zero requests dropped (ref:
+        # deployment_state.py rolling update).
+        replaced = {d for d in set(old) & set(new)
+                    if self._spec_version(old[d]) != self._spec_version(new[d])}
+        for dep_name in removed:
             drain_s = float(old.get(dep_name, {}).get(
                 "drain_timeout_s", 30.0) or 0)
             deadline = time.monotonic() + drain_s
             for handle in self.replicas.pop((app_name, dep_name), []):
                 self._draining.append((handle, deadline))
-        if stale:
+            self._abandon_update((app_name, dep_name))
+        for dep_name in replaced:
+            key = (app_name, dep_name)
+            # update-of-an-update: abandoned warming replicas die
+            self._abandon_update(key)
+            self._updating[key] = {
+                "old": list(self.replicas.get(key, [])),
+                "warming": [],
+                "drain_timeout_s": float(new[dep_name].get(
+                    "drain_timeout_s", 30.0) or 0),
+            }
+        if removed:
             self.version += 1
         self.apps[app_name] = new
         await self._reconcile()
         return True
+
+    @staticmethod
+    def _kill_quietly(handle):
+        import ray_tpu as rt
+
+        try:
+            rt.kill(handle)
+        except Exception:
+            pass
+
+    def _abandon_update(self, key: tuple):
+        """Kill warming (unrouted) replicas of a cancelled update so a
+        removed/deleted deployment can't leak actors."""
+        st = self._updating.pop(key, None)
+        if st is not None:
+            for h in st["warming"]:
+                self._kill_quietly(h)
 
     async def delete_application(self, app_name: str) -> bool:
         import ray_tpu as rt
@@ -91,10 +128,8 @@ class ServeController:
             return False
         for dep_name in specs:
             for handle in self.replicas.pop((app_name, dep_name), []):
-                try:
-                    rt.kill(handle)
-                except Exception:
-                    pass
+                self._kill_quietly(handle)
+            self._abandon_update((app_name, dep_name))
         self.version += 1
         return True
 
@@ -180,6 +215,15 @@ class ServeController:
         self._draining = keep
 
     async def _reconcile(self):
+        # non-reentrant: deploy_application's eager reconcile and the
+        # background loop interleave at await points; double-stepping a
+        # rolling update would double-start/retire replicas
+        if self._reconcile_lock is None:
+            self._reconcile_lock = asyncio.Lock()
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self):
         import ray_tpu as rt
 
         changed = False
@@ -188,6 +232,7 @@ class ServeController:
                 key = (app_name, dep_name)
                 live = [h for h in self.replicas.get(key, [])
                         if self._alive(h)]
+                live = await self._probe_health(key, spec, live)
                 if len(live) != len(self.replicas.get(key, [])):
                     changed = True
                 self.replicas[key] = live
@@ -195,6 +240,9 @@ class ServeController:
                 self._replica_load[key] = {
                     i: v for i, v in enumerate(stats or [])
                     if v is not None}
+                if key in self._updating:
+                    changed |= await self._step_update(key, spec, live)
+                    continue
                 target = await self._target_replicas(key, spec, len(live),
                                                      stats)
                 while len(live) < target:
@@ -203,13 +251,118 @@ class ServeController:
                     changed = True
                 while len(live) > target:
                     victim = live.pop()
-                    try:
-                        rt.kill(victim)
-                    except Exception:
-                        pass
+                    self._kill_quietly(victim)
                     changed = True
         if changed:
             self.version += 1
+
+    async def _step_update(self, key: tuple, spec: dict,
+                           live: list) -> bool:
+        """One tick of a rolling version replace: warm new-version
+        replicas toward the target, and for each one that becomes READY
+        route it in and move one old replica to draining. Old replicas
+        keep serving the whole time, so no request window ever has an
+        empty routing table."""
+        st = self._updating[key]
+        app_name, dep_name = key
+        # re-read the CURRENT spec: this reconcile pass may have captured
+        # its spec dict before the deploy that created this update (the
+        # lock serializes passes, not the iteration snapshot) — warming
+        # from the stale spec would "update" to the old version
+        spec = self.apps.get(app_name, {}).get(dep_name, spec)
+        target = spec.get("num_replicas", 1)
+        changed = False
+        # old replicas that died on their own shrink the retire queue
+        st["old"] = [h for h in st["old"] if h in live]
+        while len(st["warming"]) + self._new_count(key, live) < target:
+            st["warming"].append(self._start_replica(app_name, spec))
+        ready, still = [], []
+        for h in st["warming"]:
+            if await self._is_ready(h):
+                ready.append(h)
+            else:
+                still.append(h)
+        st["warming"] = still
+        for h in ready:
+            live.append(h)      # route the new-version replica in ...
+            changed = True
+            if st["old"]:       # ... and retire one old-version replica
+                self._retire_old(st, live)
+        if self._new_count(key, live) >= target:
+            # downscaling update: once the new version covers the target,
+            # retire EVERY remaining old replica (one-for-one swaps alone
+            # would strand the excess serving the old version forever)
+            while st["old"]:
+                self._retire_old(st, live)
+                changed = True
+        if not st["old"] and not st["warming"]:
+            del self._updating[key]   # update complete
+        return changed
+
+    def _retire_old(self, st: dict, live: list):
+        victim = st["old"].pop()
+        if victim in live:
+            live.remove(victim)
+        self._draining.append(
+            (victim, time.monotonic() + st["drain_timeout_s"]))
+
+    def _new_count(self, key: tuple, live: list) -> int:
+        st = self._updating.get(key)
+        if st is None:
+            return len(live)
+        return len([h for h in live if h not in st["old"]])
+
+    async def _is_ready(self, handle) -> bool:
+        import ray_tpu as rt
+
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: rt.get(handle.check_health.remote(),
+                                     timeout=5))
+            return True
+        except Exception:
+            return False
+
+    async def _probe_health(self, key: tuple, spec: dict,
+                            live: list) -> list:
+        """Active replica health checks (ref: deployment_state.py health
+        probes): every health_check_period_s call check_health() on each
+        routed replica; consecutive failures past the threshold kill the
+        replica — the target loop then replaces it."""
+        import ray_tpu as rt
+
+        period = float(spec.get("health_check_period_s", 10.0) or 0)
+        if period <= 0:
+            return live
+        now = time.monotonic()
+        if now - self._last_probe.get(key, 0.0) < period:
+            return live
+        self._last_probe[key] = now
+        threshold = int(spec.get("health_check_failure_threshold", 2))
+        healthy = []
+        for h in live:
+            hexid = h._actor_id.hex()
+            try:
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda h=h: rt.get(
+                        h.check_health.remote(),
+                        timeout=float(spec.get("health_check_timeout_s",
+                                               5.0))))
+                ok = bool(ok)
+            except Exception:
+                ok = False
+            if ok:
+                self._health_fails.pop(hexid, None)
+                healthy.append(h)
+                continue
+            fails = self._health_fails.get(hexid, 0) + 1
+            self._health_fails[hexid] = fails
+            if fails >= threshold:
+                self._health_fails.pop(hexid, None)
+                self._kill_quietly(h)   # replaced by the target loop
+            else:
+                healthy.append(h)       # not yet past the threshold
+        return healthy
 
     def _alive(self, handle) -> bool:
         from ray_tpu.core.common import ActorState

@@ -28,7 +28,11 @@ class Deployment:
                  ray_actor_options: Optional[dict] = None,
                  autoscaling_config: Optional[AutoscalingConfig | dict] = None,
                  max_ongoing_requests: int = 16,
-                 user_config: Any = None):
+                 user_config: Any = None,
+                 health_check_period_s: float = 10.0,
+                 health_check_timeout_s: float = 5.0,
+                 health_check_failure_threshold: int = 2,
+                 drain_timeout_s: float = 30.0):
         self.func_or_class = func_or_class
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -41,6 +45,10 @@ class Deployment:
         self.autoscaling_config = autoscaling_config
         self.max_ongoing_requests = max_ongoing_requests
         self.user_config = user_config
+        self.health_check_period_s = health_check_period_s
+        self.health_check_timeout_s = health_check_timeout_s
+        self.health_check_failure_threshold = health_check_failure_threshold
+        self.drain_timeout_s = drain_timeout_s
 
     def options(self, **kwargs) -> "Deployment":
         merged = dict(
@@ -48,7 +56,11 @@ class Deployment:
             ray_actor_options=self.ray_actor_options,
             autoscaling_config=self.autoscaling_config,
             max_ongoing_requests=self.max_ongoing_requests,
-            user_config=self.user_config)
+            user_config=self.user_config,
+            health_check_period_s=self.health_check_period_s,
+            health_check_timeout_s=self.health_check_timeout_s,
+            health_check_failure_threshold=self.health_check_failure_threshold,
+            drain_timeout_s=self.drain_timeout_s)
         merged.update(kwargs)
         return Deployment(self.func_or_class, **merged)
 
@@ -91,14 +103,19 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[AutoscalingConfig | dict] = None,
                max_ongoing_requests: int = 16,
-               user_config: Any = None):
+               user_config: Any = None,
+               health_check_period_s: float = 10.0,
+               health_check_timeout_s: float = 5.0,
+               health_check_failure_threshold: int = 2,
+               drain_timeout_s: float = 30.0):
     """@serve.deployment decorator (ref: serve/api.py)."""
 
     def wrap(target):
         return Deployment(
             target, name or target.__name__, num_replicas,
             ray_actor_options, autoscaling_config, max_ongoing_requests,
-            user_config)
+            user_config, health_check_period_s, health_check_timeout_s,
+            health_check_failure_threshold, drain_timeout_s)
 
     if func_or_class is not None:
         return wrap(func_or_class)

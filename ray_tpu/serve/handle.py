@@ -25,22 +25,46 @@ def _get_controller():
 
 
 class DeploymentResponse:
-    """Future-like response (ref: serve handle DeploymentResponse)."""
+    """Future-like response (ref: serve handle DeploymentResponse).
 
-    def __init__(self, ref, on_done):
+    A request that raced a replica teardown (rolling update retiring it,
+    health probe killing it) resolves to ActorDiedError — the router
+    retries it on a live replica from a force-refreshed table, so
+    clients never see the transient (ref: router retry of requests to
+    draining/dead replicas)."""
+
+    _MAX_DEAD_RETRIES = 3
+
+    def __init__(self, ref, on_done, resubmit=None):
         self._ref = ref
         self._on_done = on_done
+        self._resubmit = resubmit
         self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
 
     def result(self, timeout: Optional[float] = None) -> Any:
         import ray_tpu as rt
+        from ray_tpu.core.common import ActorDiedError
 
+        attempts = 0
         try:
-            return rt.get(self._ref, timeout=timeout)
+            while True:
+                try:
+                    return rt.get(self._ref, timeout=timeout)
+                except ActorDiedError:
+                    if self._resubmit is None or \
+                            attempts >= self._MAX_DEAD_RETRIES:
+                        raise
+                    attempts += 1
+                    self._finish()  # release the dead replica's slot
+                    self._ref, self._on_done = self._resubmit()
+                    self._done = False
         finally:
-            if not self._done:
-                self._done = True
-                self._on_done()
+            self._finish()
 
     @property
     def ref(self):
@@ -225,7 +249,9 @@ class DeploymentHandle:
         return replica
 
     # ---------------------------------------------------------------- call
-    def remote(self, *args, **kwargs):
+    def _route(self):
+        """Pick a replica and charge this handle's in-flight count;
+        returns (replica, done) where done releases the charge."""
         replica = self._pick_replica_for_model(self.multiplexed_model_id)
         with self._lock:
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
@@ -235,11 +261,25 @@ class DeploymentHandle:
                 n = self._inflight.get(replica, 1)
                 self._inflight[replica] = max(0, n - 1)
 
+        return replica, done
+
+    def _submit_once(self, args, kwargs):
+        replica, done = self._route()
+        ref = replica.handle_request.remote(
+            self.method_name, args, kwargs, self.multiplexed_model_id)
+        return ref, done
+
+    def remote(self, *args, **kwargs):
         if self.stream:
+            replica, done = self._route()
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
                 self.method_name, args, kwargs, self.multiplexed_model_id)
             return DeploymentResponseGenerator(ref_gen, done)
-        ref = replica.handle_request.remote(
-            self.method_name, args, kwargs, self.multiplexed_model_id)
-        return DeploymentResponse(ref, done)
+        ref, done = self._submit_once(args, kwargs)
+
+        def resubmit():
+            self._refresh(force=True)
+            return self._submit_once(args, kwargs)
+
+        return DeploymentResponse(ref, done, resubmit)

@@ -370,3 +370,99 @@ def test_grpc_ingress_unary_and_stream(serve_cluster):
     except grpc.RpcError as e:
         assert e.code() == grpc.StatusCode.NOT_FOUND
     chan.close()
+
+
+# ------------------------------------------------ rolling updates (round 4)
+def test_rolling_update_zero_dropped_requests(serve_cluster):
+    """Deploy v2 of an app under continuous traffic: every request
+    succeeds, answers switch from v1 to v2, and the routing table never
+    goes empty (ref: deployment_state.py rolling update)."""
+    import threading
+
+    @serve.deployment(num_replicas=2)
+    class V:
+        def __call__(self):
+            return "v1"
+
+    handle = serve.run(V.bind(), name="roll")
+    assert handle.remote().result(timeout=30) == "v1"
+
+    results: list = []
+    errors: list = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                results.append(handle.remote().result(timeout=30))
+            except Exception as e:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.5)
+
+        @serve.deployment(num_replicas=2)
+        class V:  # noqa: F811  — same deployment name, new code
+            def __call__(self):
+                return "v2"
+
+        serve.run(V.bind(), name="roll")
+        # wait until traffic is fully on v2
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            recent = results[-10:]
+            if len(recent) == 10 and all(r == "v2" for r in recent):
+                break
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, f"dropped requests during rolling update: {errors[:3]}"
+    assert "v1" in results and "v2" in results
+    assert results[-1] == "v2"
+    # no response from any third version / garbage
+    assert set(results) <= {"v1", "v2"}
+
+
+def test_replica_health_probe_replaces_unhealthy(serve_cluster):
+    """A replica whose check_health starts failing is killed and replaced
+    by the reconcile loop; requests keep succeeding (ref:
+    deployment_state.py health checks)."""
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.5,
+                      health_check_timeout_s=2.0,
+                      health_check_failure_threshold=2)
+    class Flaky:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+            self.calls = 0
+
+        def check_health(self):
+            self.calls += 1
+            if self.calls >= 2:
+                raise RuntimeError("replica went bad")
+
+        def __call__(self):
+            return self.pid
+
+    handle = serve.run(Flaky.bind(), name="flaky")
+    first_pid = handle.remote().result(timeout=30)
+    # the probe loop must replace the replica (new process, new pid)
+    deadline = time.monotonic() + 60
+    new_pid = first_pid
+    while time.monotonic() < deadline:
+        try:
+            new_pid = handle.remote().result(timeout=30)
+            if new_pid != first_pid:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert new_pid != first_pid, "unhealthy replica was never replaced"
