@@ -35,7 +35,8 @@ class JobManager:
         self.jobs: dict[str, dict] = {}
 
     def submit(self, entrypoint: str, env: Optional[dict] = None,
-               submission_id: Optional[str] = None) -> str:
+               submission_id: Optional[str] = None,
+               runtime_env: Optional[dict] = None) -> str:
         sub_id = submission_id or f"raytjob-{uuid.uuid4().hex[:8]}"
         if sub_id in self.jobs:
             raise ValueError(f"submission id {sub_id!r} already exists")
@@ -43,15 +44,61 @@ class JobManager:
         job_env = dict(os.environ)
         job_env.update(env or {})
         job_env["RAYT_ADDRESS"] = self.gcs_address
+        cwd = None
+        if runtime_env:
+            cwd = self._apply_runtime_env(runtime_env, job_env)
         log_f = open(log_path, "wb")
         proc = subprocess.Popen(
             entrypoint, shell=True, stdout=log_f, stderr=subprocess.STDOUT,
-            env=job_env)
+            env=job_env, cwd=cwd)
         self.jobs[sub_id] = {
             "proc": proc, "log_path": log_path, "entrypoint": entrypoint,
             "start_time": time.time(), "log_file": log_f,
+            "runtime_env": {k: v for k, v in (runtime_env or {}).items()
+                            if k != "env_vars"},
         }
         return sub_id
+
+    @staticmethod
+    def _apply_runtime_env(renv: dict, job_env: dict) -> Optional[str]:
+        """Materialize the job driver's runtime env (the same machinery
+        tasks/actors use — ref: job submissions route through the
+        runtime-env agent in job_manager.py:59): pip installs into the
+        content-addressed venv cache and rides PATH/PYTHONPATH;
+        working_dir becomes the driver cwd; py_modules join PYTHONPATH.
+        NOTE: pip installation blocks — callers on an event loop must run
+        submit() in an executor."""
+        from ray_tpu._internal import runtime_env as renv_mod
+
+        renv_mod.validate(renv)
+        job_env.update(renv.get("env_vars") or {})
+        py_paths: list[str] = []
+        cwd = None
+        wd = renv.get("working_dir")
+        if wd:
+            cwd = os.path.abspath(wd)
+            if not os.path.isdir(cwd):
+                raise ValueError(f"working_dir {wd!r} does not exist")
+            py_paths.append(cwd)
+        for m in renv.get("py_modules") or []:
+            p = os.path.abspath(m)
+            # the IMPORT ROOT: a package dir's parent, a .py file's dir
+            py_paths.append(os.path.dirname(p))
+        pip = renv.get("pip")
+        if pip:
+            spec = renv_mod.package({"pip": pip},
+                                    kv_put=lambda *a: None)["pip"]
+            venv_dir = renv_mod.ensure_pip_venv(spec)
+            renv_mod.mark_pip_venv_in_use(venv_dir)
+            job_env["VIRTUAL_ENV"] = venv_dir
+            job_env["PATH"] = (os.path.join(venv_dir, "bin") + os.pathsep
+                               + job_env.get("PATH", ""))
+            py_paths.append(renv_mod._venv_site_packages(venv_dir))
+        if py_paths:
+            existing = job_env.get("PYTHONPATH", "")
+            job_env["PYTHONPATH"] = os.pathsep.join(
+                py_paths + ([existing] if existing else []))
+        return cwd
 
     def status(self, sub_id: str) -> Optional[dict]:
         job = self.jobs.get(sub_id)
@@ -77,6 +124,28 @@ class JobManager:
                 return f.read().decode(errors="replace")
         except OSError:
             return ""
+
+    def tail_logs(self, sub_id: str, offset: int = 0) -> Optional[dict]:
+        """Incremental log read for follow-mode streaming (ref: the job
+        log tailing the state API exposes): returns the bytes after
+        `offset` plus the next offset and whether the job still runs."""
+        job = self.jobs.get(sub_id)
+        if job is None:
+            return None
+        # poll BEFORE reading: a job that flushes its last lines and
+        # exits between a read-then-poll would report running=False with
+        # the final bytes unread, ending a --follow loop early
+        running = job["proc"].poll() is None
+        data = b""
+        try:
+            with open(job["log_path"], "rb") as f:
+                f.seek(offset)
+                data = f.read()
+        except OSError:
+            pass
+        return {"data": data.decode(errors="replace"),
+                "offset": offset + len(data),
+                "running": running}
 
     def stop_job(self, sub_id: str) -> bool:
         job = self.jobs.get(sub_id)
@@ -218,9 +287,12 @@ class DashboardHead:
             return web.json_response({"error": "entrypoint required"},
                                      status=400)
         try:
-            sub_id = self.job_manager.submit(
-                entrypoint, env=body.get("env"),
-                submission_id=body.get("submission_id"))
+            # executor thread: a pip runtime_env blocks on install
+            sub_id = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.job_manager.submit(
+                    entrypoint, env=body.get("env"),
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env")))
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"submission_id": sub_id})
@@ -236,7 +308,14 @@ class DashboardHead:
     async def _job_logs(self, request):
         from aiohttp import web
 
-        logs = self.job_manager.logs(request.match_info["sub_id"])
+        sub_id = request.match_info["sub_id"]
+        if "offset" in request.query:  # incremental tail for --follow
+            out = self.job_manager.tail_logs(
+                sub_id, int(request.query["offset"]))
+            if out is None:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(out)
+        logs = self.job_manager.logs(sub_id)
         if logs is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.Response(text=logs, content_type="text/plain")

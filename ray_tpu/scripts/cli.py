@@ -254,6 +254,11 @@ def cmd_job_submit(args):
     payload = {"entrypoint": entry}
     if args.submission_id:
         payload["submission_id"] = args.submission_id
+    if args.runtime_env_json:
+        payload["runtime_env"] = json.loads(args.runtime_env_json)
+    if args.working_dir:
+        payload.setdefault("runtime_env", {})["working_dir"] = \
+            args.working_dir
     print(_dash_request(args, "/api/jobs", payload))
 
 
@@ -262,7 +267,23 @@ def cmd_job_status(args):
 
 
 def cmd_job_logs(args):
-    print(_dash_request(args, f"/api/jobs/{args.submission_id}/logs"))
+    if not getattr(args, "follow", False):
+        print(_dash_request(args, f"/api/jobs/{args.submission_id}/logs"))
+        return
+    import sys
+    import time as _time
+
+    offset = 0
+    while True:  # poll the incremental tail endpoint until the job exits
+        body = json.loads(_dash_request(
+            args, f"/api/jobs/{args.submission_id}/logs?offset={offset}"))
+        if body.get("data"):
+            sys.stdout.write(body["data"])
+            sys.stdout.flush()
+        offset = body.get("offset", offset)
+        if not body.get("running"):
+            return
+        _time.sleep(0.5)
 
 
 def cmd_job_stop(args):
@@ -295,8 +316,13 @@ def main(argv=None):
         if name == "submit":
             jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
             jsp.add_argument("--submission-id")
+            jsp.add_argument("--runtime-env-json",
+                             help='e.g. \'{"pip": ["six"]}\'')
+            jsp.add_argument("--working-dir")
         elif name != "list":
             jsp.add_argument("submission_id")
+            if name == "logs":
+                jsp.add_argument("--follow", action="store_true")
         jsp.set_defaults(fn=fn)
 
     sp = sub.add_parser("stop", help="stop the head node")
