@@ -130,6 +130,12 @@ class NativeArenaStore:
         self._held: dict[Any, int] = {}   # oid -> get-refcount
         self._pending: dict[Any, int] = {}  # unsealed oid -> abs offset
         self._lock = threading.Lock()
+        # fallback-to-disk allocation (ref: plasma_allocator.cc fallback
+        # mmaps): objects that don't fit the arena land in per-node files,
+        # named by object id so every worker process sees them
+        self._fallback_dir = os.path.join(
+            "/tmp", f"rayt_fallback_{name}")
+        self._pending_fb: dict[Any, str] = {}  # unsealed oid -> tmp path
 
     # ------------------------------------------------------------- helpers
     def _payload(self, offset: int, size: int) -> memoryview:
@@ -171,30 +177,67 @@ class NativeArenaStore:
         self.seal(object_id, hold=hold)
 
     # --------------------------------------------------- streaming creates
+    # ------------------------------------------------- fallback-to-disk
+    def _fb_path(self, object_id) -> str:
+        return os.path.join(self._fallback_dir, object_id.hex())
+
+    def _fb_exists(self, object_id) -> bool:
+        return os.path.exists(self._fb_path(object_id))
+
     def create_unsealed(self, object_id, size: int) -> bool:
         """Allocate an entry to be filled by write_at + seal. The object
         is invisible to contains/get until sealed (state kCreating).
-        False if it already exists; MemoryError if the arena is full."""
+        False if it already exists. When the arena cannot fit it even
+        after eviction, allocation FALLS BACK to a per-node file (ref:
+        plasma fallback allocation) instead of raising."""
+        if self._fb_exists(object_id):
+            return False
         off = ctypes.c_uint64()
         rc = self._lib.rayt_shm_create(self._handle, object_id.binary(),
                                        size, ctypes.byref(off))
         if rc == -1:
             return False
         if rc != 0:
-            raise MemoryError(
-                f"shm store out of memory for {size} bytes "
-                f"(used {self.used()}/{self.capacity()})")
+            # arena full: file-backed allocation, sealed via rename.
+            # O_EXCL serializes concurrent creators across processes —
+            # the loser sees the .creating file and treats the object as
+            # already-in-progress (duplicate-transfer semantics).
+            os.makedirs(self._fallback_dir, exist_ok=True)
+            tmp = self._fb_path(object_id) + ".creating"
+            try:
+                fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            try:
+                os.ftruncate(fd, size)
+            finally:
+                os.close(fd)
+            with self._lock:
+                self._pending_fb[object_id] = tmp
+            return True
         with self._lock:
             self._pending[object_id] = self._arena_off + off.value
         return True
 
     def write_at(self, object_id, offset: int, data):
         with self._lock:
-            base = self._pending[object_id]
+            base = self._pending.get(object_id)
+            fb = self._pending_fb.get(object_id)
+        if base is None and fb is not None:
+            with open(fb, "r+b") as f:
+                f.seek(offset)
+                f.write(bytes(data) if not isinstance(
+                    data, (bytes, bytearray)) else data)
+            return
         n = len(data)
         self._mv[base + offset:base + offset + n] = data
 
     def seal(self, object_id, hold: bool = False):
+        with self._lock:
+            fb = self._pending_fb.pop(object_id, None)
+        if fb is not None:
+            os.replace(fb, self._fb_path(object_id))  # atomic seal
+            return
         self._lib.rayt_shm_seal(self._handle, object_id.binary())
         with self._lock:
             self._pending.pop(object_id, None)
@@ -207,15 +250,22 @@ class NativeArenaStore:
     def abort_unsealed(self, object_id):
         """Drop a half-written entry (failed/cancelled pull)."""
         with self._lock:
+            fb = self._pending_fb.pop(object_id, None)
             self._pending.pop(object_id, None)
+        if fb is not None:
+            try:
+                os.remove(fb)
+            except OSError:
+                pass
+            return
         # creator still holds its create-ref: delete tombstones the entry,
         # release drops the last ref and frees the block
         self._lib.rayt_shm_delete(self._handle, object_id.binary())
         self._lib.rayt_shm_release(self._handle, object_id.binary())
 
     def contains_locally(self, object_id) -> bool:
-        return bool(self._lib.rayt_shm_contains(self._handle,
-                                                object_id.binary()))
+        return bool(self._lib.rayt_shm_contains(
+            self._handle, object_id.binary())) or self._fb_exists(object_id)
 
     def _get_view(self, object_id, size: int) -> memoryview:
         off = ctypes.c_uint64()
@@ -223,6 +273,9 @@ class NativeArenaStore:
         rc = self._lib.rayt_shm_get(self._handle, object_id.binary(),
                                     ctypes.byref(off), ctypes.byref(sz))
         if rc != 0:
+            if self._fb_exists(object_id):
+                with open(self._fb_path(object_id), "rb") as f:
+                    return memoryview(f.read())
             raise KeyError(f"object {object_id} not in shm store (rc={rc})")
         with self._lock:
             self._held[object_id] = self._held.get(object_id, 0) + 1
@@ -244,6 +297,14 @@ class NativeArenaStore:
                    length: int) -> bytes:
         """One transfer chunk: bytes [offset, offset+length) of the
         sealed payload (ref: object_buffer_pool chunked reads)."""
+        if not self._lib.rayt_shm_contains(self._handle,
+                                           object_id.binary()) \
+                and self._fb_exists(object_id):
+            # fallback file: seek+read the chunk — materializing the
+            # whole (by definition large) file per chunk would be O(n^2)
+            with open(self._fb_path(object_id), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
         view = self._get_view(object_id, size)
         try:
             return bytes(view[offset:offset + length])
@@ -278,6 +339,11 @@ class NativeArenaStore:
 
     def unlink(self, object_id):
         self._lib.rayt_shm_delete(self._handle, object_id.binary())
+        if self._fb_exists(object_id):
+            try:
+                os.remove(self._fb_path(object_id))
+            except OSError:
+                pass
 
     def used(self) -> int:
         return self._lib.rayt_shm_used(self._handle)
@@ -312,3 +378,7 @@ class NativeArenaStore:
         lib = load_shm_lib()
         if lib is not None:
             lib.rayt_shm_unlink(name.encode())
+        import shutil
+
+        shutil.rmtree(os.path.join("/tmp", f"rayt_fallback_{name}"),
+                      ignore_errors=True)

@@ -62,12 +62,19 @@ def test_pinned_objects_survive_eviction(store):
     store.release(pinned)
 
 
-def test_oom_when_everything_pinned(store):
+def test_pinned_arena_falls_back_to_disk(store):
+    """When every arena byte is pinned, new allocations land in the
+    per-node fallback files instead of raising (ref: plasma fallback
+    allocation, plasma_allocator.cc)."""
     oid = ObjectID.random()
     n = store.create_from_bytes(oid, bytes(700 * 1024))
     store._get_view(oid, n)  # pin
-    with pytest.raises(MemoryError):
-        store.create_from_bytes(ObjectID.random(), bytes(700 * 1024))
+    oid2 = ObjectID.random()
+    payload = bytes(700 * 1024)
+    store.create_from_bytes(oid2, payload)  # arena full -> disk
+    assert store.contains_locally(oid2)
+    assert store._fb_exists(oid2)           # really on the fallback path
+    assert store.read_bytes(oid2, len(payload)) == payload
     store.release(oid)
 
 
