@@ -88,6 +88,7 @@ def _run(on_tpu: bool) -> dict:
     cfg = llama.config_for(
         preset, max_seq_len=seq, remat=on_tpu,
         remat_save_attn=os.environ.get("RAYT_BENCH_SAVE_ATTN", "0") == "1",
+        remat_policy=os.environ.get("RAYT_BENCH_REMAT", "dots"),
         attn_impl="flash" if on_tpu else "xla")
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -58,6 +58,13 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     remat_save_attn: bool = False
+    # activation-saving policy under remat (PERF.md: dots saves ~8.5GB of
+    # activations at b8 s2048 on the 410m config and OOMs b16 on 16GB
+    # HBM; "nothing" saves only the ~32MB/layer block carry, trading one
+    # extra block forward in the backward for the batch headroom):
+    #   "dots"    — dots_with_no_batch_dims_saveable (matmul outputs)
+    #   "nothing" — full per-block recompute (minimum memory)
+    remat_policy: str = "dots"
     # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "auto"
     seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
@@ -115,6 +122,12 @@ PRESETS: dict[str, dict] = {
                  n_kv_heads=12, hidden_dim=2048, max_seq_len=2048),
     "410m": dict(vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
                  n_kv_heads=16, hidden_dim=2816, max_seq_len=2048),
+    # same params/FLOPs as 410m with head_dim=128 (8x128 instead of
+    # 16x64): fills the MXU's 128-wide contraction and the 128-lane
+    # tiling — the bench geometry matching Llama-2-7B's head_dim
+    # (PERF.md: the biggest modeled MFU lever for the attention kernel)
+    "410m-hd128": dict(vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
+                       n_kv_heads=8, hidden_dim=2816, max_seq_len=2048),
     "1b": dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
                n_kv_heads=8, hidden_dim=5632, max_seq_len=2048),
     "llama2-7b": dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
@@ -301,14 +314,23 @@ def backbone(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         return (x, aux_sum + aux), None
 
     if cfg.remat:
-        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if cfg.remat_policy == "nothing":
+            policy = None   # save only the block carry; recompute all
+        elif cfg.remat_policy == "dots":
+            policy = \
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}")
         if cfg.remat_save_attn:
             # also save flash-attention outputs (dots WITH batch dims are
             # not covered by the base policy, so the kernel forward would
             # rerun inside the backward); costs b*s*d*2B per layer
-            policy = jax.checkpoint_policies.save_from_both_policies(
-                policy,
-                jax.checkpoint_policies.save_only_these_names("attn_out"))
+            save_attn = jax.checkpoint_policies.save_only_these_names(
+                "attn_out")
+            policy = (save_attn if policy is None else
+                      jax.checkpoint_policies.save_from_both_policies(
+                          policy, save_attn))
         step = jax.checkpoint(step, policy=policy)
     (x, aux_sum), _ = jax.lax.scan(
         step, (x, jnp.zeros((), jnp.float32)), scanned_layers)

@@ -24,7 +24,8 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from ray_tpu.util.collective.store import (PeerServer, StoreServer, peer_send,
+from ray_tpu.util.collective.store import (PeerServer, REDUCE_UFUNCS,
+                                           StoreServer, peer_send,
                                            store_call)
 
 _NS = "collective"
@@ -127,8 +128,53 @@ class CollectiveGroup:
     def barrier(self, timeout: float = 300.0):
         self._call("barrier", None, timeout)
 
+    # arrays at/above this ride the bandwidth-optimal peer ring instead of
+    # the rank-0 star (the star serializes world_size full copies through
+    # one host; the ring moves 2*(w-1)/w of the array per rank — the Gloo
+    # ring the reference uses for big CPU tensors,
+    # gloo_collective_group.py)
+    RING_THRESHOLD_BYTES = 1 << 20
+
     def allreduce(self, array, op: str = "sum", timeout: float = 300.0):
-        return self._call(f"allreduce:{op}", np.asarray(array), timeout)
+        arr = np.asarray(array)
+        if (arr.nbytes >= self.RING_THRESHOLD_BYTES
+                and self.world_size > 1 and op in REDUCE_UFUNCS):
+            return self._ring_allreduce(arr, op, timeout)
+        return self._call(f"allreduce:{op}", arr, timeout)
+
+    def _ring_allreduce(self, arr: "np.ndarray", op: str,
+                        timeout: float) -> "np.ndarray":
+        """Classic two-phase ring: w-1 reduce-scatter steps then w-1
+        allgather steps over the per-rank peer servers; each rank sends
+        to rank+1 and receives from rank-1. Peer sends buffer in the
+        receiver's inbox, so the ring cannot rendezvous-deadlock."""
+        w, r = self.world_size, self.rank
+        ufunc = REDUCE_UFUNCS[op]
+        flat = arr.reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, w)]
+        # NEGATIVE tag namespace: user send()/recv() tags are >= 0, so
+        # ring traffic can never collide with a buffered p2p payload from
+        # the ring predecessor. The shared per-kind sequence numbers
+        # (drawn in the same order on every rank) keep concurrent
+        # allreduces separate.
+        base = -1 - int(self._next("ring").split("#")[1]) * 4096
+        nxt = self._peer_addr((r + 1) % w)
+        prv = (r - 1) % w
+        for step in range(w - 1):               # reduce-scatter
+            send_idx = (r - step) % w
+            recv_idx = (r - step - 1) % w
+            peer_send(nxt, r, base - step, chunks[send_idx],
+                      timeout=timeout)
+            got = self.peer.recv(prv, base - step, timeout)
+            chunks[recv_idx] = ufunc(chunks[recv_idx], got)
+        for step in range(w - 1):               # allgather
+            send_idx = (r + 1 - step) % w
+            recv_idx = (r - step) % w
+            peer_send(nxt, r, base - 2048 - step, chunks[send_idx],
+                      timeout=timeout)
+            chunks[recv_idx] = self.peer.recv(prv, base - 2048 - step,
+                                              timeout)
+        return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype)
 
     def allgather(self, array, timeout: float = 300.0) -> list:
         return self._call("gather", np.asarray(array), timeout)
@@ -149,11 +195,15 @@ class CollectiveGroup:
     def send(self, array, dst_rank: int, tag: int = 0):
         if dst_rank == self.rank:
             raise ValueError("cannot send to self")
+        if tag < 0:
+            raise ValueError("negative tags are reserved for ring traffic")
         peer_send(self._peer_addr(dst_rank), self.rank, tag, np.asarray(array))
 
     def recv(self, src_rank: int, tag: int = 0, timeout: float = 300.0):
         if src_rank == self.rank:
             raise ValueError("cannot recv from self")
+        if tag < 0:
+            raise ValueError("negative tags are reserved for ring traffic")
         return self.peer.recv(src_rank, tag, timeout)
 
     def destroy(self):

@@ -49,11 +49,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# one op table for every reduction path (star server + peer ring)
+REDUCE_UFUNCS: dict[str, Callable] = {
+    "sum": np.add, "prod": np.multiply,
+    "min": np.minimum, "max": np.maximum,
+}
+
 REDUCE_OPS: dict[str, Callable] = {
-    "sum": lambda parts: _tree_reduce(np.add, parts),
-    "prod": lambda parts: _tree_reduce(np.multiply, parts),
-    "min": lambda parts: _tree_reduce(np.minimum, parts),
-    "max": lambda parts: _tree_reduce(np.maximum, parts),
+    name: (lambda parts, _u=ufunc: _tree_reduce(_u, parts))
+    for name, ufunc in REDUCE_UFUNCS.items()
 }
 
 

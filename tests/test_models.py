@@ -227,3 +227,37 @@ def test_lora_train_step_freezes_base(cfg, params):
         lambda before, after: np.testing.assert_array_equal(
             before, np.asarray(after)),
         base_before, state["frozen"])
+
+
+def test_remat_policies_match():
+    """All remat policies are numerically identical (they only trade
+    memory for recompute); hd128 preset loads."""
+    params = llama.init_params(
+        llama.config_for("debug", attn_impl="xla"), jax.random.PRNGKey(0))
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                     256),
+        "targets": jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0,
+                                      256),
+    }
+
+    def loss_for(policy, save_attn=False):
+        c = llama.config_for("debug", attn_impl="xla", remat=True,
+                             remat_policy=policy,
+                             remat_save_attn=save_attn)
+        val, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, c)[0])(params)
+        return float(val), grads
+
+    l_dots, g_dots = loss_for("dots")
+    l_none, g_none = loss_for("nothing")
+    l_attn, _ = loss_for("nothing", save_attn=True)
+    assert l_dots == l_none == l_attn
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5),
+        g_dots, g_none)
+
+    hd128 = llama.config_for("410m-hd128")
+    assert hd128.head_dim == 128
+    assert hd128.num_params() == llama.config_for("410m").num_params()

@@ -1,76 +1,107 @@
-"""On-chip MFU sweep: try bench configs in ONE process, print a table.
+"""On-chip MFU sweep over (preset, batch, remat policy) configs.
 
-Usage: python tools/mfu_sweep.py  (expects a live TPU backend)
+One CHILD PROCESS per config: the tunnel's remote compile helper rejects
+a second large compile in one process, so each measurement pays backend
+init once and exits (same discipline as bench.py).
+
+Round-4 matrix (PERF.md decomposition):
+  * head_dim geometry — 410m (16x64) vs 410m-hd128 (8x128, same params):
+    hd64 half-fills the MXU's 128-wide contraction; hd128 is the
+    Llama-7B geometry and the biggest modeled attention lever.
+  * remat policy — "dots" (saves matmul outputs, ~8.5GB at b8, OOMs b16)
+    vs "nothing" (saves only block carries, unlocks b16/b24).
+
+Usage: python tools/mfu_sweep.py            # run the matrix
+       python tools/mfu_sweep.py --one preset batch policy  # child mode
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import optax
-
-from ray_tpu.models import llama
-from ray_tpu.parallel.mesh import build_mesh
-from ray_tpu.parallel.spmd import build_train_step, shard_batch
-
 PEAK = 197e12  # v5e bf16
+SEQ = 2048
+STEPS = 15
+
+CONFIGS = [
+    # (preset, batch, remat_policy)
+    ("410m", 8, "dots"),          # round-3 champion (21.4k tok/s)
+    ("410m", 8, "nothing"),       # recompute-cost A/B at equal batch
+    ("410m", 16, "nothing"),      # the batch headroom "dots" OOMs on
+    ("410m", 24, "nothing"),
+    ("410m-hd128", 8, "dots"),    # MXU-aligned head_dim
+    ("410m-hd128", 16, "nothing"),
+    ("410m-hd128", 24, "nothing"),
+]
 
 
-def measure(preset: str, batch: int, seq: int, remat: bool,
-            mu_dtype=None, steps: int = 15, attn="flash") -> dict:
-    cfg = llama.config_for(preset, max_seq_len=seq, remat=remat,
-                           attn_impl=attn)
+def measure(preset: str, batch: int, policy: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.parallel.spmd import build_train_step, shard_batch
+
+    cfg = llama.config_for(preset, max_seq_len=SEQ, remat=True,
+                           remat_policy=policy, attn_impl="flash")
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    opt = optax.adamw(3e-4, mu_dtype=mu_dtype)
     step, state = build_train_step(
-        lambda p, b: llama.loss_fn(p, b, cfg), opt, params,
+        lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(3e-4), params,
         llama.param_logical_axes(cfg), mesh)
     del params
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ), 0,
                                 cfg.vocab_size)
-    data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
-    data = shard_batch(data, mesh)
+    data = shard_batch({"tokens": tokens,
+                        "targets": jnp.roll(tokens, -1, 1)}, mesh)
     state, aux = step(state, data)
-    float(aux["loss"])
+    float(aux["loss"])  # sync (block_until_ready is a no-op on the tunnel)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(STEPS):
         state, aux = step(state, data)
     float(aux["loss"])
     dt = time.perf_counter() - t0
-    tok_s = batch * seq * steps / dt
+    tok_s = batch * SEQ * STEPS / dt
     mfu = tok_s * cfg.flops_per_token() / PEAK
-    del state
     return {"tok_s": round(tok_s, 1), "mfu": round(mfu, 4)}
 
 
 def main():
-    configs = [
-        dict(preset="410m", batch=8, seq=2048, remat=True),
-        dict(preset="410m", batch=8, seq=2048, remat=False),
-        dict(preset="410m", batch=16, seq=2048, remat=True),
-        dict(preset="410m", batch=16, seq=2048, remat=False),
-        dict(preset="410m", batch=32, seq=2048, remat=True),
-        dict(preset="1b", batch=8, seq=2048, remat=True,
-             mu_dtype=jnp.bfloat16),
-        dict(preset="1b", batch=16, seq=2048, remat=True,
-             mu_dtype=jnp.bfloat16),
-    ]
-    for c in configs:
-        label = {k: (str(v) if k == "mu_dtype" else v)
-                 for k, v in c.items()}
+    budget = float(os.environ.get("RAYT_SWEEP_TIMEOUT_S", "900"))
+    results = []
+    for preset, batch, policy in CONFIGS:
+        label = {"preset": preset, "batch": batch, "policy": policy}
         try:
-            r = measure(**c)
-        except Exception as e:
-            print(json.dumps({"cfg": label,
-                              "error": f"{type(e).__name__}: {e}"[:300]}),
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 preset, str(batch), policy],
+                capture_output=True, text=True, timeout=budget)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"cfg": label, "error": "timeout"}),
                   flush=True)
             continue
-        print(json.dumps({"cfg": label, **r}), flush=True)
+        line = next((ln for ln in reversed(r.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if r.returncode != 0 or line is None:
+            print(json.dumps({"cfg": label,
+                              "error": r.stderr[-300:]}), flush=True)
+            continue
+        row = {"cfg": label, **json.loads(line)}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    if results:
+        best = max(results, key=lambda r: r["mfu"])
+        print(json.dumps({"best": best}), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 5 and sys.argv[1] == "--one":
+        print(json.dumps(measure(sys.argv[2], int(sys.argv[3]),
+                                 sys.argv[4])), flush=True)
+    else:
+        main()
