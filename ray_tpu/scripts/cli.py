@@ -242,6 +242,12 @@ def _dash_request(args, path, data=None):
     return body
 
 
+def cmd_client_server(args):
+    from ray_tpu.client.server import main as client_main
+
+    client_main(args.address, port=args.port)
+
+
 def cmd_job_submit(args):
     import shlex
 
@@ -324,6 +330,12 @@ def main(argv=None):
             if name == "logs":
                 jsp.add_argument("--follow", action="store_true")
         jsp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("client-server",
+                        help="remote-driver proxy (ray-client analog)")
+    sp.add_argument("--address", required=True, help="GCS host:port")
+    sp.add_argument("--port", type=int, default=10001)
+    sp.set_defaults(fn=cmd_client_server)
 
     sp = sub.add_parser("stop", help="stop the head node")
     sp.set_defaults(fn=cmd_stop)

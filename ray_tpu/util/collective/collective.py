@@ -151,7 +151,9 @@ class CollectiveGroup:
         w, r = self.world_size, self.rank
         ufunc = REDUCE_UFUNCS[op]
         flat = arr.reshape(-1)
-        chunks = [c.copy() for c in np.array_split(flat, w)]
+        # views, not copies: steps REBIND chunks[i] (never mutate), and
+        # reduce results are fresh arrays anyway
+        chunks = list(np.array_split(flat, w))
         # NEGATIVE tag namespace: user send()/recv() tags are >= 0, so
         # ring traffic can never collide with a buffered p2p payload from
         # the ring predecessor. The shared per-kind sequence numbers
@@ -174,7 +176,8 @@ class CollectiveGroup:
                       timeout=timeout)
             chunks[recv_idx] = self.peer.recv(prv, base - 2048 - step,
                                               timeout)
-        return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype)
+        return np.concatenate(chunks).reshape(arr.shape).astype(
+            arr.dtype, copy=False)
 
     def allgather(self, array, timeout: float = 300.0) -> list:
         return self._call("gather", np.asarray(array), timeout)

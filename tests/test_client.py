@@ -1,0 +1,84 @@
+"""Remote-driver client tests (ref analog: python/ray/util/client tests):
+the client proxy executes tasks/actors/objects for a process with no
+local node manager."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import client as rt_client
+
+
+@pytest.fixture
+def proxy(local_cluster):
+    from ray_tpu.core.runtime import get_runtime_context
+
+    ctx = get_runtime_context()
+    addr = f"{ctx.gcs_address.host}:{ctx.gcs_address.port}"
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from ray_tpu.client.server import main; "
+         f"main({addr!r}, port=0)"],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line, "client proxy failed to start"
+    port = json.loads(line)["client_port"]
+    try:
+        yield f"127.0.0.1:{port}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_client_tasks_objects_actors(proxy):
+    ctx = rt_client.connect(proxy)
+    try:
+        @ctx.remote
+        def double(x):
+            return x * 2
+
+        ref = double.remote(21)
+        assert ctx.get(ref) == 42
+
+        # put/get + ref as task arg crosses the proxy boundary
+        big = list(range(1000))
+        data_ref = ctx.put(big)
+
+        @ctx.remote
+        def total(xs):
+            return sum(xs)
+
+        assert ctx.get(total.remote(data_ref)) == sum(big)
+
+        # wait
+        refs = [double.remote(i) for i in range(4)]
+        ready, rest = ctx.wait(refs, num_returns=4, timeout=60)
+        assert len(ready) == 4 and not rest
+
+        # actors
+        @ctx.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(10)
+        assert ctx.get(c.incr.remote()) == 11
+        assert ctx.get(c.incr.remote(5)) == 16
+        ctx.kill(c)
+
+        # options pass through
+        @ctx.remote(num_cpus=1)
+        def one():
+            return 1
+
+        assert ctx.get(one.remote()) == 1
+    finally:
+        ctx.disconnect()

@@ -65,3 +65,43 @@ def test_queue_producers_consumers(local_cluster):
     assert rt.get(p1) + rt.get(p2) == 10
     assert rt.get(c) == list(range(10))
     q.shutdown()
+
+
+# ------------------------------------------------ ecosystem shims (r4)
+def _mp_square(x):
+    return x * x
+
+
+def _mp_add(a, b):
+    return a + b
+
+
+def test_multiprocessing_pool_api(local_cluster):
+    """multiprocessing.Pool drop-in over cluster tasks (ref:
+    util/multiprocessing/pool.py)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_mp_square, range(8)) == [x * x for x in range(8)]
+        assert pool.starmap(_mp_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(_mp_add, (5, 6)) == 11
+        ar = pool.apply_async(_mp_square, (9,))
+        assert ar.get(timeout=60) == 81 and ar.ready() and ar.successful()
+        assert sorted(pool.imap_unordered(_mp_square, range(5))) == \
+            [0, 1, 4, 9, 16]
+        assert list(pool.imap(_mp_square, range(5))) == [0, 1, 4, 9, 16]
+    with pytest.raises(ValueError):
+        pool.map(_mp_square, [1])  # closed
+
+
+def test_joblib_backend(local_cluster):
+    """scikit-style joblib fan-out over the cluster (ref: util/joblib)."""
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_rayt
+
+    register_rayt()
+    with joblib.parallel_backend("rayt", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(_mp_square)(i) for i in range(6))
+    assert out == [i * i for i in range(6)]
