@@ -1,8 +1,8 @@
-"""Autoscaler v2-lite: an event-free reconciler loop (ref analogs:
-autoscaler/v2/autoscaler.py:42 `Autoscaler` + instance_manager/
-reconciler.py — read demand from the GCS, diff against launched
-instances, converge; and _private/autoscaler.py:171 for idle
-termination).
+"""Autoscaler v2: event-sourced reconciler over the instance manager
+(ref analogs: autoscaler/v2/autoscaler.py:42 `Autoscaler` +
+instance_manager/reconciler.py — converge desired demand, provider
+state, and GCS node state through explicit instance lifecycle events;
+_private/autoscaler.py:171 for idle termination).
 
 Slice-granular by design: TPU demand is satisfied by whole pod slices
 (NodeTypeConfig.hosts node processes at once), and idle scale-down only
@@ -17,6 +17,8 @@ import time
 from typing import Optional
 
 from ray_tpu._internal.logging_utils import setup_logger
+from ray_tpu.autoscaler.instance_manager import (InstanceManager,
+                                                 InstanceStatus)
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeTypeConfig
 
 logger = setup_logger("autoscaler")
@@ -34,6 +36,13 @@ class Autoscaler:
         self.reconcile_interval_s = reconcile_interval_s
         self._idle_since: dict[str, float] = {}   # slice_id -> ts
         self._task: Optional[asyncio.Task] = None
+        self.instance_manager = InstanceManager()
+        # cloud provisioning can take minutes; a REQUESTED slice absent
+        # from the provider listing is only failed past this deadline
+        self.request_timeout_s = 600.0
+        # one provider snapshot per tick: reused by every pass AND by
+        # stats(), so `rayt status` never blocks on a cloud API call
+        self._last_slices: dict[str, dict] = {}
         self.num_scale_ups = 0
         self.num_scale_downs = 0
 
@@ -57,10 +66,130 @@ class Autoscaler:
 
     # ------------------------------------------------------------ reconcile
     async def reconcile(self):
+        """One convergence tick over the three views (ref:
+        reconciler.py): observe provider + GCS into instance events, turn
+        unmet demand into QUEUED instances, launch QUEUED, retire idle."""
+        loop = asyncio.get_running_loop()
+        self._last_slices = await loop.run_in_executor(
+            None, self.provider.non_terminated_slices)
+        self._observe_provider(self._last_slices)
+        self._observe_gcs()
         demand = self._unmet_demand()
         if demand:
-            await self._scale_up(demand)
+            self._queue_for_demand(demand, self._last_slices)
+        await self._launch_queued()
         self._scale_down_idle()
+        self.instance_manager.prune_terminal()
+
+    # --------------------------------------------------- observation passes
+    def _observe_provider(self, live: dict):
+        """Provider state -> instance events: REQUESTED instances whose
+        slice appeared become ALLOCATED; ALLOCATED/RUNNING instances
+        whose slice VANISHED (preempted, crashed) become FAILED — the
+        demand pass then re-queues capacity if still needed. A REQUESTED
+        slice not yet visible is normal (cloud provisioning takes
+        minutes) until request_timeout_s."""
+        im = self.instance_manager
+        now = time.time()
+        for inst in im.instances(InstanceStatus.REQUESTED):
+            if inst.slice_id in live:
+                im.transition(
+                    inst.instance_id, InstanceStatus.ALLOCATED,
+                    "provider reports slice",
+                    node_ids=list(live[inst.slice_id].get("node_ids", [])))
+            elif inst.slice_id is not None and \
+                    now - inst.updated_at > self.request_timeout_s:
+                im.transition(inst.instance_id, InstanceStatus.FAILED,
+                              "request timed out")
+        for inst in im.instances(InstanceStatus.ALLOCATED,
+                                 InstanceStatus.RUNNING):
+            if inst.slice_id not in live:
+                im.transition(inst.instance_id, InstanceStatus.FAILED,
+                              "slice vanished from provider")
+        for inst in im.instances(InstanceStatus.STOPPING):
+            if inst.slice_id not in live:
+                im.transition(inst.instance_id, InstanceStatus.TERMINATED,
+                              "terminate confirmed")
+
+    def _observe_gcs(self):
+        """GCS node state -> instance events: an ALLOCATED instance
+        becomes RUNNING when its whole slice registered alive. Matching
+        is by the `slice` NODE LABEL (every autoscaled host advertises
+        it; provider-agnostic — GCP hosts self-label via the startup
+        script) with a node-id fallback for providers that report GCS
+        ids directly."""
+        im = self.instance_manager
+        alive_ids = set()
+        by_slice: dict[str, int] = {}
+        for nid, info in self.gcs.nodes.items():
+            if not info.alive:
+                continue
+            alive_ids.add(nid.hex())
+            label = getattr(info, "labels", {}).get("slice")
+            if label:
+                by_slice[label] = by_slice.get(label, 0) + 1
+        for inst in im.instances(InstanceStatus.ALLOCATED):
+            t = self.node_types.get(inst.node_type)
+            expected = t.hosts if t is not None else 1
+            if by_slice.get(inst.slice_id, 0) >= expected or (
+                    inst.node_ids
+                    and all(n in alive_ids for n in inst.node_ids)):
+                im.transition(inst.instance_id, InstanceStatus.RUNNING,
+                              "all hosts registered")
+
+    def _queue_for_demand(self, demands: list[dict], live_slices: dict):
+        """Unmet demand -> QUEUED instances, net of capacity already on
+        the way (queued/requested/allocated instances count as pending
+        supply so one demand doesn't launch a slice per tick)."""
+        im = self.instance_manager
+        pending: dict[str, int] = {}
+        for inst in im.instances(InstanceStatus.QUEUED,
+                                 InstanceStatus.REQUESTED,
+                                 InstanceStatus.ALLOCATED):
+            pending[inst.node_type] = pending.get(inst.node_type, 0) + 1
+        for demand in demands:
+            t = self._pick_node_type(demand)
+            if t is None:
+                logger.warning("no node type covers demand %s", demand)
+                continue
+            if pending.get(t.name, 0) > 0:
+                pending[t.name] -= 1   # already on the way
+                continue
+            live = sum(1 for e in live_slices.values()
+                       if e["node_type"] == t.name)
+            in_flight = sum(
+                1 for i in im.instances(InstanceStatus.QUEUED,
+                                        InstanceStatus.REQUESTED)
+                if i.node_type == t.name)
+            if live + in_flight >= t.max_slices:
+                continue
+            im.create(t.name)
+
+    async def _launch_queued(self):
+        """QUEUED -> REQUESTED. The instance stays REQUESTED until the
+        provider LISTS the slice (next _observe_provider tick): a
+        create that returned an id is provisioning, not allocated —
+        promoting it here would make slow cloud provisioning read as
+        'vanished -> FAILED' and relaunch every tick."""
+        im = self.instance_manager
+        loop = asyncio.get_running_loop()
+        for inst in im.instances(InstanceStatus.QUEUED):
+            t = self.node_types.get(inst.node_type)
+            if t is None:
+                im.transition(inst.instance_id, InstanceStatus.FAILED,
+                              "unknown node type")
+                continue
+            im.transition(inst.instance_id, InstanceStatus.REQUESTED,
+                          "launching")
+            try:
+                slice_id = await loop.run_in_executor(
+                    None, self.provider.create_slice, t)
+            except Exception as e:
+                im.transition(inst.instance_id, InstanceStatus.FAILED,
+                              f"create_slice failed: {e}")
+                continue
+            inst.slice_id = slice_id
+            self.num_scale_ups += 1
 
     def _unmet_demand(self) -> list[dict]:
         """Bundle-shaped demands not satisfiable by current ALIVE nodes.
@@ -98,30 +227,6 @@ class Autoscaler:
                 return True
         return False
 
-    async def _scale_up(self, demands: list[dict]):
-        """Pick the smallest node type whose per-host resources cover each
-        demand; launch one slice per distinct uncovered demand per tick
-        (conservative — the next tick re-evaluates)."""
-        launched_types: set[str] = set()
-        for demand in demands:
-            t = self._pick_node_type(demand)
-            if t is None:
-                logger.warning("no node type covers demand %s", demand)
-                continue
-            if t.name in launched_types:
-                continue  # one slice per type per tick
-            live = sum(1 for e in self.provider.non_terminated_slices()
-                       .values() if e["node_type"] == t.name)
-            if live >= t.max_slices:
-                continue
-            launched_types.add(t.name)
-            logger.info("scaling up: slice of %s for demand %s",
-                        t.name, demand)
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(
-                None, self.provider.create_slice, t)
-            self.num_scale_ups += 1
-
     def _pick_node_type(self, demand: dict) -> Optional[NodeTypeConfig]:
         candidates = []
         for t in self.node_types.values():
@@ -141,8 +246,7 @@ class Autoscaler:
         resources available == total) past the idle timeout."""
         now = time.monotonic()
         id_to_info = {nid.hex(): info for nid, info in self.gcs.nodes.items()}
-        for slice_id, entry in list(
-                self.provider.non_terminated_slices().items()):
+        for slice_id, entry in list(self._last_slices.items()):
             idle = True
             for nid_hex in entry["node_ids"]:
                 info = id_to_info.get(nid_hex)
@@ -164,12 +268,21 @@ class Autoscaler:
             if now - first >= self.idle_timeout_s:
                 logger.info("scaling down idle slice %s", slice_id)
                 self._idle_since.pop(slice_id, None)
+                inst = self.instance_manager.by_slice(slice_id)
+                if inst is not None:
+                    self.instance_manager.transition(
+                        inst.instance_id, InstanceStatus.STOPPING,
+                        "idle past timeout")
                 self.provider.terminate_slice(slice_id)
                 self.num_scale_downs += 1
 
     def stats(self) -> dict:
+        # served from the last reconcile snapshot: callable from the GCS
+        # event loop without touching the (possibly remote) provider
         return {
-            "slices": self.provider.non_terminated_slices(),
+            "slices": dict(self._last_slices),
             "num_scale_ups": self.num_scale_ups,
             "num_scale_downs": self.num_scale_downs,
+            "instances": self.instance_manager.summary(),
+            "instance_events": list(self.instance_manager.event_log)[-50:],
         }

@@ -124,9 +124,17 @@ class ClientContext:
         return self._io.run(self._conn.call(method, arg, timeout=timeout))
 
     def _encode_args(self, args):
+        # recursive: refs nested in containers must become markers too —
+        # pickling a ClientObjectRef would drag the context's event-loop
+        # thread into the payload
         def enc(a):
             if isinstance(a, ClientObjectRef):
                 return _ClientRefMarker(a._id)
+            if isinstance(a, dict):
+                return {k: enc(v) for k, v in a.items()}
+            if isinstance(a, (list, tuple)):
+                out = [enc(v) for v in a]
+                return tuple(out) if isinstance(a, tuple) else out
             return a
 
         if isinstance(args, dict):
@@ -163,7 +171,11 @@ class ClientContext:
         # indefinite waits poll in BOUNDED wire calls: one long-lived RPC
         # would trip the transport timeout (and strand a proxy executor
         # thread) on any task slower than the wire budget
-        self._poll_until(ids, len(ids), timeout)
+        ready = self._poll_until(ids, len(ids), timeout)
+        if len(ready) < len(ids):
+            raise TimeoutError(
+                f"get timed out after {timeout}s "
+                f"({len(ready)}/{len(ids)} ready)")
         blobs = self._call("client_get", (ids, 30.0), timeout=60)
         values = [cloudpickle.loads(b) for b in blobs]
         return values[0] if single else values

@@ -46,6 +46,11 @@ class ClientProxyService:
         def sub(a):
             if isinstance(a, _ClientRefMarker):
                 return self._refs[a.ref_id]
+            if isinstance(a, dict):
+                return {k: sub(v) for k, v in a.items()}
+            if isinstance(a, (list, tuple)):
+                out = [sub(v) for v in a]
+                return tuple(out) if isinstance(a, tuple) else out
             return a
 
         if isinstance(args, dict):

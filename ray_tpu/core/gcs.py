@@ -932,7 +932,7 @@ class GcsServer:
 
     # ---------------------------------------------------------- debugging
     def rpc_cluster_status(self, conn, arg=None):
-        return {
+        out = {
             "uptime_s": now() - self._started,
             "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
             "num_actors": len(self.actors),
@@ -945,6 +945,16 @@ class GcsServer:
                  "nodes": [n.hex() for n in pg.get("placement") or []]}
                 for pg_id, pg in self.placement_groups.items()],
         }
+        # monitor-in-head: head_main attaches the autoscaler so `rayt
+        # status` can show the instance lifecycle (ref: `ray status`
+        # rendering autoscaler v2 instance states)
+        scaler = getattr(self, "autoscaler", None)
+        if scaler is not None:
+            try:
+                out["autoscaler"] = scaler.stats()
+            except Exception:
+                pass
+        return out
 
 
 class GcsClient:

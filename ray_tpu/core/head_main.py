@@ -43,6 +43,7 @@ async def run(args):
             gcs, provider, types,
             idle_timeout_s=as_cfg.get("idle_timeout_s", 60.0),
             reconcile_interval_s=as_cfg.get("reconcile_interval_s", 1.0))
+        gcs.autoscaler = autoscaler  # status surface (rpc_cluster_status)
         autoscaler.start()
     nm = None
     if args.gcs_only:

@@ -79,3 +79,188 @@ def test_pending_actor_triggers_scale_up_then_idle_scale_down(
             return
         time.sleep(0.5)
     raise AssertionError(f"idle slice never scaled down: {alive_tpu}")
+
+
+# ------------------------------------------- v2 instance lifecycle (r4)
+def test_instance_lifecycle_events():
+    from ray_tpu.autoscaler.instance_manager import (InstanceManager,
+                                                     InstanceStatus)
+
+    im = InstanceManager()
+    inst = im.create("v5p-8")
+    assert inst.status == InstanceStatus.QUEUED
+    assert im.transition(inst.instance_id, InstanceStatus.REQUESTED, "go")
+    assert im.transition(inst.instance_id, InstanceStatus.ALLOCATED,
+                         "provider", slice_id="s1", node_ids=["a", "b"])
+    assert im.transition(inst.instance_id, InstanceStatus.RUNNING, "gcs")
+    # invalid transitions are rejected, not applied
+    assert not im.transition(inst.instance_id, InstanceStatus.REQUESTED,
+                             "backwards")
+    assert im.get(inst.instance_id).status == InstanceStatus.RUNNING
+    assert im.transition(inst.instance_id, InstanceStatus.STOPPING, "idle")
+    assert im.transition(inst.instance_id, InstanceStatus.TERMINATED,
+                         "gone")
+    states = [e["to"] for e in im.get(inst.instance_id).events]
+    assert states == [InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+                      InstanceStatus.ALLOCATED, InstanceStatus.RUNNING,
+                      InstanceStatus.STOPPING, InstanceStatus.TERMINATED]
+    assert im.by_slice("s1").instance_id == inst.instance_id
+    assert len(im.event_log) == 6
+
+
+class _ScriptedProvider:
+    """Deterministic provider for reconciler unit tests."""
+
+    def __init__(self):
+        self.slices = {}
+        self.n = 0
+        self.fail_next = False
+
+    def create_slice(self, node_type):
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("quota")
+        self.n += 1
+        sid = f"s{self.n}"
+        self.slices[sid] = {"node_type": node_type.name,
+                            "node_ids": [f"n{self.n}"]}
+        return sid
+
+    def terminate_slice(self, sid):
+        self.slices.pop(sid, None)
+
+    def non_terminated_slices(self):
+        return {k: dict(v) for k, v in self.slices.items()}
+
+
+class _FakeGcs:
+    def __init__(self):
+        self.nodes = {}
+        self.node_resources_available = {}
+        self._demand = {"placement_groups": [], "actors": [], "tasks": []}
+
+    def rpc_get_pending_demand(self, _):
+        return self._demand
+
+
+def test_reconciler_event_sourced_lifecycle():
+    """Demand -> QUEUED -> REQUESTED -> ALLOCATED -> RUNNING; vanished
+    slice -> FAILED and capacity is re-queued (ref: v2 reconciler.py)."""
+    import asyncio
+
+    from ray_tpu._internal.ids import NodeID
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+    from ray_tpu.autoscaler.instance_manager import InstanceStatus
+    from ray_tpu.autoscaler.node_provider import NodeTypeConfig
+
+    gcs = _FakeGcs()
+    provider = _ScriptedProvider()
+    a = Autoscaler(gcs, provider,
+                   [NodeTypeConfig("v5p-8", {"TPU": 4.0}, hosts=1)],
+                   idle_timeout_s=9999)
+    gcs._demand["actors"] = [{"TPU": 4.0}]
+
+    asyncio.run(a.reconcile())
+    im = a.instance_manager
+    # create returned, but allocation is only believed once the provider
+    # LISTS the slice (cloud provisioning can take minutes)
+    requested = im.instances(InstanceStatus.REQUESTED)
+    assert len(requested) == 1 and requested[0].slice_id == "s1"
+    # next tick observes the listing -> ALLOCATED; no second launch
+    asyncio.run(a.reconcile())
+    allocated = im.instances(InstanceStatus.ALLOCATED)
+    assert len(allocated) == 1 and allocated[0].slice_id == "s1"
+    assert len(provider.slices) == 1
+
+    # the slice's host registers in the GCS -> RUNNING
+
+    class _Named:
+        alive = True
+
+    real = NodeID.random()
+    allocated[0].node_ids = [real.hex()]
+    gcs.nodes = {real: _Named()}
+    gcs._demand["actors"] = []
+    asyncio.run(a.reconcile())
+    assert im.instances(InstanceStatus.RUNNING)
+
+    # provider loses the slice (preemption) -> FAILED
+    provider.slices.clear()
+    asyncio.run(a.reconcile())
+    assert im.instances(InstanceStatus.FAILED)
+
+    # demand returns -> fresh instance queued and launched
+    gcs._demand["actors"] = [{"TPU": 4.0}]
+    asyncio.run(a.reconcile())
+    assert len(provider.slices) == 1
+    events = [e["to"] for e in im.event_log]
+    assert InstanceStatus.FAILED in events
+
+
+def test_reconciler_create_failure_marks_failed():
+    import asyncio
+
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+    from ray_tpu.autoscaler.instance_manager import InstanceStatus
+    from ray_tpu.autoscaler.node_provider import NodeTypeConfig
+
+    gcs = _FakeGcs()
+    provider = _ScriptedProvider()
+    provider.fail_next = True
+    a = Autoscaler(gcs, provider,
+                   [NodeTypeConfig("v5p-8", {"TPU": 4.0}, hosts=1)],
+                   idle_timeout_s=9999)
+    gcs._demand["actors"] = [{"TPU": 4.0}]
+    asyncio.run(a.reconcile())
+    failed = a.instance_manager.instances(InstanceStatus.FAILED)
+    assert failed and "create_slice failed" in failed[0].events[-1]["reason"]
+    # next tick retries with a fresh instance
+    asyncio.run(a.reconcile())
+    assert len(provider.slices) == 1
+
+
+def test_gcp_provider_request_shapes():
+    """The GCP TPU provider builds correct queuedResources requests and
+    parses node listings (transport injected — no egress)."""
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+    from ray_tpu.autoscaler.node_provider import NodeTypeConfig
+
+    calls = []
+
+    def transport(method, url, body=None):
+        calls.append((method, url, body))
+        if method == "GET":
+            return {"nodes": [
+                {"name": "projects/p/locations/z/nodes/rayt-v5p-16-abc",
+                 "state": "READY",
+                 "labels": {"rayt-node-type": "v5p-16"},
+                 "networkEndpoints": [{"ipAddress": "10.0.0.2"},
+                                      {"ipAddress": "10.0.0.3"}]},
+                {"name": "projects/p/locations/z/nodes/other",
+                 "state": "READY", "labels": {}},
+            ]}
+        return {}
+
+    p = GcpTpuNodeProvider(
+        {"project_id": "proj", "zone": "us-central2-b",
+         "startup_script": "echo hi"}, transport=transport)
+    t = NodeTypeConfig("v5p-16", {"TPU": 4.0}, hosts=2)
+    sid = p.create_slice(t)
+    method, url, body = calls[0]
+    assert method == "POST" and "queuedResources" in url
+    spec = body["tpu"]["nodeSpec"][0]
+    assert spec["node"]["acceleratorType"] == "v5p-16"
+    assert spec["node"]["labels"]["rayt-node-type"] == "v5p-16"
+    assert spec["node"]["metadata"]["startup-script"] == "echo hi"
+    assert spec["nodeId"] == sid
+
+    slices = p.non_terminated_slices()
+    assert list(slices) == ["rayt-v5p-16-abc"]
+    assert slices["rayt-v5p-16-abc"]["node_type"] == "v5p-16"
+    assert len(slices["rayt-v5p-16-abc"]["node_ids"]) == 2
+
+    p.terminate_slice(sid)
+    assert calls[-1][0] == "DELETE" and sid in calls[-1][1]
+
+    with pytest.raises(ValueError):
+        p.create_slice(NodeTypeConfig("v5p-16", {}, hosts=1))  # host count

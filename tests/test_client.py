@@ -82,3 +82,35 @@ def test_client_tasks_objects_actors(proxy):
         assert ctx.get(one.remote()) == 1
     finally:
         ctx.disconnect()
+
+
+def test_client_nested_refs_and_timeout(proxy):
+    ctx = rt_client.connect(proxy)
+    try:
+        @ctx.remote
+        def total(xs):
+            # reference semantics: only TOP-LEVEL args auto-resolve;
+            # nested refs arrive as refs and the task gets them
+            import ray_tpu as rt
+
+            return sum(rt.get(xs["a"])) + rt.get(xs["b"][0])
+
+        a = ctx.put([1, 2, 3])
+        b = ctx.put(10)
+        assert ctx.get(total.remote({"a": a, "b": (b,)})) == 16
+
+        @ctx.remote
+        def slow():
+            import time
+
+            time.sleep(30)
+
+        import pytest as _pytest
+        import time as _time
+
+        t0 = _time.monotonic()
+        with _pytest.raises(TimeoutError):
+            ctx.get(slow.remote(), timeout=1.0)
+        assert _time.monotonic() - t0 < 10  # honored promptly
+    finally:
+        ctx.disconnect()
