@@ -1,0 +1,5 @@
+"""ray_tpu.experimental — utility surface (ref analog:
+python/ray/experimental/: internal_kv.py, tqdm_ray.py)."""
+
+from ray_tpu.experimental import internal_kv, tqdm_rayt  # noqa: F401
+from ray_tpu.experimental.tqdm_rayt import tqdm  # noqa: F401

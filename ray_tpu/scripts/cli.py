@@ -242,6 +242,44 @@ def _dash_request(args, path, data=None):
     return body
 
 
+def _serve_connect(args):
+    import ray_tpu as rt
+
+    addr = _read_address(args)
+    rt.init(address=addr)
+    return rt
+
+
+def cmd_serve_deploy(args):
+    rt = _serve_connect(args)
+    from ray_tpu.serve.schema import deploy_config
+
+    handles = deploy_config(args.config_file)
+    print(json.dumps({"deployed": sorted(handles)}))
+
+
+def cmd_serve_status(args):
+    rt = _serve_connect(args)
+    from ray_tpu.serve import _controller
+
+    ctl = _controller(create=False)
+    apps = rt.get(ctl.list_applications.remote(), timeout=30)
+    out = {}
+    for app in apps:
+        out[app] = rt.get(ctl.get_deployments.remote(app), timeout=30)
+    print(json.dumps(out, indent=1))
+
+
+def cmd_serve_shutdown(args):
+    _serve_connect(args)
+    from ray_tpu import serve
+
+    # full teardown: apps deleted, proxies unregistered, detached
+    # controller killed (serve/__init__.py shutdown)
+    serve.shutdown()
+    print(json.dumps({"shutdown": True}))
+
+
 def cmd_client_server(args):
     from ray_tpu.client.server import main as client_main
 
@@ -330,6 +368,17 @@ def main(argv=None):
             if name == "logs":
                 jsp.add_argument("--follow", action="store_true")
         jsp.set_defaults(fn=fn)
+
+    svp = sub.add_parser("serve", help="deploy/inspect serve apps")
+    svsub = svp.add_subparsers(dest="serve_command", required=True)
+    for name, fn in (("deploy", cmd_serve_deploy),
+                     ("status", cmd_serve_status),
+                     ("shutdown", cmd_serve_shutdown)):
+        ssp = svsub.add_parser(name)
+        ssp.add_argument("--address", help="GCS host:port")
+        if name == "deploy":
+            ssp.add_argument("config_file")
+        ssp.set_defaults(fn=fn)
 
     sp = sub.add_parser("client-server",
                         help="remote-driver proxy (ray-client analog)")

@@ -8,5 +8,7 @@ without a checkpoint. Step ids are content-derived (name + upstream
 ids), so an edited workflow invalidates exactly the downstream steps.
 """
 
-from ray_tpu.workflow.api import (StepNode, get_output, list_workflows,  # noqa: F401
-                                  resume, run, step)
+from ray_tpu.workflow.api import (Continuation, StepNode,  # noqa: F401
+                                  continuation, get_output, list_workflows,
+                                  resume, run, send_event, step,
+                                  wait_for_event)

@@ -7,6 +7,7 @@ Storage layout (one dir per workflow under the workflow root):
     meta.json                  {"status": ..., "output_step": id}
     steps/<step_id>.pkl        checkpointed step result
     steps/<step_id>.json       {"name", "upstream": [...]}
+    events/<name>.pkl          durable delivered-event payloads
 """
 
 from __future__ import annotations
@@ -70,6 +71,49 @@ class StepNode:
         return out
 
 
+class EventNode(StepNode):
+    """A step satisfied by an EXTERNAL event instead of a task (ref
+    analog: ray.workflow event system / wait_for_event): the workflow
+    parks until ``send_event(workflow_id, name, payload)`` lands; the
+    payload is checkpointed like any step result, so resume after a
+    crash replays it without waiting again."""
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None):
+        super().__init__(fn=None, args=(), kwargs={},
+                         name=f"event:{name}")
+        self.event_name = name
+        self.timeout_s = timeout_s
+
+
+def wait_for_event(name: str,
+                   timeout_s: Optional[float] = None) -> EventNode:
+    return EventNode(name, timeout_s)
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None, *,
+               storage: Optional[str] = None) -> None:
+    """Deliver an event to a (possibly running) workflow. Durable: the
+    payload is written into the workflow's storage, so it survives both
+    sender and workflow restarts."""
+    store = _Store(workflow_id, storage)
+    store.save_event(name, payload)
+
+
+class Continuation:
+    """Returned BY a step to hand control to a sub-workflow: the step's
+    durable result becomes the continuation DAG's result (ref analog:
+    ray.workflow.continuation — nested workflows)."""
+
+    def __init__(self, node: StepNode):
+        if not isinstance(node, StepNode):
+            raise TypeError("continuation() takes a bound step")
+        self.node = node
+
+
+def continuation(node: StepNode) -> Continuation:
+    return Continuation(node)
+
+
 def step(fn: Callable = None, **opts):
     """Decorator: `fn.bind(*args)` builds a StepNode DAG."""
     def wrap(f):
@@ -126,6 +170,25 @@ class _Store:
         os.replace(tmp, path)
         _write_json(os.path.join(self.steps_dir, step_id + ".json"), meta)
 
+    # ------------------------------------------------------------- events
+    def _event_path(self, name: str) -> str:
+        return os.path.join(self.dir, "events", name + ".pkl")
+
+    def save_event(self, name: str, payload: Any):
+        path = self._event_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        os.replace(tmp, path)
+
+    def has_event(self, name: str) -> bool:
+        return os.path.exists(self._event_path(name))
+
+    def load_event(self, name: str) -> Any:
+        with open(self._event_path(name), "rb") as f:
+            return pickle.load(f)
+
     def set_meta(self, **kv):
         self._ensure()
         path = os.path.join(self.dir, "meta.json")
@@ -176,12 +239,30 @@ def _execute(final: StepNode, store: _Store) -> Any:
     def resolve(a):
         return results[a.step_id()] if isinstance(a, StepNode) else a
 
+    event_started: dict[str, float] = {}
+
     def submit_ready():
         for sid, node in nodes.items():
             if sid in submitted:
                 continue
             if any(u.step_id() not in results for u in node.upstream()):
                 continue
+            if isinstance(node, EventNode):
+                event_started.setdefault(sid, time.monotonic())
+                if store.has_event(node.event_name):
+                    payload = store.load_event(node.event_name)
+                    store.save(sid, payload, {
+                        "name": node.name, "upstream": [],
+                        "finished_at": time.time()})
+                    results[sid] = payload
+                    submitted.add(sid)
+                elif node.timeout_s is not None and (
+                        time.monotonic() - event_started[sid]
+                        > node.timeout_s):
+                    raise TimeoutError(
+                        f"event {node.event_name!r} not delivered within "
+                        f"{node.timeout_s}s")
+                continue   # parked until the event lands
             args = [resolve(a) for a in node.args]
             kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
             task = rt.remote(num_cpus=node.num_cpus,
@@ -189,12 +270,22 @@ def _execute(final: StepNode, store: _Store) -> Any:
             inflight[task.remote(*args, **kwargs)] = sid
             submitted.add(sid)
 
-    def harvest(ref) -> Exception | None:
+    def harvest(ref, draining: bool = False) -> Exception | None:
         """Checkpoint one finished ref; return its error instead of raising
-        so a failing branch can't discard completed siblings' results."""
+        so a failing branch can't discard completed siblings' results. A
+        step returning a Continuation hands control to its sub-workflow:
+        the sub-DAG executes against the SAME store (its steps checkpoint
+        and resume individually) and its result becomes the step's.
+        While DRAINING after a failure, continuations are NOT started
+        (no new work after first_error) — the step stays un-checkpointed
+        and resume re-runs it."""
         sid = inflight.pop(ref)
         try:
             value = rt.get(ref)
+            if isinstance(value, Continuation):
+                if draining:
+                    return None
+                value = _execute(value.node, store)
             node = nodes[sid]
             store.save(sid, value, {
                 "name": node.name,
@@ -209,8 +300,18 @@ def _execute(final: StepNode, store: _Store) -> Any:
     submit_ready()  # nothing in flight yet: a submit error may propagate
     while final.step_id() not in results:
         if not inflight:
+            parked = [n for sid, n in nodes.items()
+                      if isinstance(n, EventNode) and sid not in results]
+            if parked:
+                time.sleep(0.1)      # waiting on external events
+                submit_ready()
+                continue
             raise RuntimeError("workflow has unrunnable steps (cycle?)")
-        done, _ = rt.wait(list(inflight), num_returns=1)
+        has_parked_events = any(
+            isinstance(n, EventNode) and sid not in results
+            for sid, n in nodes.items())
+        done, _ = rt.wait(list(inflight), num_returns=1,
+                          timeout=0.2 if has_parked_events else None)
         for ref in done:
             first_error = first_error or harvest(ref)
         if first_error is None:
@@ -227,7 +328,7 @@ def _execute(final: StepNode, store: _Store) -> Any:
                 if not done:
                     break
                 for ref in done:
-                    harvest(ref)
+                    harvest(ref, draining=True)
             raise first_error
     return results[final.step_id()]
 

@@ -105,3 +105,30 @@ def test_joblib_backend(local_cluster):
         out = joblib.Parallel()(
             joblib.delayed(_mp_square)(i) for i in range(6))
     assert out == [i * i for i in range(6)]
+
+
+def test_experimental_internal_kv_and_tqdm(local_cluster):
+    import ray_tpu as rt
+    from ray_tpu.experimental import internal_kv as kv
+    from ray_tpu.experimental import tqdm
+
+    assert kv._internal_kv_initialized()
+    assert kv._internal_kv_put("k1", b"v1", overwrite=False)
+    assert not kv._internal_kv_put("k1", b"v2", overwrite=False)
+    assert kv._internal_kv_get("k1") == b"v1"
+    assert kv._internal_kv_exists(b"k1")
+    assert b"k1" in kv._internal_kv_list("k")
+    assert kv._internal_kv_del("k1")
+    assert not kv._internal_kv_exists("k1")
+
+    @rt.remote
+    def work():
+        from ray_tpu.experimental import tqdm as rtqdm
+
+        total = 0
+        for i in rtqdm(range(10), desc="unit work"):
+            total += i
+        return total
+
+    assert rt.get(work.remote(), timeout=60) == 45
+    assert sum(tqdm(range(4), desc="driver")) == 6

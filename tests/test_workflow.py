@@ -110,3 +110,58 @@ def test_workflow_independent_branches_run_concurrently(local_cluster,
     assert {a["tag"], b["tag"]} == {1, 2}
     overlap = min(a["end"], b["end"]) - max(a["start"], b["start"])
     assert overlap > 0, f"branch intervals did not overlap ({overlap:.2f}s)"
+
+
+def test_workflow_continuation_nested(local_cluster, tmp_path):
+    """A step can return workflow.continuation(sub_dag): the sub-workflow
+    runs under the same durable store and its result becomes the step's
+    (ref: ray.workflow continuation / nested workflows)."""
+    from ray_tpu.workflow import continuation
+
+    @workflow.step
+    def leaf(x):
+        return x + 1
+
+    @workflow.step
+    def outer(x):
+        return continuation(leaf.bind(x * 10))
+
+    out = workflow.run(outer.bind(3), workflow_id="wfnest",
+                       storage=str(tmp_path))
+    assert out == 31
+    # the nested step checkpointed individually under the same store
+    metas = list((tmp_path / "wfnest" / "steps").glob("leaf-*.pkl"))
+    assert metas
+
+
+def test_workflow_events(local_cluster, tmp_path):
+    """wait_for_event parks the workflow until send_event delivers a
+    durable payload; resume replays the recorded event."""
+    import threading
+    import time
+
+    @workflow.step
+    def combine(evt, base):
+        return f"{base}-{evt}"
+
+    final = combine.bind(workflow.wait_for_event("go"), "ready")
+
+    def deliver():
+        time.sleep(1.0)
+        workflow.send_event("wfevt", "go", "signal-7",
+                            storage=str(tmp_path))
+
+    t = threading.Thread(target=deliver)
+    t.start()
+    out = workflow.run(final, workflow_id="wfevt", storage=str(tmp_path))
+    t.join()
+    assert out == "ready-signal-7"
+    # resume replays the checkpointed event without waiting
+    assert workflow.resume("wfevt", final,
+                           storage=str(tmp_path)) == "ready-signal-7"
+
+    # timeout path
+    final2 = combine.bind(workflow.wait_for_event("never", timeout_s=0.5),
+                          "x")
+    with pytest.raises(Exception):
+        workflow.run(final2, workflow_id="wfevt2", storage=str(tmp_path))
