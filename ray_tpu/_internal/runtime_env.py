@@ -33,6 +33,35 @@ import zipfile
 
 SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 KV_NAMESPACE = "runtime_env"
+
+# -------------------------------------------------------------- plugin API
+# Ref analog: _private/runtime_env/plugin.py RuntimeEnvPlugin — custom
+# runtime_env keys handled by user-registered plugins. The plugin object
+# itself rides the packaged spec (cloudpickled), so workers need no
+# import-path coordination.
+_PLUGINS: dict[str, "RuntimeEnvPlugin"] = {}
+
+
+class RuntimeEnvPlugin:
+    """Handle one custom runtime_env key.
+
+    package(value, kv_put) runs on the DRIVER: validate + upload any
+    payloads to GCS KV, return the wire value shipped in task specs.
+    materialize(spec_value, kv_get) runs in the WORKER before the task:
+    apply the env (sys.path, os.environ, files, ...).
+    """
+
+    def package(self, value, kv_put):
+        return value
+
+    def materialize(self, spec_value, kv_get) -> None:
+        raise NotImplementedError
+
+
+def register_runtime_env_plugin(key: str, plugin: RuntimeEnvPlugin):
+    if key in SUPPORTED_KEYS:
+        raise ValueError(f"{key!r} is a built-in runtime_env key")
+    _PLUGINS[key] = plugin
 _CACHE_ROOT = "/tmp/rayt_runtime_env"
 _VENV_ROOT = os.path.join(_CACHE_ROOT, "venvs")
 # keep at most this many cached venvs (LRU by last-use mtime)
@@ -45,11 +74,11 @@ _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 def validate(renv: dict) -> None:
     if not isinstance(renv, dict):
         raise TypeError(f"runtime_env must be a dict, got {type(renv)}")
-    unsupported = set(renv) - SUPPORTED_KEYS
+    unsupported = set(renv) - SUPPORTED_KEYS - set(_PLUGINS)
     if unsupported:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unsupported)}; "
-            f"supported: {sorted(SUPPORTED_KEYS)}")
+            f"supported: {sorted(SUPPORTED_KEYS | set(_PLUGINS))}")
     env_vars = renv.get("env_vars")
     if env_vars is not None:
         if not isinstance(env_vars, dict) or not all(
@@ -139,6 +168,16 @@ def package(renv: dict, kv_put) -> dict:
             repr((pkgs, opts, sys.version_info[:2])).encode()
         ).hexdigest()[:16]
         spec["pip"] = {"packages": pkgs, "options": opts, "hash": tag}
+    plugin_entries = []
+    for key, plugin in _PLUGINS.items():
+        if key in renv:
+            import cloudpickle
+
+            packaged = plugin.package(renv[key], kv_put)
+            plugin_entries.append(
+                (key, cloudpickle.dumps(plugin), packaged))
+    if plugin_entries:
+        spec["_plugins"] = plugin_entries
     return spec
 
 
@@ -343,3 +382,7 @@ def materialize(spec: dict, kv_get) -> None:
         import importlib
 
         importlib.invalidate_caches()
+    for key, plugin_blob, packaged in spec.get("_plugins") or []:
+        import cloudpickle
+
+        cloudpickle.loads(plugin_blob).materialize(packaged, kv_get)

@@ -77,6 +77,7 @@ class Autoscaler:
         demand = self._unmet_demand()
         if demand:
             self._queue_for_demand(demand, self._last_slices)
+        self._ensure_min_slices(self._last_slices)
         await self._launch_queued()
         self._scale_down_idle()
         self.instance_manager.prune_terminal()
@@ -164,6 +165,23 @@ class Autoscaler:
             if live + in_flight >= t.max_slices:
                 continue
             im.create(t.name)
+
+    def _ensure_min_slices(self, live_slices: dict):
+        """Keep each type at its configured floor (min_slices), demand or
+        not — `rayt up` pre-warms capacity this way."""
+        im = self.instance_manager
+        for t in self.node_types.values():
+            if t.min_slices <= 0:
+                continue
+            live = sum(1 for e in live_slices.values()
+                       if e["node_type"] == t.name)
+            in_flight = sum(
+                1 for i in im.instances(InstanceStatus.QUEUED,
+                                        InstanceStatus.REQUESTED,
+                                        InstanceStatus.ALLOCATED)
+                if i.node_type == t.name)
+            for _ in range(t.min_slices - live - in_flight):
+                im.create(t.name)
 
     async def _launch_queued(self):
         """QUEUED -> REQUESTED. The instance stays REQUESTED until the
@@ -264,6 +282,13 @@ class Autoscaler:
             if not idle:
                 self._idle_since.pop(slice_id, None)
                 continue
+            ntype = entry.get("node_type")
+            t = self.node_types.get(ntype)
+            if t is not None and t.min_slices > 0:
+                live = sum(1 for e in self._last_slices.values()
+                           if e["node_type"] == ntype)
+                if live <= t.min_slices:
+                    continue   # at the floor: never scale below min
             first = self._idle_since.setdefault(slice_id, now)
             if now - first >= self.idle_timeout_s:
                 logger.info("scaling down idle slice %s", slice_id)

@@ -78,6 +78,11 @@ class GcpTpuNodeProvider(NodeProvider):
                                           "tpu-ubuntu2204-base")
         self.startup_script = config.get("startup_script", "")
         self.labels = dict(config.get("labels") or {})
+        # scope every list/terminate to THIS cluster's slices: without it
+        # `rayt down` would reap other clusters' rayt-labeled resources
+        self.cluster_name = config.get("cluster_name", "")
+        if self.cluster_name:
+            self.labels["rayt-cluster"] = self.cluster_name
         self.transport = transport
 
     # ------------------------------------------------------------- helpers
@@ -140,6 +145,9 @@ class GcpTpuNodeProvider(NodeProvider):
             ntype = labels.get("rayt-node-type")
             if ntype is None:
                 continue   # not ours
+            if self.cluster_name and \
+                    labels.get("rayt-cluster") != self.cluster_name:
+                continue   # another cluster's slice
             name = node["name"].rsplit("/", 1)[-1]
             # host node-ids register via the startup script; the GCS view
             # joins on the slice label, so the provider reports endpoints

@@ -27,6 +27,7 @@ class NodeTypeConfig:
     resources_per_host: dict
     hosts: int = 1                  # hosts per slice (slice granularity)
     max_slices: int = 10
+    min_slices: int = 0             # floor the autoscaler maintains
 
     def head_resource(self) -> str:
         return f"{self.name}-head"
@@ -47,6 +48,19 @@ class NodeProvider:
     def non_terminated_slices(self) -> dict[str, dict]:
         """slice_id -> {"node_type": name, "node_ids": [hex, ...]}"""
         raise NotImplementedError
+
+
+def make_provider(provider_cfg: dict, gcs_address: str) -> NodeProvider:
+    """Provider factory from a cluster-config dict (used by head_main's
+    autoscaler wiring and the `rayt up/down` launcher)."""
+    kind = (provider_cfg or {}).get("type", "local")
+    if kind in ("local", "fake"):
+        return FakeTpuSliceProvider(gcs_address)
+    if kind == "gcp":
+        from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+        return GcpTpuNodeProvider(provider_cfg)
+    raise ValueError(f"unknown provider type {kind!r}")
 
 
 class FakeTpuSliceProvider(NodeProvider):
@@ -78,6 +92,9 @@ class FakeTpuSliceProvider(NodeProvider):
                       "node_type": node_type.name, "autoscaled": "1"}
             env = child_env(pkg_root)
             env["RAYT_CONFIG_JSON"] = get_config().to_json()
+            # slices stay in the CREATOR's process group on purpose: a
+            # launched cluster's `rayt down` reaps them via killpg on the
+            # head (their parent)
             proc = subprocess.Popen(
                 fast_python_argv("ray_tpu.core.node_main")
                 + ["--gcs-address", self.gcs_address,

@@ -32,11 +32,12 @@ async def run(args):
         dashboard_port = await dashboard.start(port=args.dashboard_port)
     autoscaler = None
     if args.autoscaler_config:
-        from ray_tpu.autoscaler import (Autoscaler, FakeTpuSliceProvider,
-                                        NodeTypeConfig)
+        from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+        from ray_tpu.autoscaler.node_provider import make_provider
 
         as_cfg = json.loads(args.autoscaler_config)
-        provider = FakeTpuSliceProvider(f"127.0.0.1:{gcs_port}")
+        provider = make_provider(as_cfg.get("provider"),
+                                 f"127.0.0.1:{gcs_port}")
         types = [NodeTypeConfig(**t) for t in as_cfg["node_types"]]
         gcs.autoscaler_active = True  # infeasible tasks wait for capacity
         autoscaler = Autoscaler(

@@ -369,6 +369,30 @@ def main(argv=None):
                 jsp.add_argument("--follow", action="store_true")
         jsp.set_defaults(fn=fn)
 
+    up = sub.add_parser("up", help="launch a cluster from a YAML config")
+    up.add_argument("config_file")
+    up.set_defaults(fn=lambda a: __import__(
+        "ray_tpu.scripts.launcher", fromlist=["up"]).up(a.config_file))
+
+    dn = sub.add_parser("down", help="tear a launched cluster down")
+    dn.add_argument("cluster_name", nargs="?", default="default")
+    dn.set_defaults(fn=lambda a: __import__(
+        "ray_tpu.scripts.launcher", fromlist=["down"]).down(a.cluster_name))
+
+    ex = sub.add_parser("exec", help="run a command against a cluster")
+    ex.add_argument("cluster_name")
+    ex.add_argument("command", nargs=argparse.REMAINDER)
+    ex.set_defaults(fn=lambda a: sys.exit(__import__(
+        "ray_tpu.scripts.launcher", fromlist=["exec_cmd"]).exec_cmd(
+            a.cluster_name,
+            a.command[1:] if a.command[:1] == ["--"] else a.command)))
+
+    at = sub.add_parser("attach", help="shell with RAYT_ADDRESS exported")
+    at.add_argument("cluster_name", nargs="?", default="default")
+    at.set_defaults(fn=lambda a: sys.exit(__import__(
+        "ray_tpu.scripts.launcher", fromlist=["attach"]).attach(
+            a.cluster_name)))
+
     svp = sub.add_parser("serve", help="deploy/inspect serve apps")
     svsub = svp.add_subparsers(dest="serve_command", required=True)
     for name, fn in (("deploy", cmd_serve_deploy),

@@ -1,0 +1,183 @@
+"""Cluster launcher: `rayt up / down / attach / exec` over a cluster
+YAML (ref analogs: the reference's `ray up/down/attach/exec` CLI +
+autoscaler cluster YAML; provider shapes from autoscaler/gcp/tpu.yaml).
+
+Config:
+
+    cluster_name: demo
+    provider:
+      type: local | fake | gcp
+      # gcp: project_id / zone / runtime_version / startup_script
+    head:
+      resources: {CPU: 4}
+      dashboard_port: 0
+    node_types:
+      - name: v5litepod-4
+        resources_per_host: {TPU: 4}
+        hosts: 1
+        max_slices: 4
+        min_slices: 0          # pre-launched at `up`
+    autoscaler:
+      idle_timeout_s: 120
+
+`up` starts the head (with the autoscaler wired to the configured
+provider), pre-launches min_slices, and records state under
+~/.rayt/clusters/<name>.json; `down` terminates slices and stops the
+head; `exec`/`attach` run commands/shells against the recorded address.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Optional
+
+STATE_DIR = os.path.expanduser("~/.rayt/clusters")
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(STATE_DIR, f"{name}.json")
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    cfg.setdefault("cluster_name", "default")
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("node_types", [])
+    return cfg
+
+
+def _save_state(cfg: dict, state: dict):
+    os.makedirs(STATE_DIR, exist_ok=True)
+    with open(_state_path(cfg["cluster_name"]), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def load_state(name: str) -> dict:
+    with open(_state_path(name)) as f:
+        return json.load(f)
+
+
+def up(config_path: str) -> dict:
+    cfg = load_config(config_path)
+    name = cfg["cluster_name"]
+    if os.path.exists(_state_path(name)):
+        raise SystemExit(f"cluster {name!r} already up "
+                         f"(state: {_state_path(name)}); "
+                         f"`rayt down {name}` first")
+    head_cfg = cfg["head"]
+    autoscaler_cfg = {
+        "node_types": list(cfg["node_types"]),
+        **(cfg.get("autoscaler") or {}),
+    }
+    from ray_tpu._internal.spawn import child_env, fast_python_argv
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    args = [
+        "--resources", json.dumps(head_cfg.get("resources", {"CPU": 4.0})),
+        "--dashboard-port", str(head_cfg.get("dashboard_port", 0)),
+    ]
+    if cfg["node_types"]:
+        args += ["--autoscaler-config", json.dumps(autoscaler_cfg)]
+    # head stderr -> cluster log, NOT an inherited pipe: a caller
+    # capturing this CLI's output would otherwise block until the head
+    # daemon exits (same discipline as `rayt start`)
+    os.makedirs(STATE_DIR, exist_ok=True)
+    log = open(os.path.join(STATE_DIR, f"{name}.log"), "ab")
+    proc = subprocess.Popen(
+        fast_python_argv("ray_tpu.core.head_main") + args,
+        stdout=subprocess.PIPE, stderr=log, env=child_env(pkg_root),
+        text=True, start_new_session=True)
+    log.close()
+    line = proc.stdout.readline()
+    if not line:
+        raise SystemExit("head process failed to start")
+    info = json.loads(line)
+    address = f"127.0.0.1:{info['gcs_port']}"
+    state = {"cluster_name": name, "address": address,
+             "head_pid": proc.pid, "config_path": os.path.abspath(
+                 config_path),
+             "dashboard_port": info.get("dashboard_port"),
+             "provider": cfg["provider"], "started_at": time.time()}
+    _save_state(cfg, state)
+    # min_slices floors are maintained by the head's autoscaler (the
+    # slices are its children, so `down`'s process-group kill reaps them)
+    print(json.dumps({"cluster": name, "address": address,
+                      "dashboard_port": info.get("dashboard_port")}))
+    return state
+
+
+def make_provider(provider_cfg: dict, gcs_address: str):
+    kind = provider_cfg.get("type", "local")
+    if kind in ("local", "fake"):
+        from ray_tpu.autoscaler.node_provider import FakeTpuSliceProvider
+
+        return FakeTpuSliceProvider(gcs_address, log_dir=STATE_DIR)
+    if kind == "gcp":
+        from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+        return GcpTpuNodeProvider(provider_cfg)
+    raise SystemExit(f"unknown provider type {kind!r}")
+
+
+def down(name: str):
+    try:
+        state = load_state(name)
+    except OSError:
+        raise SystemExit(f"no cluster state for {name!r}")
+    # terminate autoscaled slices via a provider handle, then the head
+    try:
+        provider = make_provider(state["provider"], state["address"])
+        for sid in list(provider.non_terminated_slices()):
+            provider.terminate_slice(sid)
+    except Exception:
+        pass
+    try:
+        os.killpg(os.getpgid(state["head_pid"]), 15)
+    except Exception:
+        try:
+            os.kill(state["head_pid"], 15)
+        except Exception:
+            pass
+    os.remove(_state_path(name))
+    print(json.dumps({"cluster": name, "down": True}))
+
+
+def exec_cmd(name: str, command: list[str]) -> int:
+    state = load_state(name)
+    env = dict(os.environ)
+    env["RAYT_ADDRESS"] = state["address"]
+    return subprocess.call(command, env=env)
+
+
+def attach(name: str) -> int:
+    state = load_state(name)
+    env = dict(os.environ)
+    env["RAYT_ADDRESS"] = state["address"]
+    shell = os.environ.get("SHELL", "/bin/bash")
+    print(f"# attached to {name} at {state['address']} "
+          f"(RAYT_ADDRESS exported)", file=sys.stderr)
+    return subprocess.call([shell], env=env)
+
+
+def list_clusters() -> list[dict]:
+    out = []
+    try:
+        names = os.listdir(STATE_DIR)
+    except OSError:
+        return out
+    for fn in sorted(names):
+        if fn.endswith(".json"):
+            try:
+                out.append(load_state(fn[:-5]))
+            except Exception:
+                pass
+    return out

@@ -146,3 +146,32 @@ def test_pip_env_installs_wheel_visible_only_in_task(local_cluster,
     t0 = _t.monotonic()
     assert rt.get(use_pkg.remote(), timeout=60) == "1.0"
     assert _t.monotonic() - t0 < 30.0
+
+
+def test_runtime_env_plugin_api(local_cluster):
+    """Custom runtime_env keys via the plugin API (ref:
+    _private/runtime_env/plugin.py): driver-side package() ships payloads,
+    worker-side materialize() applies them before the task runs."""
+    import os
+
+    import ray_tpu as rt
+    from ray_tpu._internal.runtime_env import (RuntimeEnvPlugin,
+                                               register_runtime_env_plugin)
+
+    class StampPlugin(RuntimeEnvPlugin):
+        def package(self, value, kv_put):
+            kv_put("stamp_payload", f"packaged:{value}".encode())
+            return "stamp_payload"
+
+        def materialize(self, spec_value, kv_get):
+            os.environ["STAMPED"] = kv_get(spec_value).decode()
+
+    register_runtime_env_plugin("stamp", StampPlugin())
+
+    @rt.remote(runtime_env={"stamp": "xyz"})
+    def read():
+        import os
+
+        return os.environ.get("STAMPED")
+
+    assert rt.get(read.remote(), timeout=90) == "packaged:xyz"
