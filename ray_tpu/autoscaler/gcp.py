@@ -136,9 +136,25 @@ class GcpTpuNodeProvider(NodeProvider):
                        "?force=true")
 
     def non_terminated_slices(self) -> dict[str, dict]:
+        # Paginate to exhaustion: a one-page read would silently drop
+        # slices beyond page 1, making _observe_provider mark their live
+        # instances FAILED and double-launch capacity. A transport error
+        # mid-listing propagates, aborting the whole reconcile tick —
+        # a partial listing is never observed.
         out: dict[str, dict] = {}
-        resp = self.transport("GET", f"{self._parent()}/nodes")
-        for node in resp.get("nodes", []):
+        nodes: list[dict] = []
+        page_token = None
+        while True:
+            url = f"{self._parent()}/nodes"
+            if page_token:
+                from urllib.parse import quote
+                url += f"?pageToken={quote(page_token, safe='')}"
+            resp = self.transport("GET", url)
+            nodes.extend(resp.get("nodes", []))
+            page_token = resp.get("nextPageToken")
+            if not page_token:
+                break
+        for node in nodes:
             if node.get("state") not in ("READY", "CREATING"):
                 continue
             labels = node.get("labels", {})

@@ -34,7 +34,11 @@ from ray_tpu._internal.rpc import (Connection, ConnectionLost, RemoteError,
                                    connect)
 from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
 from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
-                                 GetTimeoutError, ObjectLostError, ObjectMeta,
+                                 GetTimeoutError,
+                                 NodeAffinitySchedulingStrategy,
+                                 NodeLabelSchedulingStrategy,
+                                 ObjectLostError, ObjectMeta,
+                                 PlacementGroupSchedulingStrategy,
                                  TaskError, TaskSpec, WorkerCrashedError,
                                  WorkerInfo)
 from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
@@ -132,6 +136,13 @@ class CoreWorker:
         self._actor_async_loop: EventLoopThread | None = None
         self._actor_seq_state: dict[str, dict] = {}
         self._shutdown = False
+        # every fire-and-forget coroutine goes through _spawn (on-loop) or
+        # _spawn_from_thread (foreign threads) so shutdown can
+        # cancel-and-await them: an abandoned pending task at loop
+        # teardown prints "Task was destroyed but it is pending!" and can
+        # mask a real hang. _closing gates late spawns during the sweep.
+        self._bg_tasks: set[asyncio.Task] = set()
+        self._closing = False
         self.gcs: GcsClient | None = None
         self.node_conn: Connection | None = None
         self.worker_info: WorkerInfo | None = None
@@ -141,6 +152,33 @@ class CoreWorker:
 
         self.task_events = TaskEventBuffer(self.worker_id.hex(),
                                            self.node_id.hex())
+
+    def _spawn(self, coro) -> "asyncio.Task | None":
+        """ensure_future + lifetime tracking (must run on the IO loop).
+        During the shutdown sweep new background work is dropped — a task
+        scheduled after the cancel-and-await would be destroyed pending."""
+        if self._closing:
+            coro.close()
+            return None
+        t = asyncio.ensure_future(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
+
+    def _spawn_from_thread(self, coro) -> None:
+        """Thread-safe fire-and-forget onto the IO loop, shutdown-tracked
+        (the raw io.spawn future is untracked — fine only when the caller
+        awaits it)."""
+        if self._closing:
+            # io.stop() halts the loop without closing it, so a
+            # post-shutdown call_soon_threadsafe would "succeed" and the
+            # callback never run, leaking a never-awaited coroutine
+            coro.close()
+            return
+        try:
+            self.io.loop.call_soon_threadsafe(self._spawn, coro)
+        except RuntimeError:  # loop already closed
+            coro.close()
 
     # ------------------------------------------------------------ bootstrap
     def connect_cluster(self):
@@ -179,10 +217,10 @@ class CoreWorker:
         def on_actor_event(info):
             sub = self._actor_submitters.get(info.actor_id)
             if sub is not None:
-                asyncio.ensure_future(sub.on_actor_update(info))
+                self._spawn(sub.on_actor_update(info))
 
         await self.gcs.subscribe(CH_ACTOR, on_actor_event)
-        asyncio.ensure_future(self._task_event_flush_loop())
+        self._spawn(self._task_event_flush_loop())
         if self.mode == "worker":
             await self.node_conn.call(
                 "register_worker", (self.worker_info, os.getpid()))
@@ -200,6 +238,17 @@ class CoreWorker:
         self.io.stop()
 
     async def _async_shutdown(self):
+        # stop background work BEFORE tearing down connections: a lease
+        # expiry or flush tick racing the close would error, and any task
+        # still pending when the loop stops prints "Task was destroyed".
+        # _closing first, so a cancelled task's cleanup can't re-spawn.
+        self._closing = True
+        for t in list(self._bg_tasks):
+            t.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*list(self._bg_tasks),
+                                 return_exceptions=True)
+        self._bg_tasks.clear()
         for pool in self._lease_cache.values():
             for winfo, token, nm_addr, _ in pool.idle:
                 await self._release_lease(winfo, token, nm_addr,
@@ -255,7 +304,7 @@ class CoreWorker:
             except Exception:
                 pass
         try:
-            self.io.spawn(_free())
+            self._spawn_from_thread(_free())
         except Exception:
             pass
 
@@ -294,7 +343,7 @@ class CoreWorker:
                     except Exception:
                         pass
                 try:
-                    self.io.spawn(_free_dev())
+                    self._spawn_from_thread(_free_dev())
                 except Exception:
                     pass
 
@@ -309,7 +358,7 @@ class CoreWorker:
             except Exception:
                 pass
         try:
-            self.io.spawn(_send())
+            self._spawn_from_thread(_send())
         except Exception:
             pass
 
@@ -417,7 +466,7 @@ class CoreWorker:
                 finally:
                     self._release_create_ref(oid)
 
-            self.io.spawn(_announce())
+            self._spawn_from_thread(_announce())
         else:
             self.memory_store.put(oid, value, is_exception)
             self.object_meta[oid] = ObjectMeta(
@@ -655,7 +704,7 @@ class CoreWorker:
             self.reference_counter.add_task_pin(aid)
         logger.warning("reconstructing %s by re-executing task %s",
                        oid, pt.spec.name)
-        asyncio.ensure_future(self._run_normal_task(pt.spec))
+        self._spawn(self._run_normal_task(pt.spec))
         return True
 
     def _poll_budget(self, deadline: float | None) -> float:
@@ -825,6 +874,8 @@ class CoreWorker:
             finally:
                 for t in waiters:
                     t.cancel()
+                if waiters:
+                    await asyncio.gather(*waiters, return_exceptions=True)
             ready = [r for r in refs if r.id in ready_ids]
             not_ready = [r for r in refs if r.id not in ready_ids]
             return ready, not_ready
@@ -861,7 +912,7 @@ class CoreWorker:
             runtime_env=self._package_runtime_env(options.runtime_env),
             tensor_transport=options.tensor_transport)
         refs = self._register_task(spec, pinned + pinned_kw)
-        self.io.spawn(self._run_normal_task(spec))
+        self._spawn_from_thread(self._run_normal_task(spec))
         if spec.num_returns == -1:
             from ray_tpu.core.streaming import ObjectRefGenerator
 
@@ -934,7 +985,6 @@ class CoreWorker:
         return restore
 
     def _demand_for(self, options) -> dict[str, float]:
-        from ray_tpu.core.common import PlacementGroupSchedulingStrategy
         demand = options.resources.to_demand()
         strat = options.scheduling_strategy
         if isinstance(strat, PlacementGroupSchedulingStrategy):
@@ -990,9 +1040,6 @@ class CoreWorker:
         # the scheduling class includes the strategy (ref: SchedulingClass
         # keyed by resource shape + strategy) so an affinity/SPREAD lease
         # is never handed to a task with different placement constraints
-        from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
-                                         NodeLabelSchedulingStrategy)
-
         if strategy is None:
             skey = None
         elif isinstance(strategy, NodeAffinitySchedulingStrategy):
@@ -1028,7 +1075,7 @@ class CoreWorker:
         pool.waiters.append(fut)
         if pool.inflight < len(pool.waiters):
             pool.inflight += 1
-            asyncio.ensure_future(
+            self._spawn(
                 self._fetch_lease(key, demand, pool, strategy))
         entry = await fut
         return entry[0], entry[1], entry[2]
@@ -1039,7 +1086,10 @@ class CoreWorker:
         to whichever waiter is first in line."""
         try:
             entry = await self._request_cluster_lease(demand, strategy)
-        except Exception as e:
+        except BaseException as e:
+            # BaseException: a shutdown-sweep CancelledError must run the
+            # same bookkeeping, else pool.inflight stays inflated and a
+            # waiter future hangs forever (its task destroyed pending).
             pool.inflight -= 1
             # fetches and waiters are ~1:1 (one spawned per new waiter),
             # so a failed fetch fails exactly ONE waiter — the same blast
@@ -1048,8 +1098,17 @@ class CoreWorker:
             while pool.waiters:
                 fut = pool.waiters.pop(0)
                 if not fut.done():
-                    fut.set_exception(e)
+                    if isinstance(e, asyncio.CancelledError):
+                        fut.set_exception(
+                            WorkerCrashedError("shutting down"))
+                        # the waiter task is likely cancelled too; mark
+                        # the exception retrieved so GC doesn't warn
+                        fut.exception()
+                    else:
+                        fut.set_exception(e)
                     break
+            if isinstance(e, asyncio.CancelledError):
+                raise
             return
         pool.inflight -= 1
         self._offer_lease(key, pool, entry, recycled=False)
@@ -1067,7 +1126,7 @@ class CoreWorker:
                 return
         idle_s = get_config().lease_reuse_idle_s
         if not recycled or idle_s <= 0 or self._shutdown:
-            asyncio.ensure_future(self._release_lease(
+            self._spawn(self._release_lease(
                 entry[0], entry[1], entry[2], reusable=False))
             return
         # identity sentinel: the same lease can be recycled repeatedly, so
@@ -1084,7 +1143,7 @@ class CoreWorker:
                     await self._release_lease(
                         entry[0], entry[1], entry[2], reusable=False)
                     return
-        asyncio.ensure_future(_expire())
+        self._spawn(_expire())
 
     async def _request_cluster_lease(self, demand: dict[str, float],
                                      strategy=None):
@@ -1159,8 +1218,6 @@ class CoreWorker:
                           (winfo, token, nm_addr), recycled=True)
 
     async def _run_normal_task(self, spec: TaskSpec):
-        from ray_tpu.core.common import PlacementGroupSchedulingStrategy
-
         pt = self.pending_tasks[spec.task_id]
         # PG strategies were already rewritten into bundle-reserved demand
         strat = spec.scheduling_strategy
@@ -1193,7 +1250,7 @@ class CoreWorker:
                 # whole wave onto the first-granted node; releasing makes
                 # every task take the round-robin path at the node manager
                 # (fire-and-forget: no reply-latency cost per task)
-                asyncio.ensure_future(self._release_lease(
+                self._spawn(self._release_lease(
                     winfo, token, nm_addr, reusable=False))
             else:
                 self._recycle_lease(spec.resources, winfo, token, nm_addr,
@@ -1314,7 +1371,7 @@ class CoreWorker:
             tensor_transport=options.tensor_transport)
         refs = self._register_task(spec, pinned + pinned_kw)
         sub = self.get_actor_submitter(actor_id)
-        self.io.spawn(sub.submit(spec))
+        self._spawn_from_thread(sub.submit(spec))
         if spec.num_returns == -1:
             from ray_tpu.core.streaming import ObjectRefGenerator
 
@@ -1702,7 +1759,7 @@ class _ActorTaskSubmitter:
     async def _ensure_resolved(self):
         if not self._resolve_started:
             self._resolve_started = True
-            asyncio.ensure_future(self._resolve_loop())
+            self.cw._spawn(self._resolve_loop())
         await self._resolved.wait()
 
     async def _resolve_loop(self):
@@ -1754,7 +1811,7 @@ class _ActorTaskSubmitter:
         elif info.state == ActorState.RESTARTING:
             self.address = None
             self._resolved.clear()
-            asyncio.ensure_future(self._resolve_loop())
+            self.cw._spawn(self._resolve_loop())
 
     async def submit(self, spec: TaskSpec):
         attempts = spec.max_retries + 1
@@ -1782,7 +1839,7 @@ class _ActorTaskSubmitter:
                 self._avoid_address = address
                 self.address = None
                 self._resolved.clear()
-                asyncio.ensure_future(self._resolve_loop())
+                self.cw._spawn(self._resolve_loop())
                 if attempts > 0:
                     continue
                 self.cw._fail_task(spec, ActorDiedError(

@@ -11,9 +11,19 @@ churn per call.
 memory (multiprocessing.shared_memory). Cross-process visibility relies
 on the SPSC discipline: the producer writes the payload bytes first and
 publishes by bumping ``write_seq`` last; the consumer reads ``write_seq``
-before the payload and releases the slot by bumping ``read_seq`` last
-(x86/ARM64 total-store-order through the kernel-shared mapping is enough
-for this protocol at Python speeds; each seq has one writer).
+before the payload and releases the slot by bumping ``read_seq`` last.
+
+Memory-ordering caveat: the publish relies on total store order, which
+x86-64 guarantees for plain stores. ARM64 is weakly ordered — there is
+no fence between the payload memcpy and the seq store — so on ARM hosts
+a consumer could in principle observe the bumped seq before the payload
+bytes land. In CPython each store is preceded and followed by
+interpreter bookkeeping (refcount writes, bytecode dispatch) that spans
+many nanoseconds, and each seq has exactly one writer, so the window is
+practically unobservable at Python speeds; the protocol is nevertheless
+only *specified* for x86-64. Deployments on ARM hosts should route the
+seq bump through an atomic release store in the _native lib (the shm
+arena there already does this for its allocation headers).
 
 Capacity gives pipelining: a ring of N slots lets N ticks be in flight
 between two stages before the producer blocks (GPipe-style microbatch
@@ -24,6 +34,8 @@ from __future__ import annotations
 
 import pickle
 import struct
+import sys
+import threading
 import time
 from dataclasses import dataclass
 from multiprocessing import shared_memory
@@ -32,22 +44,30 @@ _HDR = struct.Struct("<QQQQB")  # write_seq, read_seq, slot_size, n_slots, close
 _LEN = struct.Struct("<Q")      # per-slot payload length prefix
 _HDR_SIZE = 64                  # one cache line; header never shares a slot
 
+# serializes the resource_tracker monkeypatch below: without it, two
+# threads opening channels concurrently can save the no-op lambda as
+# `orig` and restore it last, permanently disabling tracker registration
+# for every later SharedMemory user in the process
+_TRACKER_PATCH_LOCK = threading.Lock()
+
 
 def _open_untracked(**kwargs) -> shared_memory.SharedMemory:
     """Open a SharedMemory segment WITHOUT resource_tracker registration:
     the channel owner unlinks deterministically in close()/teardown(),
     and 3.12's unconditional registration would otherwise let an exiting
     attacher's tracker unlink a live ring (or double-unlink noise when
-    several attachers share one tracker). SharedMemory(track=False)
-    replaces this from 3.13."""
+    several attachers share one tracker)."""
+    if sys.version_info >= (3, 13):
+        return shared_memory.SharedMemory(track=False, **kwargs)
     from multiprocessing import resource_tracker
 
-    orig = resource_tracker.register
-    resource_tracker.register = lambda *a, **k: None
-    try:
-        return shared_memory.SharedMemory(**kwargs)
-    finally:
-        resource_tracker.register = orig
+    with _TRACKER_PATCH_LOCK:
+        orig = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None
+        try:
+            return shared_memory.SharedMemory(**kwargs)
+        finally:
+            resource_tracker.register = orig
 
 
 class ChannelClosed(Exception):

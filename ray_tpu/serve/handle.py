@@ -139,12 +139,14 @@ class DeploymentResponseGenerator:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__", stream: bool = False,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 retry_on_replica_death: bool = True):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
         self.multiplexed_model_id = multiplexed_model_id
+        self.retry_on_replica_death = retry_on_replica_death
         # model-id -> replica affinity (multiplex routing)
         self._model_affinity: dict = {}
         self._lock = threading.Lock()
@@ -162,18 +164,22 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self.method_name,
-                 self.stream, self.multiplexed_model_id))
+                 self.stream, self.multiplexed_model_id,
+                 self.retry_on_replica_death))
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None
+                multiplexed_model_id: Optional[str] = None,
+                retry_on_replica_death: Optional[bool] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self.method_name,
             self.stream if stream is None else stream,
             self.multiplexed_model_id if multiplexed_model_id is None
-            else multiplexed_model_id)
+            else multiplexed_model_id,
+            self.retry_on_replica_death if retry_on_replica_death is None
+            else retry_on_replica_death)
         h._model_affinity = self._model_affinity  # share affinity cache
         return h
 
@@ -270,6 +276,19 @@ class DeploymentHandle:
         return ref, done
 
     def remote(self, *args, **kwargs):
+        """Submit a request; returns a DeploymentResponse (or generator
+        for stream handles).
+
+        Delivery semantics (unary, non-stream handles): by default a
+        request whose replica dies is transparently resubmitted to a live
+        replica, i.e. AT-LEAST-ONCE — a replica can die after partially
+        or fully executing, so non-idempotent handlers may observe
+        duplicate execution. Opt out with
+        ``handle.options(retry_on_replica_death=False)`` to get
+        at-most-once (the ActorDiedError surfaces to the caller).
+        Stream handles are always at-most-once: a mid-stream replica
+        death surfaces as ActorDiedError (replaying a partially consumed
+        stream would re-deliver items)."""
         if self.stream:
             replica, done = self._route()
             ref_gen = replica.handle_request_streaming.options(
@@ -282,4 +301,6 @@ class DeploymentHandle:
             self._refresh(force=True)
             return self._submit_once(args, kwargs)
 
-        return DeploymentResponse(ref, done, resubmit)
+        return DeploymentResponse(
+            ref, done,
+            resubmit if self.retry_on_replica_death else None)
