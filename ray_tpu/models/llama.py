@@ -408,8 +408,19 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     vv = (h @ layer["wv"].astype(dt)).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     kk = apply_rope(kk, cos, sin, positions)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, kk, (0, cache_len, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, vv, (0, cache_len, 0, 0))
+    if jnp.ndim(cache_len) == 0:
+        # whole batch advances together (left-padded batched decode)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, kk, (0, cache_len, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, vv, (0, cache_len, 0, 0))
+    else:
+        # per-row write offsets (continuous-batching slots: each row is
+        # an independent request at its own depth — vLLM-style)
+        def _upd(c, new, off):
+            return jax.lax.dynamic_update_slice(c, new, (off, 0, 0))
+        k_cache = jax.vmap(_upd)(k_cache, kk, cache_len)
+        v_cache = jax.vmap(_upd)(v_cache, vv, cache_len)
     # mask: key slot j visible iff start <= j <= query slot
     max_len = k_cache.shape[1]
     q_pos = positions if abs_positions is None else abs_positions  # [b, s]
@@ -435,11 +446,19 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
                 cfg: LlamaConfig) -> tuple[jax.Array, dict]:
     """Append `tokens` [b, s] to the cache, return logits for the last
     position [b, vocab] and the updated cache. jit-able with static s
-    (s=1 for autoregressive decode; larger s = chunked prefill)."""
+    (s=1 for autoregressive decode; larger s = chunked prefill).
+
+    cache["length"] may be a scalar (whole batch in lock-step, the
+    left-padded batched path) or shape [b] (per-row depths: the
+    continuous-batching slot path, where each row is an independent
+    request and writes at its own cache offset)."""
     b, s = tokens.shape
     dt = cfg.dtype
     cache_len = cache["length"]
-    abs_positions = cache_len + jnp.arange(s)[None, :].repeat(b, 0)
+    if jnp.ndim(cache_len) == 0:
+        abs_positions = cache_len + jnp.arange(s)[None, :].repeat(b, 0)
+    else:
+        abs_positions = cache_len[:, None] + jnp.arange(s)[None, :]
     start = cache.get("start")
     if start is None:
         positions = abs_positions

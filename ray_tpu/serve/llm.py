@@ -2,9 +2,10 @@
 
 BASELINE config #5 (Llama TP Serve replicas): a replica pins a
 pjit-sharded Llama across the host's local mesh (tensor axis over chips,
-ICI collectives inserted by GSPMD), batches concurrent requests into one
-left-padded decode batch, and streams tokens through the existing
-streaming-return path (SSE at the proxy).
+ICI collectives inserted by GSPMD), decodes concurrent requests in a
+continuously-batched slot ring (finished slots refill between steps),
+and streams tokens through the existing streaming-return path (SSE at
+the proxy).
 
 Ref analogs: python/ray/serve/_private/replica.py:750 (user-callable
 host), router.py:321 (request path); the engine itself has no reference
@@ -16,7 +17,8 @@ prefill/decode with donated KV cache, greedy/temperature sampling in-jit.
 from __future__ import annotations
 
 import asyncio
-import time
+import threading
+
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -44,18 +46,30 @@ class _Request:
     loop: Optional[asyncio.AbstractEventLoop] = None
 
 
-class LLMEngine:
-    """Batched TP generation engine over the local device mesh.
+@dataclass
+class _Slot:
+    """One occupied decode slot: a request mid-generation."""
+    req: _Request
+    emitted: int = 0
+    length: int = 0  # host view of the row's cache depth
 
-    One engine per replica process. Requests queue; a background loop
-    groups up to `max_batch` of them (within `batch_window_s`), left-pads
-    prompts to a length bucket, prefills the batch in one jit call, then
-    decodes step-by-step, streaming each request's tokens as they land.
+
+class LLMEngine:
+    """Continuously-batched TP generation engine over the local device
+    mesh.
+
+    One engine per replica process. The decode batch is `max_batch`
+    fixed SLOTS over one persistent KV cache with per-row depths
+    (cache["length"] is [b]): a new request is prefilled alone (batch-1,
+    per-bucket trace), its KV rows inserted into a free slot, and it
+    joins the very next decode step — it never waits for the previous
+    batch to drain. Finished slots free immediately and refill from the
+    queue between steps. Static shapes throughout: one decode trace
+    ever, one prefill + insert trace per prompt bucket.
     """
 
     def __init__(self, preset: str = "debug", *, tp: int | None = None,
                  max_batch: int = 4, max_seq_len: int | None = None,
-                 batch_window_s: float = 0.02,
                  prompt_buckets: tuple[int, ...] = (32, 128, 512, 1024),
                  eos_token_id: int | None = None,
                  params: Any = None, seed: int = 0):
@@ -67,7 +81,6 @@ class LLMEngine:
             cfg = llama.config_for(preset, max_seq_len=max_seq_len)
         self.cfg = cfg
         self.max_batch = max_batch
-        self.batch_window_s = batch_window_s
         self.prompt_buckets = tuple(
             b for b in prompt_buckets if b < cfg.max_seq_len) or (
                 cfg.max_seq_len // 2,)
@@ -95,21 +108,57 @@ class LLMEngine:
         # one jit; prefill (s=bucket) and decode (s=1) are separate traces
         # of the same function, cached per shape
         self._step = jax.jit(step, donate_argnums=(1,))
+
+        def insert_row(cache, row_k, row_v, slot, length, start):
+            """Graft a freshly prefilled request's KV rows into `slot` of
+            the persistent cache and reset that row's depth/start."""
+            return {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], row_k, (0, slot, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], row_v, (0, slot, 0, 0, 0)),
+                "length": cache["length"].at[slot].set(length),
+                "start": cache["start"].at[slot].set(start),
+            }
+
+        self._insert_row = jax.jit(insert_row, donate_argnums=(0,))
         self._queue: asyncio.Queue[_Request] = None  # type: ignore
         self._task = None
         self._loop = None
+        # decode-slot state. Mutations happen on executor threads, one at
+        # a time under _mutex; _epoch fences out a stale step still
+        # running on the process-global executor after a loop rebind
+        # (replica restart) so it can't touch the new engine state.
+        self._mutex = threading.Lock()
+        self._epoch = 0
+        self._slots: list[Optional[_Slot]] = [None] * max_batch
+        self._decode_cache = None  # lazy: built on first request
+        self._cur = np.zeros((max_batch, 1), np.int32)
+        self._temps = np.zeros((max_batch, 1), np.float32)
+        self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         # perf counters (for the serve bench)
         self.generated_tokens = 0
-        self.batches = 0
+        self.batches = 0       # decode steps executed
+        self.prefills = 0
 
     # ------------------------------------------------------------ serving
     async def ensure_started(self):
         loop = asyncio.get_running_loop()
         if self._loop is not loop or self._task is None or self._task.done():
             # (re)bind to the current event loop — a queue/task from a
-            # previous loop (replica restart, repeated asyncio.run) is dead
+            # previous loop (replica restart, repeated asyncio.run) is
+            # dead, and so are any requests parked in old slots. Bumping
+            # the epoch under the mutex waits out any in-flight executor
+            # step and invalidates stragglers; the cache is rebuilt
+            # because the old one may have been donated by a stale step.
+            with self._mutex:
+                self._epoch += 1
+                self._slots = [None] * self.max_batch
+                self._decode_cache = None
+                self._cur = np.zeros((self.max_batch, 1), np.int32)
+                self._temps = np.zeros((self.max_batch, 1), np.float32)
             self._queue = asyncio.Queue()
-            self._task = asyncio.ensure_future(self._batch_loop())
+            self._task = asyncio.ensure_future(self._engine_loop())
             self._loop = loop
 
     async def generate(self, tokens: list[int], *,
@@ -136,84 +185,160 @@ class LLMEngine:
                 raise item
             yield item
 
-    async def _batch_loop(self):
-        while True:
-            first = await self._queue.get()
-            batch = [first]
-            deadline = time.monotonic() + self.batch_window_s
-            while len(batch) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(
-                        self._queue.get(), remaining))
-                except asyncio.TimeoutError:
-                    break
-            loop = asyncio.get_running_loop()
+    async def _engine_loop(self):
+        """Continuous-batching scheduler: admit into free slots between
+        decode steps; a late-arriving request starts decoding one step
+        after its prefill, regardless of how deep the other slots are."""
+        loop = asyncio.get_running_loop()
+        epoch = self._epoch
+
+        async def _admit(req: _Request):
             try:
-                await loop.run_in_executor(None, self._run_batch, batch)
-            except Exception as e:  # engine-level failure: fail the batch
-                for r in batch:
-                    r.loop.call_soon_threadsafe(r.out.put_nowait, e)
+                await loop.run_in_executor(None, self._admit, req, epoch)
+            except Exception as e:
+                req.loop.call_soon_threadsafe(req.out.put_nowait, e)
 
-    # ------------------------------------------------------- the hot loop
-    def _run_batch(self, batch: list[_Request]):
+        while True:
+            if not any(s is not None for s in self._slots):
+                # idle: block until work arrives (no spinning)
+                await _admit(await self._queue.get())
+            # opportunistic refill of every free slot, no waiting
+            while (not self._queue.empty()
+                   and any(s is None for s in self._slots)):
+                await _admit(self._queue.get_nowait())
+            if any(s is not None for s in self._slots):
+                try:
+                    await loop.run_in_executor(
+                        None, self._decode_step_all, epoch)
+                except Exception:
+                    # _poison_recover already failed the active requests
+                    # and reset the (donated, now-dead) cache; an epoch
+                    # mismatch means a newer loop owns the engine — stop
+                    if epoch != self._epoch:
+                        return
+
+    # ------------------------------------------------------- the hot path
+    def _ensure_decode_cache(self):
+        if self._decode_cache is None:
+            cache = llama.init_kv_cache(self.cfg, self.max_batch,
+                                        max_len=self.cfg.max_seq_len)
+            # per-row depths: each slot is an independent request
+            cache["length"] = jnp.zeros((self.max_batch,), jnp.int32)
+            self._decode_cache = jax.device_put(cache, self._cache_sharding)
+
+    def _finish(self, i: int):
+        s = self._slots[i]
+        s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, None)
+        self._slots[i] = None
+        self._temps[i, 0] = 0.0
+
+    def _admit(self, req: _Request, epoch: int):
+        """Prefill one request (batch-1, per-bucket trace) and graft its
+        KV rows into a free slot of the persistent decode cache."""
+        with self._mutex:
+            if epoch != self._epoch:
+                raise RuntimeError("engine restarted during admission")
+            self._admit_locked(req)
+
+    def _admit_locked(self, req: _Request):
         cfg = self.cfg
-        bsz = self.max_batch  # fixed slots: one decode-jit trace ever
-        longest = max(len(r.tokens) for r in batch)
-        bucket = _bucket(longest, self.prompt_buckets)
-        prompts = np.zeros((bsz, bucket), np.int32)
-        start = np.full((bsz,), bucket, np.int32)  # empty slots: all-pad
-        temps = np.zeros((bsz, 1), np.float32)
-        for i, r in enumerate(batch):
-            toks = r.tokens[-bucket:]
-            prompts[i, bucket - len(toks):] = toks
-            start[i] = bucket - len(toks)
-            temps[i, 0] = r.temperature
-        max_new = max(r.max_new_tokens for r in batch)
-        budget = min(max_new, cfg.max_seq_len - bucket)
+        try:
+            self._ensure_decode_cache()
+        except Exception:
+            self._decode_cache = None
+            raise
+        slot = next(i for i, s in enumerate(self._slots) if s is None)
+        toks = req.tokens  # generate() enforces len <= max bucket
+        bucket = _bucket(len(toks), self.prompt_buckets)
+        prompts = np.zeros((1, bucket), np.int32)
+        prompts[0, bucket - len(toks):] = toks
 
-        cache = llama.init_kv_cache(cfg, bsz, max_len=cfg.max_seq_len)
-        cache["start"] = jnp.asarray(start)
-        cache = jax.device_put(cache, self._cache_sharding)
-        key = jax.random.PRNGKey(int(time.time_ns()) % (1 << 31))
-        temps_j = jnp.asarray(temps)
+        small = llama.init_kv_cache(cfg, 1, max_len=bucket)
+        small["start"] = jnp.asarray([bucket - len(toks)], jnp.int32)
+        small = jax.device_put(small, self._cache_sharding)
+        temps1 = jnp.asarray([[req.temperature]], np.float32)
+        nxt, small, self._key = self._step(
+            self.params, small, jnp.asarray(prompts), self._key, temps1)
+        first = int(np.asarray(nxt)[0])
+        self.prefills += 1
 
-        nxt, cache, key = self._step(
-            self.params, cache, jnp.asarray(prompts), key, temps_j)
-        done = [False] * bsz
-        emitted = [0] * bsz
-        for i in range(len(batch), bsz):
-            done[i] = True
-        for step_i in range(budget):
-            toks = np.asarray(nxt)  # host sync: the step's sampled tokens
-            for i, r in enumerate(batch):
-                if done[i]:
-                    continue
-                t = int(toks[i])
-                if self.eos_token_id is not None and t == self.eos_token_id:
-                    done[i] = True
-                    r.loop.call_soon_threadsafe(r.out.put_nowait, None)
-                    continue
-                emitted[i] += 1
-                self.generated_tokens += 1
-                r.loop.call_soon_threadsafe(r.out.put_nowait, t)
-                if emitted[i] >= r.max_new_tokens:
-                    done[i] = True
-                    r.loop.call_soon_threadsafe(r.out.put_nowait, None)
-            if all(done):
-                break
-            nxt, cache, key = self._step(
-                self.params, cache, nxt[:, None], key, temps_j)
-        for i, r in enumerate(batch):
-            if not done[i]:
-                r.loop.call_soon_threadsafe(r.out.put_nowait, None)
+        # deliver the prefill's token before joining the decode batch
+        if self.eos_token_id is not None and first == self.eos_token_id:
+            req.loop.call_soon_threadsafe(req.out.put_nowait, None)
+            return
+        self.generated_tokens += 1
+        req.loop.call_soon_threadsafe(req.out.put_nowait, first)
+        if req.max_new_tokens <= 1:
+            req.loop.call_soon_threadsafe(req.out.put_nowait, None)
+            return
+
+        try:
+            self._decode_cache = self._insert_row(
+                self._decode_cache, small["k"], small["v"],
+                jnp.int32(slot), jnp.int32(bucket),
+                jnp.int32(bucket - len(toks)))
+        except BaseException:
+            # insert_row donates the shared cache: a failure here loses
+            # every active slot's KV, not just the new request's
+            self._poison_recover()
+            raise
+        self._slots[slot] = _Slot(req, emitted=1, length=bucket)
+        self._cur[slot, 0] = first
+        self._temps[slot, 0] = req.temperature
+
+    def _poison_recover(self):
+        """The shared decode cache was donated into a call that failed:
+        its buffers are gone. Fail every active request and reset so the
+        next admission rebuilds from scratch (callers hold _mutex)."""
+        err = RuntimeError("decode cache lost to a failed engine step")
+        for s in self._slots:
+            if s is not None:
+                s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, err)
+        self._slots = [None] * self.max_batch
+        self._decode_cache = None
+        self._cur = np.zeros((self.max_batch, 1), np.int32)
+        self._temps = np.zeros((self.max_batch, 1), np.float32)
+
+    def _decode_step_all(self, epoch: int):
+        with self._mutex:
+            if epoch != self._epoch:
+                raise RuntimeError("engine restarted during decode")
+            self._decode_step_locked()
+
+    def _decode_step_locked(self):
+        """One decode step across all slots (free rows compute masked
+        garbage — the price of a single static-shape trace)."""
+        try:
+            nxt, self._decode_cache, self._key = self._step(
+                self.params, self._decode_cache, jnp.asarray(self._cur),
+                self._key, jnp.asarray(self._temps))
+        except BaseException:
+            self._poison_recover()
+            raise
+        toks = np.asarray(nxt)  # host sync: this step's sampled tokens
+        self._cur = toks[:, None].astype(np.int32)
         self.batches += 1
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            t = int(toks[i])
+            s.length += 1
+            if self.eos_token_id is not None and t == self.eos_token_id:
+                self._finish(i)
+                continue
+            s.emitted += 1
+            self.generated_tokens += 1
+            s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, t)
+            if (s.emitted >= s.req.max_new_tokens
+                    or s.length >= self.cfg.max_seq_len - 1):
+                self._finish(i)
 
     def stats(self) -> dict:
         return {"generated_tokens": self.generated_tokens,
                 "batches": self.batches,
+                "prefills": self.prefills,
+                "active_slots": sum(1 for s in self._slots
+                                    if s is not None),
                 "tp": self.mesh.shape.get("tensor", 1)}
 
 
