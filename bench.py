@@ -92,9 +92,25 @@ def _run(on_tpu: bool) -> dict:
         attn_impl="flash" if on_tpu else "xla")
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    logical = llama.param_logical_axes(cfg)
+    trainable = None
+    lora_tag = ""
+    if os.environ.get("RAYT_BENCH_LORA", "0") == "1":
+        # BASELINE config #3's fine-tune variant: frozen base, adapter-only
+        # grads/optimizer (tools/lora_bench.py drives this leg)
+        from ray_tpu.models import lora as lora_mod
+
+        lcfg = lora_mod.LoraConfig(
+            rank=int(os.environ.get("RAYT_BENCH_LORA_RANK", "16")),
+            alpha=cfg.lora_alpha)
+        params = {**params, "lora": lora_mod.init_lora_params(
+            cfg, lcfg, jax.random.PRNGKey(2))}
+        logical = {**logical, "lora": lora_mod.lora_logical_axes(cfg, lcfg)}
+        trainable = ("lora",)
+        lora_tag = "lora_"
     step, state = build_train_step(
         lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(3e-4), params,
-        llama.param_logical_axes(cfg), mesh)
+        logical, mesh, trainable_keys=trainable)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                                 cfg.vocab_size)
     data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
@@ -114,11 +130,16 @@ def _run(on_tpu: bool) -> dict:
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * steps / dt
     flops_per_tok = cfg.flops_per_token()
+    if lora_tag:
+        # frozen-base backward skips dL/dW for base weights: ~2N of the
+        # 6N fwd+bwd FLOPs/token never execute, so counting 6N would
+        # overstate achieved FLOPs (and MFU) by ~1.5x
+        flops_per_tok *= 2 / 3
     achieved = tok_s * flops_per_tok
     peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
     mfu = achieved / peak
     return {
-        "metric": f"llama_{preset}_train_tokens_per_sec_per_chip",
+        "metric": f"llama_{preset}_{lora_tag}train_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4),
@@ -180,8 +201,13 @@ def _run_leg(on_tpu: bool, timeout_s: float) -> dict | None:
     return None
 
 
-_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "TPU_BENCH_CACHE.json")
+# cache is keyed by bench variant: a dead-tunnel replay must never hand
+# back a different variant's number as the headline metric
+_TPU_CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "TPU_BENCH_CACHE_LORA.json"
+    if os.environ.get("RAYT_BENCH_LORA", "0") == "1"
+    else "TPU_BENCH_CACHE.json")
 
 
 def main():

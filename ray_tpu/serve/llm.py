@@ -97,6 +97,8 @@ class LLMEngine:
             is_leaf=lambda x: isinstance(x, tuple))
 
         def step(params, cache, tokens, key, temperature):
+            if tokens.ndim == 1:  # decode path: device-resident [b]
+                tokens = tokens[:, None]
             logits, cache = llama.decode_step(params, cache, tokens, cfg)
             key, sub = jax.random.split(key)
             greedy = jnp.argmax(logits, axis=-1)
@@ -122,6 +124,11 @@ class LLMEngine:
             }
 
         self._insert_row = jax.jit(insert_row, donate_argnums=(0,))
+
+        def set_slot(cur, temps, slot, tok, temp):
+            return cur.at[slot].set(tok), temps.at[slot, 0].set(temp)
+
+        self._set_slot = jax.jit(set_slot, donate_argnums=(0, 1))
         self._queue: asyncio.Queue[_Request] = None  # type: ignore
         self._task = None
         self._loop = None
@@ -133,8 +140,10 @@ class LLMEngine:
         self._epoch = 0
         self._slots: list[Optional[_Slot]] = [None] * max_batch
         self._decode_cache = None  # lazy: built on first request
-        self._cur = np.zeros((max_batch, 1), np.int32)
-        self._temps = np.zeros((max_batch, 1), np.float32)
+        # device-resident between steps: re-uploading from host every
+        # decode step would cost two H2D transfers per token
+        self._cur = jnp.zeros((max_batch,), jnp.int32)
+        self._temps = jnp.zeros((max_batch, 1), jnp.float32)
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         # perf counters (for the serve bench)
         self.generated_tokens = 0
@@ -153,10 +162,17 @@ class LLMEngine:
             # because the old one may have been donated by a stale step.
             with self._mutex:
                 self._epoch += 1
+                # a restart must not strand live consumers: anything
+                # still parked in a slot gets an error, not silence
+                err = RuntimeError("engine restarted")
+                for s_ in self._slots:
+                    if s_ is not None:
+                        s_.req.loop.call_soon_threadsafe(
+                            s_.req.out.put_nowait, err)
                 self._slots = [None] * self.max_batch
                 self._decode_cache = None
-                self._cur = np.zeros((self.max_batch, 1), np.int32)
-                self._temps = np.zeros((self.max_batch, 1), np.float32)
+                self._cur = jnp.zeros((self.max_batch,), jnp.int32)
+                self._temps = jnp.zeros((self.max_batch, 1), jnp.float32)
             self._queue = asyncio.Queue()
             self._task = asyncio.ensure_future(self._engine_loop())
             self._loop = loop
@@ -191,6 +207,9 @@ class LLMEngine:
         after its prefill, regardless of how deep the other slots are."""
         loop = asyncio.get_running_loop()
         epoch = self._epoch
+        queue = self._queue  # bound once: after a rebind self._queue is
+        # the NEW loop's queue; a stale loop reading it would steal and
+        # fail the new loop's requests
 
         async def _admit(req: _Request):
             try:
@@ -198,14 +217,14 @@ class LLMEngine:
             except Exception as e:
                 req.loop.call_soon_threadsafe(req.out.put_nowait, e)
 
-        while True:
+        while epoch == self._epoch:
             if not any(s is not None for s in self._slots):
                 # idle: block until work arrives (no spinning)
-                await _admit(await self._queue.get())
+                await _admit(await queue.get())
             # opportunistic refill of every free slot, no waiting
-            while (not self._queue.empty()
+            while (not queue.empty()
                    and any(s is None for s in self._slots)):
-                await _admit(self._queue.get_nowait())
+                await _admit(queue.get_nowait())
             if any(s is not None for s in self._slots):
                 try:
                     await loop.run_in_executor(
@@ -229,8 +248,7 @@ class LLMEngine:
     def _finish(self, i: int):
         s = self._slots[i]
         s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, None)
-        self._slots[i] = None
-        self._temps[i, 0] = 0.0
+        self._slots[i] = None  # row's temp/token are garbage-masked
 
     def _admit(self, req: _Request, epoch: int):
         """Prefill one request (batch-1, per-bucket trace) and graft its
@@ -283,8 +301,9 @@ class LLMEngine:
             self._poison_recover()
             raise
         self._slots[slot] = _Slot(req, emitted=1, length=bucket)
-        self._cur[slot, 0] = first
-        self._temps[slot, 0] = req.temperature
+        self._cur, self._temps = self._set_slot(
+            self._cur, self._temps, jnp.int32(slot), jnp.int32(first),
+            jnp.float32(req.temperature))
 
     def _poison_recover(self):
         """The shared decode cache was donated into a call that failed:
@@ -296,8 +315,8 @@ class LLMEngine:
                 s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, err)
         self._slots = [None] * self.max_batch
         self._decode_cache = None
-        self._cur = np.zeros((self.max_batch, 1), np.int32)
-        self._temps = np.zeros((self.max_batch, 1), np.float32)
+        self._cur = jnp.zeros((self.max_batch,), jnp.int32)
+        self._temps = jnp.zeros((self.max_batch, 1), jnp.float32)
 
     def _decode_step_all(self, epoch: int):
         with self._mutex:
@@ -310,13 +329,13 @@ class LLMEngine:
         garbage — the price of a single static-shape trace)."""
         try:
             nxt, self._decode_cache, self._key = self._step(
-                self.params, self._decode_cache, jnp.asarray(self._cur),
-                self._key, jnp.asarray(self._temps))
+                self.params, self._decode_cache, self._cur,
+                self._key, self._temps)
         except BaseException:
             self._poison_recover()
             raise
         toks = np.asarray(nxt)  # host sync: this step's sampled tokens
-        self._cur = toks[:, None].astype(np.int32)
+        self._cur = nxt  # stays on device for the next step
         self.batches += 1
         for i, s in enumerate(self._slots):
             if s is None:

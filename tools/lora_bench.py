@@ -1,0 +1,65 @@
+"""LoRA fine-tune throughput leg (BASELINE config #3 fine-tune variant).
+
+Runs bench.py's measurement child with RAYT_BENCH_LORA=1 (frozen base,
+adapter-only grads + optimizer state) and writes LORA_BENCH.json. Same
+tunnel discipline as the headline bench: live on-chip measurement when
+the TPU is reachable, cached replay flagged "cached": true when it
+isn't, explicit "hardware_blocked" annotation when there's nothing to
+replay — never a silent CPU number.
+
+Ref analog: release/train_tests fine-tune benchmarks; LoRA itself is
+repo-native (`ray_tpu/models/lora.py`, `train/recipes.py`).
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_spec = importlib.util.spec_from_file_location(
+    "rayt_bench", os.path.join(ROOT, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+_CACHE = os.path.join(ROOT, "TPU_BENCH_CACHE_LORA.json")
+
+
+def main():
+    os.environ["RAYT_BENCH_LORA"] = "1"
+    result = None
+    if bench._tunnel_listening():
+        result = bench._run_leg(on_tpu=True, timeout_s=float(
+            os.environ.get("RAYT_BENCH_TPU_TIMEOUT_S", "900")))
+        if result is not None:
+            with open(_CACHE, "w") as f:
+                json.dump({**result, "measured_at": time.time()}, f)
+    else:
+        print("lora_bench: TPU tunnel down", file=sys.stderr)
+    if result is None and os.path.exists(_CACHE):
+        with open(_CACHE) as f:
+            cached = json.load(f)
+        age_h = (time.time() - cached.pop("measured_at", 0)) / 3600
+        result = {**cached, "cached": True,
+                  "cache_age_hours": round(age_h, 1)}
+    if result is None:
+        # nothing live, nothing cached: record the CPU-correctness leg
+        # with an explicit hardware-blocked annotation
+        cpu = bench._run_leg(on_tpu=False, timeout_s=900)
+        result = {**(cpu or {}), "hardware_blocked": True,
+                  "note": "TPU tunnel unreachable and no cached on-chip "
+                          "LoRA measurement exists; value is a CPU "
+                          "correctness run, not a chip rate"}
+    result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    print(json.dumps(result))
+    with open(os.path.join(ROOT, "LORA_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,10 @@ async def _run_bench(preset: str, concurrency: int, requests: int,
     await asyncio.gather(*background)
 
     import jax
+
+    def _ms(v, nd=2):
+        return None if v is None else round(v * 1e3, nd)
+
     return {
         "metric": "serve_llm_engine_throughput",
         "preset": preset,
@@ -112,11 +116,11 @@ async def _run_bench(preset: str, concurrency: int, requests: int,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
         "tokens_per_sec": round(tokens / elapsed, 1),
-        "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 2),
-        "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 2),
-        "itl_p50_ms": round(_pct(itls, 50) * 1e3, 3),
-        "itl_p99_ms": round(_pct(itls, 99) * 1e3, 3),
-        "late_join_ttft_ms": round(late_ttft * 1e3, 2),
+        "ttft_p50_ms": _ms(_pct(ttfts, 50)),
+        "ttft_p99_ms": _ms(_pct(ttfts, 99)),
+        "itl_p50_ms": _ms(_pct(itls, 50), 3),
+        "itl_p99_ms": _ms(_pct(itls, 99), 3),
+        "late_join_ttft_ms": _ms(late_ttft),
         "decode_steps": eng.batches,
         "prefills": eng.prefills,
     }
