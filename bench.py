@@ -210,6 +210,30 @@ _TPU_CACHE = os.path.join(
     else "TPU_BENCH_CACHE.json")
 
 
+def write_tpu_cache(result: dict, path: str = None) -> None:
+    """Persist a live on-chip measurement (shared by bench variants so
+    the cache/replay discipline never drifts between them)."""
+    try:
+        with open(path or _TPU_CACHE, "w") as f:
+            json.dump({**result, "measured_at": time.time()}, f)
+    except OSError:
+        pass
+
+
+def read_tpu_cache(path: str = None) -> dict | None:
+    """Replay the last live measurement, flagged cached + aged."""
+    p = path or _TPU_CACHE
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            cached = json.load(f)
+    except Exception:
+        return None
+    age_h = (time.time() - cached.pop("measured_at", 0)) / 3600
+    return {**cached, "cached": True, "cache_age_hours": round(age_h, 1)}
+
+
 def main():
     # Attempt the TPU leg unless JAX_PLATFORMS is explicitly pinned to a
     # TPU-less value: sitecustomize can register the TPU platform via
@@ -234,28 +258,16 @@ def main():
             # persist every live on-chip measurement so a later bench run
             # with a dead tunnel can report the last REAL number (clearly
             # labeled) instead of silently degrading to a CPU figure
-            try:
-                with open(_TPU_CACHE, "w") as f:
-                    json.dump({**result, "measured_at": time.time()}, f)
-            except OSError:
-                pass
+            write_tpu_cache(result)
         else:
             print("bench: TPU leg FAILED", file=sys.stderr)
-            if os.path.exists(_TPU_CACHE):
-                try:
-                    with open(_TPU_CACHE) as f:
-                        cached = json.load(f)
-                    age_h = (time.time()
-                             - cached.pop("measured_at", 0)) / 3600
-                    result = {**cached, "cached": True,
-                              "cache_age_hours": round(age_h, 1)}
-                    print("bench: TPU backend unreachable NOW; replaying "
-                          f"the last live on-chip measurement "
-                          f"({age_h:.1f}h old, flagged 'cached': true)",
-                          file=sys.stderr)
-                except Exception:
-                    result = None
-            if result is None:
+            result = read_tpu_cache()
+            if result is not None:
+                print("bench: TPU backend unreachable NOW; replaying "
+                      "the last live on-chip measurement "
+                      f"({result['cache_age_hours']:.1f}h old, flagged "
+                      "'cached': true)", file=sys.stderr)
+            else:
                 print("bench: no cached TPU result — falling back to CPU "
                       "(vs_baseline will be a CPU number)", file=sys.stderr)
     if result is None:

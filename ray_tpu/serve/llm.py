@@ -163,12 +163,26 @@ class LLMEngine:
             with self._mutex:
                 self._epoch += 1
                 # a restart must not strand live consumers: anything
-                # still parked in a slot gets an error, not silence
+                # still parked in a slot OR the old queue gets an error,
+                # not silence. A consumer whose loop already closed needs
+                # (and can receive) no notification.
                 err = RuntimeError("engine restarted")
+
+                def _notify(req):
+                    try:
+                        req.loop.call_soon_threadsafe(req.out.put_nowait,
+                                                      err)
+                    except RuntimeError:
+                        pass  # consumer's loop is closed: already gone
                 for s_ in self._slots:
                     if s_ is not None:
-                        s_.req.loop.call_soon_threadsafe(
-                            s_.req.out.put_nowait, err)
+                        _notify(s_.req)
+                if self._queue is not None:
+                    while True:
+                        try:
+                            _notify(self._queue.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
                 self._slots = [None] * self.max_batch
                 self._decode_cache = None
                 self._cur = jnp.zeros((self.max_batch,), jnp.int32)

@@ -32,6 +32,10 @@ def lora_finetune_loop(config: dict):
       init_params_fn — optional callable (cfg) -> base params (defaults to
                       random init; real runs pass a checkpoint loader)
     """
+    import os
+    import pickle
+    import tempfile
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -73,16 +77,30 @@ def lora_finetune_loop(config: dict):
     start_step = 0
     ckpt = train.get_checkpoint()
     if ckpt is not None:
-        # failure-policy restart: reload the adapters + step so retries
-        # resume instead of re-randomizing (optimizer moments reset — the
-        # adapters-only artifact stays small and serving-loadable)
+        # failure-policy restart: reload adapters + optimizer moments +
+        # step so a resumed run continues EXACTLY where it stopped —
+        # resetting adamw moments would silently change training
+        # dynamics after every restart. Moments cover only the adapters,
+        # so the artifact stays small and serving-loadable.
         from ray_tpu.train.checkpoint import load_pytree
 
-        restored = load_pytree(ckpt.subdir(f"rank_{rank}").path)
+        ckpt_dir = ckpt.subdir(f"rank_{rank}").path
+        restored = load_pytree(ckpt_dir)
         loaded = jax.tree.map(jnp.asarray, restored["lora"])
         state["params"]["lora"] = jax.tree.map(
             lambda x, cur: jax.device_put(x.astype(cur.dtype), cur.sharding),
             loaded, state["params"]["lora"])
+        opt_path = os.path.join(ckpt_dir, "opt_state.pkl")
+        if os.path.exists(opt_path):
+            # pickled host copy (not save_pytree): pickle preserves the
+            # optax NamedTuple structure exactly, so tree.map against the
+            # live opt_state restores sharded without re-registration
+            with open(opt_path, "rb") as f:
+                opt_host = pickle.load(f)
+            state["opt_state"] = jax.tree.map(
+                lambda h, cur: jax.device_put(
+                    jnp.asarray(h, cur.dtype), cur.sharding),
+                opt_host, state["opt_state"])
         start_step = int(restored["step"])
 
     bsz = config.get("batch_size", 8)
@@ -99,8 +117,6 @@ def lora_finetune_loop(config: dict):
     report_every = config.get("report_every", 10)
     steps = config.get("steps", 50)
 
-    import tempfile
-
     last_loss = first_loss = None
     for i in range(start_step, steps):
         batch = shard_batch(make_batch(i, rank), mesh)
@@ -111,9 +127,13 @@ def lora_finetune_loop(config: dict):
                 first_loss = last_loss
             with tempfile.TemporaryDirectory() as d:
                 # adapters-only checkpoint: the LoRA artifact is the
-                # deliverable (base stays wherever it was loaded from)
+                # deliverable (base stays wherever it was loaded from);
+                # optimizer moments ride along so restarts resume the
+                # exact trajectory
                 save_pytree({"lora": state["params"]["lora"],
                              "step": i + 1}, d)
+                with open(os.path.join(d, "opt_state.pkl"), "wb") as f:
+                    pickle.dump(jax.device_get(state["opt_state"]), f)
                 train.report({"loss": last_loss, "first_loss": first_loss,
                               "step": i + 1},
                              checkpoint=Checkpoint(d))

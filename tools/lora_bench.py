@@ -36,21 +36,18 @@ def main():
         result = bench._run_leg(on_tpu=True, timeout_s=float(
             os.environ.get("RAYT_BENCH_TPU_TIMEOUT_S", "900")))
         if result is not None:
-            with open(_CACHE, "w") as f:
-                json.dump({**result, "measured_at": time.time()}, f)
+            bench.write_tpu_cache(result, _CACHE)
     else:
         print("lora_bench: TPU tunnel down", file=sys.stderr)
-    if result is None and os.path.exists(_CACHE):
-        with open(_CACHE) as f:
-            cached = json.load(f)
-        age_h = (time.time() - cached.pop("measured_at", 0)) / 3600
-        result = {**cached, "cached": True,
-                  "cache_age_hours": round(age_h, 1)}
+    if result is None:
+        result = bench.read_tpu_cache(_CACHE)
     if result is None:
         # nothing live, nothing cached: record the CPU-correctness leg
         # with an explicit hardware-blocked annotation
-        cpu = bench._run_leg(on_tpu=False, timeout_s=900)
-        result = {**(cpu or {}), "hardware_blocked": True,
+        cpu = bench._run_leg(on_tpu=False, timeout_s=900) or {
+            "metric": "llama_lora_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0}
+        result = {**cpu, "hardware_blocked": True,
                   "note": "TPU tunnel unreachable and no cached on-chip "
                           "LoRA measurement exists; value is a CPU "
                           "correctness run, not a chip rate"}
