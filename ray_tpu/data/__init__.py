@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ray_tpu.data.aggregate import (AggregateFn, Count, Max,  # noqa: F401
+                                    Mean, Min, Std, Sum)
 from ray_tpu.data.dataset import (DataIterator, Dataset,  # noqa: F401
                                   from_items_rows)
 from ray_tpu.data.datasource import (read_csv, read_json,  # noqa: F401

@@ -1,9 +1,22 @@
-"""Block primitives. A Block is EITHER a row-major list of dicts OR a
-columnar ``pyarrow.Table`` (ref analog:
-python/ray/data/_internal/arrow_block.py — the reference is Arrow-first).
-Arrow blocks flow zero-copy from parquet/csv into numpy batches (the
-TPU-adjacent format fed to jax); list blocks keep ad-hoc Python data
-simple. Every primitive here handles both."""
+"""Block primitives. A Block is ONE of:
+
+* a columnar ``pyarrow.Table`` (ref analog:
+  python/ray/data/_internal/arrow_block.py — the reference is
+  Arrow-first): what file readers produce; zero-copy slices; flows
+  into numpy batches without touching Python rows;
+* a :class:`NumpyBlock` — struct-of-arrays (dict of same-length numpy
+  arrays). The TPU-native columnar format: unlike Arrow it carries
+  multi-dim columns (token matrices, images) natively, converts to a
+  jax-feedable batch for free, and pickles its arrays out-of-band
+  (protocol 5) straight into the shm arena;
+* a row-major Python list (of dicts, or bare items) for ad-hoc data.
+
+``map_batches`` output batches become columnar blocks (NumpyBlock for
+dict-of-arrays, Table stays Table), so a
+``read_parquet -> map_batches -> iter_batches`` pipeline never
+materializes per-row dicts. Every primitive here handles all three
+flavors.
+"""
 
 from __future__ import annotations
 
@@ -11,7 +24,49 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
-Block = Any  # list[dict] | list[Any] | pyarrow.Table
+Block = Any  # pyarrow.Table | NumpyBlock | list[dict] | list[Any]
+
+
+class NumpyBlock:
+    """Columnar struct-of-arrays block: dict of equal-length ndarrays.
+
+    Slicing returns zero-copy views; pickling rides protocol-5
+    out-of-band buffers (numpy supports PickleBuffer), so put/get of a
+    large block moves bytes through the shm arena without row-wise
+    pickle churn.
+    """
+
+    __slots__ = ("cols",)
+
+    def __init__(self, cols: dict):
+        self.cols = {k: np.asarray(v) for k, v in cols.items()}
+        lengths = {len(v) for v in self.cols.values()}
+        if len(lengths) > 1:
+            raise ValueError(
+                f"NumpyBlock columns have unequal lengths: "
+                f"{ {k: len(v) for k, v in self.cols.items()} }")
+
+    @property
+    def num_rows(self) -> int:
+        if not self.cols:
+            return 0
+        return len(next(iter(self.cols.values())))
+
+    def slice(self, start: int, length: int) -> "NumpyBlock":
+        return NumpyBlock({k: v[start:start + length]
+                           for k, v in self.cols.items()})
+
+    def to_rows(self) -> list[dict]:
+        keys = list(self.cols)
+        return [{k: _item(self.cols[k][i]) for k in keys}
+                for i in range(self.num_rows)]
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __repr__(self):
+        return (f"NumpyBlock(rows={self.num_rows}, "
+                f"cols={list(self.cols)})")
 
 
 def is_arrow_block(block: Block) -> bool:
@@ -22,28 +77,69 @@ def is_arrow_block(block: Block) -> bool:
     return isinstance(block, pa.Table)
 
 
+def is_numpy_block(block: Block) -> bool:
+    return isinstance(block, NumpyBlock)
+
+def is_columnar_block(block: Block) -> bool:
+    return is_numpy_block(block) or is_arrow_block(block)
+
+
+def num_rows_of(block: Block) -> int:
+    if is_columnar_block(block):
+        return block.num_rows
+    return len(block)
+
+
+def slice_rows(block: Block, start: int, length: int) -> Block:
+    """Zero-copy for columnar blocks, list slice otherwise."""
+    if is_columnar_block(block):
+        return block.slice(start, length)
+    return block[start:start + length]
+
+
 def iter_rows(block: Block) -> Iterator:
-    """Row iterator over either block flavor."""
+    """Row iterator over any block flavor."""
     if is_arrow_block(block):
         yield from block.to_pylist()
+    elif is_numpy_block(block):
+        yield from block.to_rows()
     else:
         yield from block
 
 
 def block_rows(block: Block) -> list:
-    """Materialize rows (list-of-dicts) from either block flavor."""
+    """Materialize rows (list-of-dicts) from any block flavor."""
     if is_arrow_block(block):
         return block.to_pylist()
+    if is_numpy_block(block):
+        return block.to_rows()
     return block
 
 
 def is_record_block(block: Block) -> bool:
-    if is_arrow_block(block):
+    if is_columnar_block(block):
         return True
     return bool(block) and isinstance(block[0], dict)
 
 
 def to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if is_numpy_block(block):
+        if batch_format == "numpy":
+            # zero-copy views, READ-ONLY: these may alias the shared
+            # object store, and an in-place `batch['x'] *= 2` would
+            # silently corrupt the stored block for every other reader
+            # (Arrow's zero-copy to_numpy has the same contract)
+            return {k: _readonly_view(v) for k, v in block.cols.items()}
+        if batch_format == "rows":
+            return block.to_rows()
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+
+            return pa.table({k: pa.array(v)
+                             for k, v in block.cols.items()})
+        import pandas as pd
+
+        return pd.DataFrame(block.cols)
     if is_arrow_block(block):
         if batch_format == "pyarrow":
             return block
@@ -80,20 +176,30 @@ def to_batch(block: Block, batch_format: str = "numpy") -> Any:
 
 
 def from_batch(batch: Any) -> Block:
+    """A user batch becomes a block. Columnar inputs STAY columnar —
+    a dict of arrays from map_batches must not shatter into per-row
+    dicts (the reference builds Arrow blocks here, arrow_block.py:130)."""
     if batch is None:
         return []
-    if is_arrow_block(batch):
-        return batch  # arrow tables ARE blocks
+    if is_arrow_block(batch) or is_numpy_block(batch):
+        return batch  # columnar formats ARE blocks
     if isinstance(batch, list):
         return batch
     if isinstance(batch, dict):
         if not batch:
             return []
-        keys = list(batch)
-        n = len(batch[keys[0]])
-        return [{k: _item(batch[k][i]) for k in keys} for i in range(n)]
+        try:
+            return NumpyBlock(batch)
+        except ValueError:
+            # ragged columns (per-row variable-length lists, e.g.
+            # un-padded token lists): numpy can't hold them columnar —
+            # degrade this block to rows rather than fail the pipeline
+            keys = list(batch)
+            n = len(batch[keys[0]])
+            return [{k: _item(batch[k][i]) for k in keys}
+                    for i in range(n)]
     # pandas
-    return batch.to_dict("records")
+    return NumpyBlock({c: batch[c].to_numpy() for c in batch.columns})
 
 
 def _item(x):
@@ -102,45 +208,90 @@ def _item(x):
     return x
 
 
+def _readonly_view(a: np.ndarray) -> np.ndarray:
+    v = a.view()
+    v.flags.writeable = False
+    return v
+
+
 def batch_iter(block: Block, batch_size: int | None) -> Iterator[Block]:
     if batch_size is None or batch_size <= 0:
         yield block
         return
-    if is_arrow_block(block):
-        for i in range(0, block.num_rows, batch_size):
-            yield block.slice(i, batch_size)  # zero-copy view
-        return
-    for i in range(0, len(block), batch_size):
-        yield block[i:i + batch_size]
+    n = num_rows_of(block)
+    for i in range(0, n, batch_size):
+        yield slice_rows(block, i, batch_size)  # zero-copy for columnar
 
 
 def split_block(block: Block, n: int) -> list[Block]:
-    length = block.num_rows if is_arrow_block(block) else len(block)
+    length = num_rows_of(block)
     out = []
     size, rem = divmod(length, n)
     start = 0
     for i in range(n):
         end = start + size + (1 if i < rem else 0)
-        if is_arrow_block(block):
-            out.append(block.slice(start, end - start))
-        else:
-            out.append(block[start:end])
+        out.append(slice_rows(block, start, end - start))
         start = end
     return out
 
 
 def concat_blocks(blocks: Iterable[Block]) -> Block:
-    blocks = list(blocks)
+    blocks = [b for b in list(blocks) if num_rows_of(b)]
+    if not blocks:
+        return []
+    if all(is_numpy_block(b) for b in blocks):
+        keys = list(blocks[0].cols)
+        if all(list(b.cols) == keys for b in blocks):
+            return NumpyBlock({k: np.concatenate([b.cols[k]
+                                                  for b in blocks])
+                               for k in keys})
     if any(is_arrow_block(b) for b in blocks):
         import pyarrow as pa
 
-        tables = [b if is_arrow_block(b) else pa.Table.from_pylist(b)
-                  for b in blocks if (b.num_rows if is_arrow_block(b)
-                                      else len(b))]
-        if not tables:
-            return []
+        tables = [b if is_arrow_block(b)
+                  else pa.Table.from_pylist(block_rows(b))
+                  for b in blocks]
         return pa.concat_tables(tables, promote_options="default")
     out: list = []
     for b in blocks:
-        out.extend(b)
+        out.extend(block_rows(b))
     return out
+
+
+def iter_batches_from_blocks(block_iter: Iterable[Block], batch_size: int,
+                             batch_format: str,
+                             drop_last: bool) -> Iterator[Any]:
+    """Re-batch a stream of blocks to `batch_size` WITHOUT materializing
+    rows: columnar blocks are sliced (zero-copy views) and concatenated
+    at batch granularity (ref analog: _internal/block_batching).
+    Mixed-flavor boundaries degrade that one batch to rows."""
+    pending: list[Block] = []
+    pending_rows = 0
+
+    def emit(blocks: list[Block]):
+        block = blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+        return to_batch(block, batch_format)
+
+    for block in block_iter:
+        n = num_rows_of(block)
+        if n == 0:
+            continue
+        pending.append(block)
+        pending_rows += n
+        while pending_rows >= batch_size:
+            take: list[Block] = []
+            need = batch_size
+            while need > 0:
+                head = pending[0]
+                hn = num_rows_of(head)
+                if hn <= need:
+                    take.append(pending.pop(0))
+                    need -= hn
+                else:
+                    take.append(slice_rows(head, 0, need))
+                    pending[0] = slice_rows(head, need, hn - need)
+                    need = 0
+            pending_rows -= batch_size
+            yield emit(take)
+    if pending_rows and not drop_last:
+        yield emit(pending)

@@ -10,7 +10,9 @@ import itertools
 from typing import Any, Callable, Iterator, Optional
 
 import ray_tpu as rt
-from ray_tpu.data.block import (Block, concat_blocks, split_block, to_batch)
+from ray_tpu.data.block import (Block, concat_blocks,
+                                iter_batches_from_blocks, num_rows_of,
+                                slice_rows, split_block, to_batch)
 from ray_tpu.data.executor import (ActorPoolStrategy, MapSpec,
                                    StreamingExecutor)
 
@@ -153,13 +155,9 @@ class Dataset:
             if remaining <= 0:
                 return
             block = rt.get(ref)
-            from ray_tpu.data.block import is_arrow_block
-
-            n_rows = block.num_rows if is_arrow_block(block) else len(block)
+            n_rows = num_rows_of(block)
             if n_rows > remaining:
-                yield rt.put(block.slice(0, remaining)
-                             if is_arrow_block(block)
-                             else block[:remaining])
+                yield rt.put(slice_rows(block, 0, remaining))
                 return
             remaining -= n_rows
             yield ref
@@ -177,16 +175,11 @@ class Dataset:
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator[Any]:
-        from ray_tpu.data.block import block_rows
-
-        buffer: list = []
-        for ref in self._iter_block_refs():
-            buffer.extend(block_rows(rt.get(ref)))
-            while len(buffer) >= batch_size:
-                yield to_batch(buffer[:batch_size], batch_format)
-                buffer = buffer[batch_size:]
-        if buffer and not drop_last:
-            yield to_batch(buffer, batch_format)
+        # columnar end-to-end: blocks are sliced/concatenated, never
+        # shattered into per-row dicts (ref: _internal/block_batching)
+        yield from iter_batches_from_blocks(
+            (rt.get(ref) for ref in self._iter_block_refs()),
+            batch_size, batch_format, drop_last)
 
     def take(self, n: int = 20) -> list:
         from ray_tpu.data.block import block_rows
@@ -202,13 +195,8 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        from ray_tpu.data.block import is_arrow_block
-
-        total = 0
-        for ref in self._iter_block_refs():
-            b = rt.get(ref)
-            total += b.num_rows if is_arrow_block(b) else len(b)
-        return total
+        return sum(num_rows_of(rt.get(ref))
+                   for ref in self._iter_block_refs())
 
     def num_blocks(self) -> int:
         return len(self._source_refs)
@@ -219,6 +207,33 @@ class Dataset:
             return None
         row = first[0]
         return sorted(row.keys()) if isinstance(row, dict) else ["item"]
+
+    def aggregate(self, *agg_fns) -> dict:
+        """Global aggregation via AggregateFn plugins (ref:
+        dataset.py aggregate + aggregate.py): one accumulate task per
+        block, tiny accumulators merge on the driver — rows never leave
+        their blocks."""
+        from ray_tpu.data.block import iter_rows as _block_iter_rows
+
+        def accumulate(block: Block) -> list:
+            accs = []
+            for fn in agg_fns:
+                acc = fn.init()
+                for row in _block_iter_rows(block):
+                    acc = fn.accumulate_row(acc, row)
+                accs.append(acc)
+            return accs
+
+        acc_task = rt.remote(num_cpus=1)(accumulate)
+        partials = rt.get([acc_task.remote(ref)
+                           for ref in self._iter_block_refs()])
+        out = {}
+        for i, fn in enumerate(agg_fns):
+            acc = fn.init()
+            for p in partials:
+                acc = fn.merge(acc, p[i])
+            out[fn.name] = fn.finalize(acc)
+        return out
 
     def sum(self, on: str) -> float:
         return sum(row[on] for row in self.iter_rows())
@@ -255,8 +270,9 @@ class Dataset:
         shards: list[list] = [[] for _ in range(n)]
         if equal:
             rows = concat_blocks([rt.get(r) for r in refs])
-            per = len(rows) // n
-            for i, part in enumerate(split_block(rows[:per * n], n)):
+            per = num_rows_of(rows) // n
+            for i, part in enumerate(
+                    split_block(slice_rows(rows, 0, per * n), n)):
                 shards[i].append(rt.put(part))
         else:
             for i, ref in enumerate(refs):
@@ -283,23 +299,20 @@ class DataIterator:
         self._refs = refs
 
     def iter_rows(self) -> Iterator[dict]:
+        from ray_tpu.data.block import iter_rows as _block_iter_rows
+
         for ref in self._refs:
-            yield from rt.get(ref)
+            yield from _block_iter_rows(rt.get(ref))
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator[Any]:
-        buffer: Block = []
-        for ref in self._refs:
-            buffer.extend(rt.get(ref))
-            while len(buffer) >= batch_size:
-                yield to_batch(buffer[:batch_size], batch_format)
-                buffer = buffer[batch_size:]
-        if buffer and not drop_last:
-            yield to_batch(buffer, batch_format)
+        yield from iter_batches_from_blocks(
+            (rt.get(ref) for ref in self._refs),
+            batch_size, batch_format, drop_last)
 
     def count(self) -> int:
-        return sum(len(rt.get(ref)) for ref in self._refs)
+        return sum(num_rows_of(rt.get(ref)) for ref in self._refs)
 
     def __reduce__(self):
         return (DataIterator, (self._refs,))

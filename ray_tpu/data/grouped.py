@@ -63,8 +63,16 @@ class GroupedData:
         return [combine_task.remote(*[p[j] for p in parts])
                 for j in range(n)]
 
-    def aggregate(self, **named_aggs: tuple[str, Callable]):
-        """named_aggs: out_col=(in_col, reducer over list of values).
+    def aggregate(self, *agg_fns, **named_aggs: tuple[str, Callable]):
+        """Two surfaces (ref: grouped_data.py aggregate):
+
+        * positional :class:`~ray_tpu.data.aggregate.AggregateFn` plugin
+          objects — rows fold into small accumulators inside each hash
+          partition (init/accumulate_row/finalize), so a group's rows
+          are never gathered into a list;
+        * keyword ``out_col=(in_col, reducer over list of values)`` for
+          quick ad-hoc reductions.
+
         Returns a Dataset of one row per group. Aggregation runs as one
         task per partition — partitions never land on the driver, so the
         group stage scales past one node's store (ref: planner/exchange
@@ -78,6 +86,11 @@ class GroupedData:
             out: Block = []
             for gkey, rows in groups.items():
                 row = {key: gkey}
+                for fn in agg_fns:
+                    acc = fn.init()
+                    for r in rows:
+                        acc = fn.accumulate_row(acc, r)
+                    row[fn.name] = fn.finalize(acc)
                 for out_col, (in_col, reducer) in named_aggs.items():
                     row[out_col] = reducer([r[in_col] for r in rows])
                 out.append(row)

