@@ -210,6 +210,7 @@ class DashboardHead:
         from aiohttp import web
 
         app = web.Application()
+        app.router.add_get("/", self._index)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/cluster_status", self._cluster_status)
         app.router.add_get("/api/nodes", self._nodes)
@@ -234,6 +235,16 @@ class DashboardHead:
             await self._runner.cleanup()
 
     # ---------------------------------------------------------- handlers
+    async def _index(self, request):
+        """The operator page: one static HTML file (no build step)
+        rendering nodes/actors/jobs from the JSON endpoints (ref analog:
+        the reference's React dashboard client, scoped to overview)."""
+        from aiohttp import web
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "static", "index.html")
+        return web.FileResponse(path)  # async file serve, no loop stall
+
     async def _metrics(self, request):
         from aiohttp import web
 

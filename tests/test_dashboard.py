@@ -136,3 +136,12 @@ def test_job_submit_with_runtime_env(dash_cluster, tmp_path):
     rest = json.loads(_get(port,
                            f"/api/jobs/{sub_id}/logs?offset={tail['offset']}"))
     assert rest["data"] == ""
+
+
+def test_index_page_serves_static_html(dash_cluster):
+    """`/` serves the operator page (ref: dashboard web client, scoped):
+    static HTML wired to the JSON endpoints it polls."""
+    html = _get(dash_cluster.dashboard_port, "/")
+    assert html.lstrip().startswith("<!DOCTYPE html>")
+    for endpoint in ("/api/nodes", "/api/actors", "/api/jobs"):
+        assert endpoint in html
