@@ -17,3 +17,9 @@ from ray_tpu.rl.impala import (IMPALA, AggregatorActor,  # noqa: F401
 from ray_tpu.rl.vtrace import vtrace  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNRunner  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer  # noqa: F401
+from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
+                                    MultiAgentEnvRunner, MultiAgentPPO,
+                                    MultiAgentPPOConfig,
+                                    MultiAgentVectorEnv,
+                                    make_multi_agent_env,
+                                    register_multi_agent_env)

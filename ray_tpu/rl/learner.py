@@ -53,6 +53,36 @@ def compute_gae(rewards, values, dones, last_value, gamma, lam,
     return adv, adv + values
 
 
+def build_ppo_batch(samples: list, gamma: float, lam: float):
+    """Fold sampled [T, N] trajectories into one flat PPO batch:
+    GAE per trajectory, flatten, concat. Shared by the single-agent and
+    multi-agent drivers (per-policy streams are the same shape).
+    Returns (batch, episode_returns, env_steps)."""
+    obs, acts, logps, advs, rets = [], [], [], [], []
+    ep_returns: list[float] = []
+    steps = 0
+    for s in samples:
+        adv, ret = compute_gae(
+            s["rewards"], s["values"], s["dones"], s["last_value"],
+            gamma, lam, s.get("trunc_values"))
+        T, N = s["rewards"].shape
+        steps += T * N
+        obs.append(s["obs"].reshape((T * N,) + s["obs"].shape[2:]))
+        acts.append(s["actions"].reshape(T * N))
+        logps.append(s["logp"].reshape(T * N))
+        advs.append(adv.reshape(T * N))
+        rets.append(ret.reshape(T * N))
+        ep_returns.extend(s["episode_returns"])
+    batch = {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(acts),
+        "logp_old": np.concatenate(logps),
+        "advantages": np.concatenate(advs).astype(np.float32),
+        "returns": np.concatenate(rets).astype(np.float32),
+    }
+    return batch, ep_returns, steps
+
+
 class JaxLearner:
     """One learner process; jit-compiled minibatch PPO update."""
 

@@ -121,30 +121,10 @@ class PPO:
         }
 
     def _build_batch(self, samples: list[dict]):
+        from ray_tpu.rl.learner import build_ppo_batch
+
         cfg = self.config
-        obs, acts, logps, advs, rets = [], [], [], [], []
-        ep_returns: list[float] = []
-        steps = 0
-        for s in samples:
-            adv, ret = compute_gae(
-                s["rewards"], s["values"], s["dones"], s["last_value"],
-                cfg.gamma, cfg.gae_lambda, s.get("trunc_values"))
-            T, N = s["rewards"].shape
-            steps += T * N
-            obs.append(s["obs"].reshape((T * N,) + s["obs"].shape[2:]))
-            acts.append(s["actions"].reshape(T * N))
-            logps.append(s["logp"].reshape(T * N))
-            advs.append(adv.reshape(T * N))
-            rets.append(ret.reshape(T * N))
-            ep_returns.extend(s["episode_returns"])
-        batch = {
-            "obs": np.concatenate(obs),
-            "actions": np.concatenate(acts),
-            "logp_old": np.concatenate(logps),
-            "advantages": np.concatenate(advs).astype(np.float32),
-            "returns": np.concatenate(rets).astype(np.float32),
-        }
-        return batch, ep_returns, steps
+        return build_ppo_batch(samples, cfg.gamma, cfg.gae_lambda)
 
     @staticmethod
     def _split_batch(batch: dict, n: int) -> list[dict]:

@@ -1,0 +1,91 @@
+"""Multi-agent RL (VERDICT r5 item #7; ref analogs:
+rllib/env/multi_agent_env_runner.py, core/rl_module/multi_rl_module.py,
+examples MultiAgentCartPole): policy mapping, per-policy batching
+through the shared learner stack, per-policy metrics."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def rl_cluster(local_cluster):
+    yield local_cluster
+
+
+def test_multi_agent_env_lockstep():
+    from ray_tpu.rl import MultiAgentCartPole
+
+    env = MultiAgentCartPole(num_envs=4, seed=0, num_agents=3)
+    obs = env.reset(0)
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    assert obs["agent_0"].shape == (4, 4)
+    actions = {a: np.zeros(4, np.int32) for a in env.agent_ids}
+    obs2, rew, term, trunc, final = env.step(actions)
+    assert all(rew[a].shape == (4,) for a in env.agent_ids)
+    # independent streams: different seeds per agent -> different states
+    assert not np.allclose(obs2["agent_0"], obs2["agent_1"])
+
+
+def test_policy_mapping_groups_agents(rl_cluster):
+    """4 agents -> 2 policies; each policy's runner batch carries BOTH
+    its agents' streams (per-module batching)."""
+    import cloudpickle
+
+    from ray_tpu.rl.module import MLPModuleConfig
+    from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
+    from ray_tpu.rl import module as rlm
+    import jax
+
+    cfgs = {"even": MLPModuleConfig(observation_size=4, num_actions=2,
+                                    hidden=(16,)),
+            "odd": MLPModuleConfig(observation_size=4, num_actions=2,
+                                   hidden=(16,))}
+    mapping = lambda aid: "even" if int(aid[-1]) % 2 == 0 else "odd"
+    runner = MultiAgentEnvRunner(
+        "MultiAgentCartPole", 4, 0, cloudpickle.dumps(cfgs),
+        cloudpickle.dumps(mapping),
+        cloudpickle.dumps({"num_agents": 4}))
+    assert runner.policy_agents == {"even": ["agent_0", "agent_2"],
+                                    "odd": ["agent_1", "agent_3"]}
+    params = {p: rlm.init_params(c, jax.random.PRNGKey(0))
+              for p, c in cfgs.items()}
+    runner.set_weights(params)
+    out = runner.sample(8)["policies"]
+    # 2 agents x 4 envs = 8 streams per policy
+    assert out["even"]["obs"].shape == (8, 8, 4)
+    assert out["odd"]["rewards"].shape == (8, 8)
+    assert out["even"]["last_value"].shape == (8,)
+
+
+def test_multi_agent_ppo_learns_two_policies(rl_cluster):
+    """2-policy MultiAgentCartPole learns: both policies' mean returns
+    improve over training, with per-policy metrics reported."""
+    from ray_tpu.rl import MultiAgentPPOConfig
+
+    algo = MultiAgentPPOConfig(
+        env="MultiAgentCartPole",
+        env_config={"num_agents": 2},
+        num_env_runners=2,
+        num_envs_per_runner=8,
+        rollout_fragment_length=64,
+        policies={"agent_0": {}, "agent_1": {"hidden": (32, 32)}},
+        policy_mapping_fn=lambda aid: aid,
+        minibatch_size=512,
+        seed=0).build()
+    try:
+        first = algo.train()
+        assert set(first["policies"]) == {"agent_0", "agent_1"}
+        assert "learner/loss" in first["policies"]["agent_0"]
+        last = first
+        for _ in range(11):
+            last = algo.train()
+        # both policies independently beat their starting return
+        for p in ("agent_0", "agent_1"):
+            assert (last["policies"][p]["episode_return_mean"]
+                    > first["policies"][p]["episode_return_mean"]), (
+                p, first["policies"][p], last["policies"][p])
+        assert last["num_env_steps_sampled"] > 0
+    finally:
+        algo.stop()
