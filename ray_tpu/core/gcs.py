@@ -15,6 +15,7 @@ gcs_health_check_manager.h:45).
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import Any
 
@@ -58,6 +59,13 @@ class GcsServer:
         self.node_conns: dict[NodeID, Connection] = {}
         self.node_resources_available: dict[NodeID, dict[str, float]] = {}
         self.node_last_heartbeat: dict[NodeID, float] = {}
+        # streaming resource sync (ref analog: ray_syncer.h:83 delta
+        # broadcast): every change to a node's view entry bumps the
+        # version and logs the node id; consumers pull only the entries
+        # changed since their last-seen version
+        self.resource_version = 0
+        self._resource_log: collections.deque = collections.deque(
+            maxlen=4096)
         self.actors: dict[ActorID, ActorInfo] = {}
         self.actor_specs: dict[ActorID, TaskSpec] = {}
         # worker ids whose death was reported before their start_actor
@@ -212,6 +220,10 @@ class GcsServer:
         # give them a heartbeat grace window before declaring them dead
         for nid in self.nodes:
             self.node_last_heartbeat[nid] = now()
+            # seed the delta log so a since=0 consumer's pull covers the
+            # restored nodes — otherwise the delta path would silently
+            # omit every node that hasn't re-registered yet
+            self._mark_resource_change(nid)
         logger.info("GCS snapshot loaded: %d nodes, %d actors, %d jobs",
                     len(self.nodes), len(self.actors), len(self.jobs))
 
@@ -436,6 +448,7 @@ class GcsServer:
         self.node_conns[info.node_id] = conn
         self.node_resources_available[info.node_id] = dict(info.resources_total)
         self.node_last_heartbeat[info.node_id] = now()
+        self._mark_resource_change(info.node_id)
         conn.on_close.append(lambda c: asyncio.ensure_future(
             self._on_node_lost(info.node_id)))
         self.mark_dirty()
@@ -450,6 +463,7 @@ class GcsServer:
         info.alive = False
         conn = self.node_conns.pop(node_id, None)
         self.node_resources_available.pop(node_id, None)
+        self._mark_resource_change(node_id)
         self.mark_dirty()
         logger.warning("node %s lost (conn: %s)", node_id,
                        getattr(conn, "close_reason", "") or "untracked")
@@ -460,34 +474,90 @@ class GcsServer:
                     ActorState.ALIVE, ActorState.PENDING):
                 await self._handle_actor_failure(actor, "node died")
 
+    def _mark_resource_change(self, node_id: NodeID):
+        self.resource_version += 1
+        self._resource_log.append((self.resource_version, node_id))
+
     def rpc_heartbeat(self, conn, arg):
-        """Resource-view sync (ref analog: RaySyncer resource broadcast)."""
-        node_id, available = arg
+        """Resource-view sync (ref analog: RaySyncer resource broadcast).
+
+        Delta form: (node_id, delta, full) where delta maps changed
+        resource keys to amounts (None = key removed) and full=True
+        replaces the whole view (first send / after reconnect). Legacy
+        (node_id, available) is treated as full. Only REAL changes bump
+        the version — an all-idle cluster syncs O(0) bytes downstream."""
+        if len(arg) == 3:
+            node_id, delta, full = arg
+        else:
+            node_id, delta, full = arg[0], arg[1], True
         self.node_last_heartbeat[node_id] = now()
         if node_id in self.nodes and self.nodes[node_id].alive:
-            self.node_resources_available[node_id] = available
+            cur = self.node_resources_available.get(node_id)
+            if full or cur is None:
+                new = {k: v for k, v in delta.items() if v is not None}
+                if cur != new:
+                    self.node_resources_available[node_id] = new
+                    self._mark_resource_change(node_id)
+            elif delta:
+                changed = False
+                for k, v in delta.items():
+                    if v is None:
+                        changed |= cur.pop(k, None) is not None
+                    elif cur.get(k) != v:
+                        cur[k] = v
+                        changed = True
+                if changed:
+                    self._mark_resource_change(node_id)
         return True
+
+    def _node_view_entry(self, nid: NodeID) -> dict:
+        info = self.nodes[nid]
+        return {
+            "total": info.resources_total,
+            "available": self.node_resources_available.get(nid, {}),
+            "alive": info.alive,
+            "address": info.address,
+            "labels": info.labels,
+        }
+
+    def rpc_get_cluster_resources_delta(self, conn, since: int):
+        """Entries changed in (since, current]; falls back to a full
+        view when `since` predates the change log's horizon (fresh
+        consumer, log overflow, or GCS restart)."""
+        v = self.resource_version
+        if since == v:
+            return {"version": v, "full": None, "changed": {},
+                    "removed": []}
+        oldest = self._resource_log[0][0] if self._resource_log else v + 1
+        if since > v or since < oldest - 1:
+            # version from a previous GCS incarnation, or horizon lost
+            return {"version": v,
+                    "full": self.rpc_get_cluster_resources(conn),
+                    "changed": {}, "removed": []}
+        changed_ids = {nid for ver, nid in self._resource_log
+                       if ver > since}
+        changed, removed = {}, []
+        for nid in changed_ids:
+            if nid in self.nodes:
+                changed[nid.hex()] = self._node_view_entry(nid)
+            else:
+                removed.append(nid.hex())
+        return {"version": v, "full": None, "changed": changed,
+                "removed": removed}
 
     def rpc_get_all_nodes(self, conn, arg=None):
         return list(self.nodes.values())
 
     def rpc_get_cluster_resources(self, conn, arg=None):
-        return {
-            nid.hex(): {
-                "total": self.nodes[nid].resources_total,
-                "available": self.node_resources_available.get(nid, {}),
-                "alive": self.nodes[nid].alive,
-                "address": self.nodes[nid].address,
-                "labels": self.nodes[nid].labels,
-            }
-            for nid in self.nodes
-        }
+        return {nid.hex(): self._node_view_entry(nid)
+                for nid in self.nodes}
 
     def rpc_drain_node(self, conn, node_id: NodeID):
         info = self.nodes.get(node_id)
         if info is None:
             return False
         info.labels["draining"] = "1"
+        self._mark_resource_change(node_id)  # view entry carries labels
         return True
 
     # --------------------------------------------------------------- jobs

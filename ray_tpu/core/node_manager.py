@@ -249,6 +249,12 @@ class NodeManager:
         self._pg_reserved: dict[tuple, dict[str, float]] = {}
         self._pg_prepared: dict[tuple, dict[str, float]] = {}
         self._cluster_view: dict = {}
+        self._view_version = 0         # last-seen GCS resource version
+        self._hb_last_sent: dict | None = None  # delta-heartbeat baseline
+        # serializes delta sends: two concurrent pushes reading the same
+        # baseline would leave the GCS view diverged until the next real
+        # change (the full-view protocol was self-healing; deltas aren't)
+        self._hb_lock = asyncio.Lock()
         self._spread_counter = 0
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
@@ -309,17 +315,31 @@ class NodeManager:
         await self.server.stop()
 
     async def _heartbeat_loop(self):
+        """Streaming resource sync (ref: ray_syncer.h delta broadcast):
+        upstream sends only resource keys that changed since the last
+        ack'd send; downstream pulls only view entries changed since the
+        last-seen version. An idle cluster's sync traffic is a liveness
+        ping + an empty delta, independent of node count."""
         while not self._stopping:
             try:
-                await self.gcs_conn.call(
-                    "heartbeat", (self.node_id, dict(self.resources_available)))
-                self._cluster_view = await self.gcs_conn.call(
-                    "get_cluster_resources")
+                await self._push_heartbeat()
+                await self._refresh_view()
             except Exception:
                 if self.gcs_conn is not None and self.gcs_conn.closed \
                         and not self._stopping:
                     await self._reconnect_gcs()
             await asyncio.sleep(get_config().gcs_health_check_period_s)
+
+    async def _refresh_view(self):
+        resp = await self.gcs_conn.call("get_cluster_resources_delta",
+                                        self._view_version)
+        if resp["full"] is not None:
+            self._cluster_view = resp["full"]
+        else:
+            self._cluster_view.update(resp["changed"])
+            for nid_hex in resp["removed"]:
+                self._cluster_view.pop(nid_hex, None)
+        self._view_version = resp["version"]
 
     async def _reconnect_gcs(self):
         """The GCS died (head restart). Reconnect and re-register this
@@ -338,6 +358,14 @@ class NodeManager:
                 resources_total=dict(self.resources_total),
                 labels=dict(self.labels))
             await self.gcs_conn.call("register_node", info)
+            # the restarted GCS has a fresh version counter and no view
+            # of us: resync from scratch (full heartbeat, full view
+            # pull). The old view is dropped NOW — a node the new GCS
+            # never heard of would otherwise survive as an alive ghost
+            # entry that spillback keeps routing to.
+            self._view_version = 0
+            self._hb_last_sent = None
+            self._cluster_view = {}
             logger.info("re-registered with restarted GCS")
         except Exception:
             pass
@@ -533,8 +561,7 @@ class NodeManager:
         if target is not None:
             return target
         try:
-            self._cluster_view = await self.gcs_conn.call(
-                "get_cluster_resources")
+            await self._refresh_view()
         except Exception:
             return None
         return self._pick_spillback(demand, strategy)
@@ -559,8 +586,7 @@ class NodeManager:
                     # a just-registered node may not be in the heartbeat
                     # view yet: refresh once before declaring it gone
                     try:
-                        self._cluster_view = await self.gcs_conn.call(
-                            "get_cluster_resources")
+                        await self._refresh_view()
                     except Exception:
                         pass
                     view = self._cluster_view.get(strategy.node_id.hex())
@@ -778,14 +804,29 @@ class NodeManager:
         return True
 
     async def _push_heartbeat(self):
-        """Sync the GCS resource view immediately (instead of waiting for
-        the periodic heartbeat) so just-committed bundle resources are
-        visible to spillback/scheduling decisions made right after."""
-        try:
-            await self.gcs_conn.call(
-                "heartbeat", (self.node_id, dict(self.resources_available)))
-        except Exception:
-            pass
+        """Sync the GCS resource view (delta form): only resource keys
+        that changed since the last ack'd send travel; a removed key is
+        sent as None. Also called out-of-band so just-committed bundle
+        resources are visible to spillback/scheduling immediately."""
+        async with self._hb_lock:
+            cur = dict(self.resources_available)
+            if self._hb_last_sent is None:
+                delta, full = cur, True
+            else:
+                delta = {k: v for k, v in cur.items()
+                         if self._hb_last_sent.get(k) != v}
+                for k in self._hb_last_sent:
+                    if k not in cur:
+                        delta[k] = None
+                full = False
+            try:
+                await self.gcs_conn.call("heartbeat",
+                                         (self.node_id, delta, full))
+                self._hb_last_sent = cur
+            except Exception:
+                # the server may or may not have applied the delta:
+                # the baseline is unknowable — next send must be full
+                self._hb_last_sent = None
 
     async def rpc_pg_return(self, conn, arg):
         pg_id, bundle_index = arg

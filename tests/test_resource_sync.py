@@ -1,0 +1,109 @@
+"""Streaming resource delta sync (VERDICT r5 item #10; ref analog:
+src/ray/common/ray_syncer/ray_syncer.h:83 — delta broadcast instead of
+full-view polling). Unit-level: 100 virtual nodes against the GcsServer
+handlers directly (no processes), asserting sync payloads scale with
+CHANGES, not cluster size. Integration: the live multi-node path is
+exercised by tests/test_multi_node.py through spillback."""
+
+import pickle
+
+import pytest
+
+from ray_tpu._internal.ids import NodeID
+from ray_tpu.core.common import Address, NodeInfo
+
+
+@pytest.fixture
+def gcs_with_nodes():
+    import asyncio
+
+    from ray_tpu.core.gcs import GcsServer
+
+    gcs = GcsServer()
+
+    class _Conn:
+        on_close: list = []
+
+        async def close(self):
+            pass
+
+    nids = []
+    loop = asyncio.new_event_loop()
+    try:
+        for i in range(100):
+            nid = NodeID.random()
+            nids.append(nid)
+            info = NodeInfo(node_id=nid,
+                            address=Address("127.0.0.1", 20000 + i),
+                            resources_total={"CPU": 8.0})
+            loop.run_until_complete(
+                gcs.rpc_register_node(_Conn(), info))
+    finally:
+        loop.close()
+    yield gcs, nids
+
+
+def _payload_size(obj) -> int:
+    return len(pickle.dumps(obj))
+
+
+def test_delta_pull_scales_with_changes(gcs_with_nodes):
+    gcs, nids = gcs_with_nodes
+    # first pull: a fresh consumer gets all 100 nodes (as a full view or
+    # as 100 changed entries — equivalent)
+    first = gcs.rpc_get_cluster_resources_delta(None, 0)
+    view = first["full"] if first["full"] is not None else first["changed"]
+    assert len(view) == 100
+    v = first["version"]
+
+    # steady state, nothing changed: the response is O(1)
+    idle = gcs.rpc_get_cluster_resources_delta(None, v)
+    assert idle["full"] is None and idle["changed"] == {}
+    assert _payload_size(idle) < 200
+
+    # one node's availability changes -> exactly one entry travels
+    gcs.rpc_heartbeat(None, (nids[7], {"CPU": 3.0}, False))
+    delta = gcs.rpc_get_cluster_resources_delta(None, v)
+    assert list(delta["changed"]) == [nids[7].hex()]
+    assert delta["changed"][nids[7].hex()]["available"] == {"CPU": 3.0}
+    # the one-change payload is ~100x smaller than the full view
+    assert _payload_size(delta) * 20 < _payload_size(first)
+
+    # an unchanged-value heartbeat does NOT bump the version
+    v2 = delta["version"]
+    gcs.rpc_heartbeat(None, (nids[7], {"CPU": 3.0}, False))
+    assert gcs.resource_version == v2
+
+
+def test_delta_heartbeat_merges_and_deletes(gcs_with_nodes):
+    gcs, nids = gcs_with_nodes
+    nid = nids[0]
+    gcs.rpc_heartbeat(None, (nid, {"CPU": 2.0, "pg_0": 1.0}, False))
+    assert gcs.node_resources_available[nid] == {"CPU": 2.0, "pg_0": 1.0}
+    # None deletes a key (placement-group bundle released)
+    gcs.rpc_heartbeat(None, (nid, {"pg_0": None}, False))
+    assert gcs.node_resources_available[nid] == {"CPU": 2.0}
+    # legacy 2-tuple form still replaces the whole view
+    gcs.rpc_heartbeat(None, (nid, {"CPU": 8.0}))
+    assert gcs.node_resources_available[nid] == {"CPU": 8.0}
+
+
+def test_delta_pull_survives_log_eviction(gcs_with_nodes):
+    gcs, nids = gcs_with_nodes
+    v = gcs.rpc_get_cluster_resources_delta(None, 0)["version"]
+    # push the change log far past its horizon
+    for i in range(5000):
+        gcs.rpc_heartbeat(None,
+                          (nids[i % 100], {"CPU": float(i % 7)}, False))
+    resp = gcs.rpc_get_cluster_resources_delta(None, v)
+    # horizon lost -> full view, never a silently-partial delta
+    assert resp["full"] is not None and len(resp["full"]) == 100
+
+
+def test_delta_pull_handles_gcs_restart_version_reset(gcs_with_nodes):
+    gcs, _ = gcs_with_nodes
+    # consumer's version is from a previous GCS incarnation (larger than
+    # the fresh server's counter): must get a full view, not "no change"
+    resp = gcs.rpc_get_cluster_resources_delta(
+        None, gcs.resource_version + 1000)
+    assert resp["full"] is not None
