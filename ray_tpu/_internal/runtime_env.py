@@ -20,6 +20,13 @@ correctness-relevant option is worse than rejecting it):
   resolve the venv's interpreter (ref: _private/runtime_env/pip.py —
   the reference launches dedicated workers from the venv interpreter;
   pooled workers here splice import paths instead and restore after).
+* ``conda``: an existing env NAME (str) or an environment spec dict
+  ({"dependencies": [...]}, the env.yaml shape). Spec dicts build a
+  content-addressed env once per unique spec via the ``conda`` binary
+  (override with RAYT_CONDA_EXE; clear error when absent); either form
+  splices the env's site-packages ahead of sys.path and exports
+  CONDA_PREFIX/PATH (ref: _private/runtime_env/conda.py — same splice
+  model as pip above).
 """
 
 from __future__ import annotations
@@ -31,7 +38,8 @@ import sys
 import time
 import zipfile
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip",
+                  "conda"}
 KV_NAMESPACE = "runtime_env"
 
 # -------------------------------------------------------------- plugin API
@@ -64,6 +72,7 @@ def register_runtime_env_plugin(key: str, plugin: RuntimeEnvPlugin):
     _PLUGINS[key] = plugin
 _CACHE_ROOT = "/tmp/rayt_runtime_env"
 _VENV_ROOT = os.path.join(_CACHE_ROOT, "venvs")
+_CONDA_ROOT = os.path.join(_CACHE_ROOT, "conda")
 # keep at most this many cached venvs (LRU by last-use mtime)
 _VENV_GC_KEEP = 8
 # skip bulky junk when zipping (ref: packaging.py excludes)
@@ -107,6 +116,33 @@ def validate(renv: dict) -> None:
                 isinstance(p, str) for p in pkgs):
             raise TypeError("runtime_env['pip'] must be a list of "
                             "requirement strings or {'packages': [...]}")
+    conda = renv.get("conda")
+    if conda is not None:
+        if isinstance(conda, dict):
+            deps = conda.get("dependencies")
+            if not isinstance(deps, (list, tuple)):
+                raise TypeError("runtime_env['conda'] spec dict needs a "
+                                "'dependencies' list (env.yaml shape)")
+            for d in deps:
+                if isinstance(d, dict):
+                    for k, v in d.items():
+                        if not isinstance(v, (list, tuple)) or not all(
+                                isinstance(x, str) for x in v):
+                            raise TypeError(
+                                f"runtime_env['conda'] nested dependency "
+                                f"{k!r} must map to a list of strings, "
+                                f"got {v!r}")
+                elif not isinstance(d, str):
+                    raise TypeError(
+                        "runtime_env['conda'] dependencies must be "
+                        f"strings or dicts, got {d!r}")
+        elif not isinstance(conda, str):
+            raise TypeError("runtime_env['conda'] must be an env name or "
+                            "an environment spec dict")
+    if renv.get("conda") is not None and renv.get("pip") is not None:
+        raise ValueError("runtime_env: 'conda' and 'pip' are mutually "
+                         "exclusive (put pip packages inside the conda "
+                         "spec's dependencies)")
 
 
 def _zip_path(path: str) -> bytes:
@@ -168,6 +204,14 @@ def package(renv: dict, kv_put) -> dict:
             repr((pkgs, opts, sys.version_info[:2])).encode()
         ).hexdigest()[:16]
         spec["pip"] = {"packages": pkgs, "options": opts, "hash": tag}
+    conda = renv.get("conda")
+    if conda:
+        if isinstance(conda, str):
+            spec["conda"] = {"name": conda}
+        else:
+            canon = _canon_conda(conda)
+            tag = hashlib.sha256(repr(canon).encode()).hexdigest()[:16]
+            spec["conda"] = {"spec": canon, "hash": tag}
     plugin_entries = []
     for key, plugin in _PLUGINS.items():
         if key in renv:
@@ -179,6 +223,131 @@ def package(renv: dict, kv_put) -> dict:
     if plugin_entries:
         spec["_plugins"] = plugin_entries
     return spec
+
+
+# ------------------------------------------------------------------- conda
+def _canon_conda(spec: dict) -> dict:
+    """Canonical spec: dependency ORDER must not change the hash. Nested
+    pip blocks ({"pip": [...]}) canonicalize too."""
+    deps = []
+    for d in spec.get("dependencies") or []:
+        if isinstance(d, dict):
+            deps.append({k: sorted(v) for k, v in sorted(d.items())})
+        else:
+            deps.append(d)
+    deps.sort(key=repr)
+    out = {"dependencies": deps}
+    if spec.get("channels"):
+        out["channels"] = list(spec["channels"])
+    return out
+
+
+_NAMED_PREFIX_CACHE: dict[tuple, str] = {}
+
+
+def _conda_exe() -> str:
+    import shutil
+
+    exe = os.environ.get("RAYT_CONDA_EXE") or shutil.which("conda")
+    if not exe:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda binary on PATH "
+            "(or RAYT_CONDA_EXE); none found on this node")
+    return exe
+
+
+def _spec_to_yaml(spec: dict) -> str:
+    """Minimal env.yaml writer (no yaml dep): names, channels, deps,
+    nested pip lists."""
+    lines = ["name: rayt-env"]
+    if spec.get("channels"):
+        lines.append("channels:")
+        lines += [f"  - {c}" for c in spec["channels"]]
+    lines.append("dependencies:")
+    for d in spec.get("dependencies") or []:
+        if isinstance(d, dict):
+            for k, vals in d.items():
+                lines.append(f"  - {k}:")
+                lines += [f"    - {v}" for v in vals]
+        else:
+            lines.append(f"  - {d}")
+    return "\n".join(lines) + "\n"
+
+
+def ensure_conda_env(conda_spec: dict) -> str:
+    """Resolve a conda runtime env to its PREFIX directory.
+
+    Named envs resolve through `conda run`; spec dicts build a
+    content-addressed prefix once (same lock + .complete discipline as
+    ensure_pip_venv). Ref: _private/runtime_env/conda.py get_or_create.
+    """
+    import fcntl
+    import subprocess
+
+    conda = _conda_exe()
+    name = conda_spec.get("name")
+    if name:
+        # per-process cache: `conda run` costs seconds and the answer
+        # never changes for a given name — pooled workers materialize
+        # per TASK, not per process
+        cached = _NAMED_PREFIX_CACHE.get((conda, name))
+        if cached is not None:
+            return cached
+        r = subprocess.run(
+            [conda, "run", "-n", name, "python", "-c",
+             "import sys; print(sys.prefix)"],
+            capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"conda env {name!r} not usable: {r.stderr[-1000:]}")
+        prefix = r.stdout.strip().splitlines()[-1]
+        _NAMED_PREFIX_CACHE[(conda, name)] = prefix
+        return prefix
+    prefix = os.path.join(_CONDA_ROOT, conda_spec["hash"])
+    marker = os.path.join(prefix, ".complete")
+    if os.path.exists(marker):
+        try:
+            os.utime(prefix)
+            return prefix
+        except OSError:
+            pass
+    os.makedirs(_CONDA_ROOT, exist_ok=True)
+    lock_path = prefix + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return prefix
+            yaml_path = prefix + ".yaml"
+            with open(yaml_path, "w") as f:
+                f.write(_spec_to_yaml(conda_spec["spec"]))
+            r = subprocess.run(
+                [conda, "env", "create", "-p", prefix, "-f", yaml_path],
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                import shutil
+
+                shutil.rmtree(prefix, ignore_errors=True)
+                raise RuntimeError(
+                    f"conda env create failed: {r.stderr[-2000:]}")
+            with open(marker, "w") as f:
+                f.write("ok")
+            return prefix
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _conda_site_packages(prefix: str) -> str:
+    lib = os.path.join(prefix, "lib")
+    try:
+        pys = sorted(d for d in os.listdir(lib)
+                     if d.startswith("python"))
+    except OSError:
+        pys = []
+    if pys:
+        return os.path.join(lib, pys[-1], "site-packages")
+    ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+    return os.path.join(lib, ver, "site-packages")
 
 
 # ------------------------------------------------------------------ pip/venv
@@ -379,6 +548,18 @@ def materialize(spec: dict, kv_get) -> None:
                               + os.environ.get("PATH", ""))
         # a module imported under a previous env must not satisfy this
         # env's import of the same distribution
+        import importlib
+
+        importlib.invalidate_caches()
+    conda_spec = spec.get("conda")
+    if conda_spec:
+        prefix = ensure_conda_env(conda_spec)
+        site = _conda_site_packages(prefix)
+        if site not in sys.path:
+            sys.path.insert(0, site)
+        os.environ["CONDA_PREFIX"] = prefix
+        os.environ["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                              + os.environ.get("PATH", ""))
         import importlib
 
         importlib.invalidate_caches()

@@ -950,6 +950,8 @@ class CoreWorker:
         saved_keys = list(spec.runtime_env.get("env_vars") or {})
         if spec.runtime_env.get("pip"):
             saved_keys += ["VIRTUAL_ENV", "PATH"]  # venv splice reverts too
+        if spec.runtime_env.get("conda"):
+            saved_keys += ["CONDA_PREFIX", "PATH"]
         saved_env = {k: os.environ.get(k) for k in saved_keys}
         saved_cwd = os.getcwd()
         saved_path = list(sys.path)
@@ -1722,6 +1724,25 @@ class CoreWorker:
         return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
                 "actor_id": self.actor_id.hex() if self.actor_id else None,
                 "threads": out}
+
+    async def rpc_profile_worker(self, conn, arg=None):
+        """On-demand self-profiling (ref: dashboard profile_manager
+        py-spy/memray attach — cooperative here, no ptrace): mode "cpu"
+        samples all threads' stacks, mode "memory" opens a tracemalloc
+        window. Runs on an executor thread so the IO loop keeps serving."""
+        from ray_tpu._internal import profiler
+
+        arg = arg or {}
+        mode = arg.get("mode", "cpu")
+        duration = float(arg.get("duration_s", 5.0))
+        loop = asyncio.get_running_loop()
+        if mode == "memory":
+            return await loop.run_in_executor(
+                None, profiler.sample_memory, duration,
+                int(arg.get("top_n", 25)))
+        return await loop.run_in_executor(
+            None, profiler.sample_cpu, duration,
+            float(arg.get("interval_s", 0.01)))
 
     def rpc_worker_stats(self, conn, arg=None):
         return {

@@ -95,13 +95,17 @@ class GcsServer:
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
         self._started = now()
-        # --- persistence (ref analog: redis_store_client.h — snapshot
-        # instead of Redis: tables pickle to a file, dirty-flag debounced) ---
+        # --- persistence (ref analog: gcs/store_client/ — pluggable:
+        # local snapshot file, or an external store server the head can
+        # restart against from ANY machine: core/persistence.py) ---
+        from ray_tpu.core.persistence import make_backend
+
         self.persist_path = (persist_path if persist_path is not None
                              else get_config().gcs_persist_path) or None
+        self._backend = make_backend(self.persist_path)
         self._dirty = False
         self._bg: list[asyncio.Task] = []
-        if self.persist_path:
+        if self._backend is not None:
             self._load_snapshot()
 
     # ------------------------------------------------------- persistence
@@ -113,33 +117,30 @@ class GcsServer:
     # tick would stall the event loop)
     _BLOB_THRESHOLD = 256 * 1024
 
-    def _externalize_blob(self, value: bytes) -> tuple:
+    def _externalize_blob(self, value: bytes, pending: dict) -> tuple:
         import hashlib
-        import os
 
         digest = hashlib.sha256(value).hexdigest()
-        blob_dir = self.persist_path + ".blobs"
-        os.makedirs(blob_dir, exist_ok=True)
-        path = os.path.join(blob_dir, digest)
-        if not os.path.exists(path):
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(value)
-            os.replace(tmp, path)
+        pending[digest] = value  # written OFF-loop with the snapshot
         return ("__rayt_blob__", digest)
 
-    def _snapshot_state(self) -> dict:
+    def _snapshot_state(self) -> tuple[dict, dict]:
+        """-> (state, pending_blobs). No backend IO happens here: with a
+        REMOTE backend a blocking put from the event loop would stall
+        every GCS handler (heartbeats included) for the store's RTT."""
+        pending_blobs: dict[str, bytes] = {}
         kv_out: dict = {}
         for ns, table in self.kv.items():
             out_table = {}
             for key, value in table.items():
                 if isinstance(value, (bytes, bytearray)) and \
                         len(value) > self._BLOB_THRESHOLD:
-                    out_table[key] = self._externalize_blob(bytes(value))
+                    out_table[key] = self._externalize_blob(
+                        bytes(value), pending_blobs)
                 else:
                     out_table[key] = value
             kv_out[ns] = out_table
-        return {
+        return ({
             "kv": kv_out,
             "nodes": self.nodes,
             "node_last_heartbeat": self.node_last_heartbeat,
@@ -150,7 +151,7 @@ class GcsServer:
             "placement_groups": self.placement_groups,
             "dedup_results": {c: dict(t)
                               for c, t in self._dedup_results.items()},
-        }
+        }, pending_blobs)
 
     def _write_snapshot(self):
         import pickle
@@ -158,43 +159,38 @@ class GcsServer:
         # serialize on the caller (event-loop) thread — the tables are
         # mutated by handlers on that loop, so pickling from an executor
         # thread would race ("dict changed size during iteration")
-        data = pickle.dumps(self._snapshot_state(), protocol=4)
-        self._write_snapshot_bytes(data)
+        state, blobs = self._snapshot_state()
+        data = pickle.dumps(state, protocol=4)
+        self._write_snapshot_bytes(data, blobs)
 
-    def _write_snapshot_bytes(self, data: bytes):
-        import os
-
-        tmp = self.persist_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, self.persist_path)
+    def _write_snapshot_bytes(self, data: bytes, blobs: dict):
+        for digest, value in blobs.items():
+            self._backend.put_if_absent("blobs/" + digest, value)
+        self._backend.put("snapshot", data)
 
     def _load_snapshot(self):
-        import os
         import pickle
 
-        if not os.path.exists(self.persist_path):
-            return
         try:
-            with open(self.persist_path, "rb") as f:
-                state = pickle.load(f)
+            data = self._backend.get("snapshot")
+            if data is None:
+                return
+            state = pickle.loads(data)
         except Exception:
             logger.exception("GCS snapshot load failed; starting empty")
             return
-        blob_dir = self.persist_path + ".blobs"
         kv: dict = {}
         for ns, table in state.get("kv", {}).items():
             out = {}
             for key, value in table.items():
                 if isinstance(value, tuple) and len(value) == 2 and \
                         value[0] == "__rayt_blob__":
-                    try:
-                        with open(os.path.join(blob_dir, value[1]),
-                                  "rb") as f:
-                            out[key] = f.read()
-                    except OSError:
+                    blob = self._backend.get("blobs/" + value[1])
+                    if blob is None:
                         logger.warning("missing snapshot blob for %s/%s",
                                        ns, key)
+                    else:
+                        out[key] = blob
                 else:
                     out[key] = value
             kv[ns] = out
@@ -236,9 +232,10 @@ class GcsServer:
                 self._dirty = False
                 try:
                     # pickle on the loop (consistent view), write off-loop
-                    data = pickle.dumps(self._snapshot_state(), protocol=4)
+                    state, blobs = self._snapshot_state()
+                    data = pickle.dumps(state, protocol=4)
                     await asyncio.get_running_loop().run_in_executor(
-                        None, self._write_snapshot_bytes, data)
+                        None, self._write_snapshot_bytes, data, blobs)
                 except Exception:
                     self._dirty = True  # don't lose the mutation
                     logger.exception("GCS snapshot write failed")
@@ -260,7 +257,7 @@ class GcsServer:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         port = await self.server.start(host, port)
-        if self.persist_path:
+        if self._backend is not None:
             self._bg.append(asyncio.ensure_future(self._flush_loop()))
             self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
             # actors restored mid-placement must resume scheduling — their
@@ -275,11 +272,13 @@ class GcsServer:
     async def stop(self):
         for t in self._bg:
             t.cancel()
-        if self.persist_path and self._dirty:
+        if self._backend is not None and self._dirty:
             try:
                 self._write_snapshot()
             except Exception:
                 pass
+        if self._backend is not None:
+            self._backend.close()
         await self.server.stop()
 
     # ------------------------------------------------------------- pubsub

@@ -45,8 +45,23 @@ class JobManager:
         job_env.update(env or {})
         job_env["RAYT_ADDRESS"] = self.gcs_address
         cwd = None
+        runtime_env = dict(runtime_env) if runtime_env else None
+        container = (runtime_env or {}).pop("container", None)
+        if container and runtime_env:
+            # host-path-dependent keys can't cross the container
+            # boundary; failing loudly beats a silently wrong env
+            bad = {"pip", "py_modules"} & set(runtime_env)
+            if bad:
+                raise ValueError(
+                    f"runtime_env keys {sorted(bad)} cannot combine with "
+                    "'container' (they splice HOST paths; bake them into "
+                    "the image instead)")
         if runtime_env:
             cwd = self._apply_runtime_env(runtime_env, job_env)
+        if container:
+            entrypoint = self._containerize(
+                entrypoint, container, cwd,
+                env_vars=(runtime_env or {}).get("env_vars"))
         log_f = open(log_path, "wb")
         proc = subprocess.Popen(
             entrypoint, shell=True, stdout=log_f, stderr=subprocess.STDOUT,
@@ -99,6 +114,36 @@ class JobManager:
             job_env["PYTHONPATH"] = os.pathsep.join(
                 py_paths + ([existing] if existing else []))
         return cwd
+
+    @staticmethod
+    def _containerize(entrypoint: str, container: dict,
+                      cwd: Optional[str],
+                      env_vars: Optional[dict] = None) -> str:
+        """Wrap the driver entrypoint in a container run (ref analog:
+        _private/runtime_env/image_uri.py — job-level isolation; the
+        host-network flag keeps the driver able to dial the GCS).
+        Requires podman or docker (override: RAYT_CONTAINER_RUNTIME)."""
+        import shlex
+        import shutil
+
+        if not isinstance(container, dict) or not container.get("image"):
+            raise ValueError(
+                "runtime_env['container'] must be a dict with an 'image'")
+        runtime = os.environ.get("RAYT_CONTAINER_RUNTIME") or \
+            shutil.which("podman") or shutil.which("docker")
+        if not runtime:
+            raise RuntimeError(
+                "runtime_env['container'] requires podman or docker on "
+                "the head node (or RAYT_CONTAINER_RUNTIME); none found")
+        cmd = [runtime, "run", "--rm", "--network=host",
+               "--env", "RAYT_ADDRESS"]
+        for k, v in (env_vars or {}).items():
+            cmd += ["--env", f"{k}={v}"]
+        if cwd:
+            cmd += ["-v", f"{cwd}:/workdir", "-w", "/workdir"]
+        cmd += list(container.get("run_options") or [])
+        cmd += [container["image"], "sh", "-c", entrypoint]
+        return " ".join(shlex.quote(c) for c in cmd)
 
     def status(self, sub_id: str) -> Optional[dict]:
         job = self.jobs.get(sub_id)

@@ -191,6 +191,31 @@ def cmd_stack(args):
             print(t["stack"].rstrip())
 
 
+def cmd_profile(args):
+    """On-demand profile of one live worker (ref analog: the dashboard's
+    py-spy/memray attach): CPU samples -> collapsed stacks (flamegraph
+    input with -o), memory -> top allocation sites."""
+    from ray_tpu import state_api
+    from ray_tpu._internal import profiler
+
+    _attach(args)
+    result = state_api.profile_worker(
+        args.worker, mode=args.mode, duration_s=args.duration,
+        interval_s=args.interval)
+    if args.mode == "memory":
+        print(f"net new bytes over {result['duration_s']}s: "
+              f"{result['total_new_bytes']}")
+        for a in result["top_allocations"]:
+            print(f"{a['size_diff_bytes']:>12}  {a['location']}")
+        return
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(profiler.render_collapsed(result))
+        print(f"collapsed stacks -> {args.output} "
+              f"({result['num_samples']} samples)")
+    print(profiler.render_top(result))
+
+
 def cmd_memory(args):
     """Object report (ref analog: `ray memory`)."""
     from ray_tpu import state_api
@@ -432,6 +457,17 @@ def main(argv=None):
     sp = sub.add_parser("stack", help="stack traces of all workers")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("profile",
+                        help="sample one worker's CPU or memory live")
+    sp.add_argument("worker", help="worker or actor id (hex prefix)")
+    sp.add_argument("--mode", choices=("cpu", "memory"), default="cpu")
+    sp.add_argument("--duration", type=float, default=5.0)
+    sp.add_argument("--interval", type=float, default=0.01)
+    sp.add_argument("-o", "--output",
+                    help="write collapsed stacks for flamegraph.pl")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("memory", help="object store contents per node")
     sp.add_argument("--address")

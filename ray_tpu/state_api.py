@@ -177,6 +177,52 @@ def memory_summary() -> dict:
     }
 
 
+def profile_worker(worker_id: str, *, mode: str = "cpu",
+                   duration_s: float = 5.0,
+                   interval_s: float = 0.01) -> dict:
+    """Profile one live worker on demand (ref analog: the dashboard's
+    py-spy/memray attach, profile_manager.py:373). `worker_id` is a hex
+    prefix; matches actor ids too."""
+    from ray_tpu._internal.rpc import connect
+
+    cw = _cw()
+
+    async def fetch():
+        for n in await cw.gcs.get_all_nodes():
+            if not n.alive:
+                continue
+            conn = await connect(n.address.host, n.address.port)
+            try:
+                workers = await conn.call("list_workers", timeout=10)
+            finally:
+                await conn.close()
+            for w in workers:
+                wid = w.get("worker_id", "")
+                aid = w.get("actor_id") or ""
+                if not (wid.startswith(worker_id)
+                        or (aid and aid.startswith(worker_id))):
+                    continue
+                addr = w.get("address")
+                if not addr:
+                    continue
+                host, _, port = addr.partition(":")
+                wc = await connect(host, int(port))
+                try:
+                    out = await wc.call(
+                        "profile_worker",
+                        {"mode": mode, "duration_s": duration_s,
+                         "interval_s": interval_s},
+                        timeout=duration_s + 30)
+                finally:
+                    await wc.close()
+                out["worker_id"] = wid
+                out["node_id"] = n.node_id.hex()
+                return out
+        raise ValueError(f"no live worker matches {worker_id!r}")
+
+    return cw.io.run(fetch())
+
+
 def dump_stacks() -> list[dict]:
     """Stack traces of every registered worker on every node (ref
     analog: `ray stack`, scripts.py:1934 py-spy dump — cooperative

@@ -11,4 +11,5 @@ from ray_tpu.tune.search import (choice, grid_search, loguniform,  # noqa: F401
                                  randint, sample_from, uniform)
 from ray_tpu.tune.trial import Trial, TrialStatus  # noqa: F401
 from ray_tpu.tune.tuner import TuneConfig, Tuner  # noqa: F401
-from ray_tpu.tune.tpe import Searcher, TPESearcher  # noqa: F401,E402
+from ray_tpu.tune.tpe import (BOHBSearcher, Searcher,  # noqa: F401,E402
+                              TPESearcher)

@@ -151,6 +151,11 @@ class TuneController:
         trial.results.append(metrics)
         if entry.get("checkpoint_dir"):
             trial.checkpoint_dir = entry["checkpoint_dir"]
+        if self.search_alg is not None:
+            # multi-fidelity searchers (BOHB) model per-budget results
+            on_res = getattr(self.search_alg, "on_trial_result", None)
+            if on_res is not None:
+                on_res(trial.trial_id, metrics)
         decision = self.scheduler.on_result(trial, metrics)
         if decision == STOP:
             self._stop_trial_actor(trial)
@@ -201,6 +206,19 @@ class TuneController:
             json.dump(state, f)
         os.replace(tmp, os.path.join(self.experiment_path,
                                      "tuner_state.json"))
+        if self.search_alg is not None:
+            # searcher fidelity across restores: the model's observations
+            # and RNG resume exactly (ref: tune/execution/experiment_state
+            # searcher checkpointing)
+            try:
+                blob = cloudpickle.dumps(self.search_alg)
+                stmp = os.path.join(self.experiment_path, ".searcher.tmp")
+                with open(stmp, "wb") as f:
+                    f.write(blob)
+                os.replace(stmp, os.path.join(self.experiment_path,
+                                              "searcher_state.pkl"))
+            except Exception:
+                pass  # an unpicklable custom searcher degrades to fresh
         self._dirty = False
 
 

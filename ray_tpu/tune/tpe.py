@@ -22,6 +22,10 @@ class Searcher:
     def suggest(self, trial_id: str) -> Optional[dict]:
         raise NotImplementedError
 
+    def on_trial_result(self, trial_id: str, result: dict):
+        """Optional: observe an INTERMEDIATE result (multi-fidelity
+        searchers model per-budget performance from these)."""
+
     def on_trial_complete(self, trial_id: str, result: Optional[dict]):
         pass
 
@@ -48,8 +52,11 @@ class TPESearcher(Searcher):
         self._obs: list[tuple[dict, float]] = []  # (flat config, score)
 
     # ------------------------------------------------------------ interface
+    def _has_model(self) -> bool:
+        return len(self._obs) >= self.n_startup
+
     def suggest(self, trial_id: str) -> dict:
-        if len(self._obs) < self.n_startup:
+        if not self._has_model():
             flat = {p: leaf.sample(self.rng) for p, leaf in self._leaves}
         else:
             flat = self._suggest_tpe()
@@ -70,7 +77,8 @@ class TPESearcher(Searcher):
 
     # ------------------------------------------------------------ internals
     def _split(self):
-        ranked = sorted(self._obs, key=lambda o: o[1], reverse=True)
+        ranked = sorted(self._model_obs(), key=lambda o: o[1],
+                        reverse=True)
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
         return ranked[:n_good], ranked[n_good:] or ranked[:1]
 
@@ -105,6 +113,10 @@ class TPESearcher(Searcher):
             if r <= acc:
                 return c
         return scored[-1][1]
+
+    def _model_obs(self) -> list:
+        """Observations backing the TPE model (subclass hook)."""
+        return self._obs
 
     def _pick_numeric(self, leaf, g_vals, b_vals):
         log = isinstance(leaf, Float) and leaf.log
@@ -141,3 +153,48 @@ class TPESearcher(Searcher):
             if ratio > best_ratio:
                 best_ratio, best_x = ratio, x
         return from_internal(best_x)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB-class searcher: TPE over the HIGHEST fidelity that has
+    enough observations (ref analogs: tune/search/bohb/ TuneBOHB;
+    Falkner et al. 2018). Pair with ASHAScheduler — early rungs feed the
+    per-budget models via on_trial_result, so the model warms up from
+    cheap partial evaluations long before any trial completes."""
+
+    def __init__(self, param_space: dict, *, metric: str,
+                 mode: str = "max", budget_key: str = "training_iteration",
+                 min_points_per_budget: int = 6, **kw):
+        super().__init__(param_space, metric=metric, mode=mode, **kw)
+        self.budget_key = budget_key
+        self.min_points = min_points_per_budget
+        # budget value -> [(flat config, score), ...]
+        self._budget_obs: dict[float, list] = {}
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        flat = self._pending.get(trial_id)
+        if flat is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = float(result.get(self.budget_key, 0.0))
+        self._budget_obs.setdefault(budget, []).append((flat, score))
+
+    def _has_model(self) -> bool:
+        return super()._has_model() or any(
+            len(v) >= self.min_points for v in self._budget_obs.values())
+
+    def _model_obs(self) -> list:
+        # highest budget whose sample count supports a Parzen split —
+        # high-fidelity evidence beats plentiful low-fidelity evidence
+        for b in sorted(self._budget_obs, reverse=True):
+            if len(self._budget_obs[b]) >= self.min_points:
+                return self._budget_obs[b]
+        return self._obs or next(
+            iter(self._budget_obs.values()), [])
+
+# Searcher persistence is whole-object cloudpickle: the controller
+# checkpoints self.search_alg verbatim and Tuner.restore unpickles it
+# (controller._save_state / tuner.restore) — no separate state schema
+# to drift out of sync.

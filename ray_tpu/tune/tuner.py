@@ -91,6 +91,13 @@ class Tuner:
         with open(state_file) as f:
             state = json.load(f)
         trials = [Trial.from_snapshot(s) for s in state["trials"]]
+        searcher_file = os.path.join(path, "searcher_state.pkl")
+        restored_searcher = None
+        if os.path.exists(searcher_file):
+            import cloudpickle
+
+            with open(searcher_file, "rb") as f:
+                restored_searcher = cloudpickle.loads(f.read())
         for t in trials:
             if t.status in (TrialStatus.RUNNING, TrialStatus.ERROR):
                 t.status = TrialStatus.PENDING
@@ -99,6 +106,8 @@ class Tuner:
             storage_path=os.path.dirname(path.rstrip("/")))
         tc = tune_config or TuneConfig(metric=state.get("metric"),
                                        mode=state.get("mode") or "min")
+        if restored_searcher is not None and tc.search_alg is None:
+            tc.search_alg = restored_searcher
         return cls(trainable, tune_config=tc, run_config=run_config,
                    resources_per_trial=resources_per_trial,
                    _restored_trials=trials)

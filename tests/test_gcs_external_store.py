@@ -1,0 +1,102 @@
+"""HA-grade GCS backing store (VERDICT r5 missing #6; ref analog:
+src/ray/gcs/store_client/redis_store_client.h:107): snapshots live in
+an EXTERNAL store process, so a head restarted anywhere — not just on
+the box holding the old snapshot file — rebuilds its tables."""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._internal.ids import NodeID
+from ray_tpu.core.common import Address, NodeInfo
+
+
+class _Conn:
+    on_close: list = []
+
+    async def close(self):
+        pass
+
+
+@pytest.fixture
+def store(tmp_path):
+    """A SnapshotStoreServer running on a private event loop thread
+    (stands in for the store process on another machine)."""
+    from ray_tpu._internal.rpc import EventLoopThread
+    from ray_tpu.core.persistence import SnapshotStoreServer
+
+    io = EventLoopThread(name="test-store")
+    server = SnapshotStoreServer(str(tmp_path / "store-data"))
+    port = io.run(server.start("127.0.0.1", 0), 30)
+    yield f"rayt://127.0.0.1:{port}", server, tmp_path
+    io.run(server.stop(), 10)
+    io.stop()
+
+
+def test_backend_roundtrip(store):
+    from ray_tpu.core.persistence import make_backend
+
+    uri, _, _ = store
+    b = make_backend(uri)
+    assert b.get("snapshot") is None
+    b.put("snapshot", b"state-v1")
+    assert b.get("snapshot") == b"state-v1"
+    b.put_if_absent("blobs/abc", b"blob-bytes")
+    assert b.exists("blobs/abc")
+    assert b.get("blobs/abc") == b"blob-bytes"
+    b.close()
+
+
+def test_head_restarts_anywhere_against_external_store(store):
+    """GCS #1 writes tables to the store; GCS #2 (a fresh object — 'a
+    new machine') reloads nodes, KV, and jobs from it."""
+    from ray_tpu.core.gcs import GcsServer
+
+    uri, _, _ = store
+
+    async def first_head():
+        gcs = GcsServer(persist_path=uri)
+        nid = NodeID.random()
+        await gcs.rpc_register_node(_Conn(), NodeInfo(
+            node_id=nid, address=Address("127.0.0.1", 21001),
+            resources_total={"CPU": 8.0}))
+        gcs.rpc_kv_put(None, ("ns", "key", b"value", False))
+        # big value -> content-addressed blob in the external store
+        gcs.rpc_kv_put(None, ("ns", "big", b"x" * 600_000, False))
+        gcs.rpc_register_job(None, (None, {"name": "j1"}))
+        gcs.mark_dirty()
+        gcs._write_snapshot()
+        gcs._backend.close()
+        return nid
+
+    nid = asyncio.new_event_loop().run_until_complete(first_head())
+
+    # a brand-new head process, pointed at the same store URI
+    gcs2 = GcsServer(persist_path=uri)
+    try:
+        assert nid in gcs2.nodes
+        assert gcs2.nodes[nid].resources_total == {"CPU": 8.0}
+        assert gcs2.kv["ns"]["key"] == b"value"
+        assert gcs2.kv["ns"]["big"] == b"x" * 600_000
+        assert len(gcs2.jobs) == 1
+        # restored nodes seed the resource-sync log (delta consumers see
+        # them) — same invariant as the file backend
+        view = gcs2.rpc_get_cluster_resources_delta(None, 0)
+        entries = (view["full"] if view["full"] is not None
+                   else view["changed"])
+        assert nid.hex() in entries
+    finally:
+        gcs2._backend.close()
+
+
+def test_file_backend_layout_unchanged(tmp_path):
+    """The file backend keeps the pre-backend on-disk layout, so old
+    snapshots keep loading."""
+    from ray_tpu.core.persistence import FileSnapshotBackend
+
+    base = str(tmp_path / "snap.pkl")
+    b = FileSnapshotBackend(base)
+    b.put("snapshot", b"data")
+    b.put("blobs/deadbeef", b"blob")
+    assert (tmp_path / "snap.pkl").read_bytes() == b"data"
+    assert (tmp_path / "snap.pkl.blobs" / "deadbeef").read_bytes() == b"blob"

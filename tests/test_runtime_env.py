@@ -175,3 +175,143 @@ def test_runtime_env_plugin_api(local_cluster):
         return os.environ.get("STAMPED")
 
     assert rt.get(read.remote(), timeout=90) == "packaged:xyz"
+
+
+# ------------------------------------------------------ conda (r5, ref conda.py)
+@pytest.fixture
+def stub_conda(tmp_path, monkeypatch):
+    """A fake conda binary: `env create -p P -f F` makes a prefix with a
+    marker module in site-packages; `run -n NAME python -c ...` prints a
+    prepared named-env prefix."""
+    import stat
+    import sys as _sys
+
+    named_prefix = tmp_path / "named-env"
+    ver = f"python{_sys.version_info[0]}.{_sys.version_info[1]}"
+    (named_prefix / "lib" / ver / "site-packages").mkdir(parents=True)
+    (named_prefix / "lib" / ver / "site-packages"
+     / "named_env_marker.py").write_text("WHO = 'named'\n")
+
+    stub = tmp_path / "conda"
+    stub.write_text(f"""#!/bin/bash
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+  while [ $# -gt 0 ]; do
+    if [ "$1" = "-p" ]; then PREFIX="$2"; fi
+    shift
+  done
+  mkdir -p "$PREFIX/lib/{ver}/site-packages"
+  echo "WHO = 'spec'" > "$PREFIX/lib/{ver}/site-packages/spec_env_marker.py"
+  exit 0
+fi
+if [ "$1" = "run" ]; then
+  echo "{named_prefix}"
+  exit 0
+fi
+exit 1
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("RAYT_CONDA_EXE", str(stub))
+    yield
+
+
+def test_conda_spec_env_builds_and_splices(stub_conda, monkeypatch,
+                                           tmp_path):
+    import sys as _sys
+
+    from ray_tpu._internal import runtime_env as renv_mod
+
+    monkeypatch.setattr(renv_mod, "_CONDA_ROOT",
+                        str(tmp_path / "conda-cache"))
+    spec = renv_mod.package(
+        {"conda": {"dependencies": ["numpy", {"pip": ["x", "y"]}]}},
+        kv_put=lambda *a: None)
+    # hash is order-insensitive
+    spec2 = renv_mod.package(
+        {"conda": {"dependencies": [{"pip": ["y", "x"]}, "numpy"]}},
+        kv_put=lambda *a: None)
+    assert spec["conda"]["hash"] == spec2["conda"]["hash"]
+
+    saved = list(_sys.path)
+    try:
+        renv_mod.materialize(spec, kv_get=lambda k: None)
+        import named_env_marker  # noqa: F401  (should NOT resolve)
+    except ImportError:
+        pass
+    finally:
+        import spec_env_marker
+
+        assert spec_env_marker.WHO == "spec"
+        _sys.modules.pop("spec_env_marker", None)
+        _sys.path[:] = saved
+
+
+def test_conda_named_env_splices(stub_conda, monkeypatch):
+    import sys as _sys
+
+    from ray_tpu._internal import runtime_env as renv_mod
+
+    spec = renv_mod.package({"conda": "my-named-env"},
+                            kv_put=lambda *a: None)
+    saved = list(_sys.path)
+    try:
+        renv_mod.materialize(spec, kv_get=lambda k: None)
+        import named_env_marker
+
+        assert named_env_marker.WHO == "named"
+    finally:
+        _sys.modules.pop("named_env_marker", None)
+        _sys.path[:] = saved
+
+
+def test_conda_requires_binary(monkeypatch):
+    from ray_tpu._internal import runtime_env as renv_mod
+
+    monkeypatch.delenv("RAYT_CONDA_EXE", raising=False)
+    monkeypatch.setattr("shutil.which", lambda _: None)
+    with pytest.raises(RuntimeError, match="conda binary"):
+        renv_mod.ensure_conda_env({"name": "whatever"})
+
+
+def test_conda_and_pip_mutually_exclusive():
+    from ray_tpu._internal import runtime_env as renv_mod
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        renv_mod.validate({"conda": "env", "pip": ["numpy"]})
+
+
+# ------------------------------------------- container jobs (r5, ref image_uri.py)
+def test_job_container_wraps_entrypoint(tmp_path, monkeypatch):
+    from ray_tpu.dashboard.head import JobManager
+
+    runtime = tmp_path / "podman"
+    runtime.write_text("#!/bin/bash\necho CONTAINER-RAN \"$@\"\n")
+    runtime.chmod(0o755)
+    monkeypatch.setenv("RAYT_CONTAINER_RUNTIME", str(runtime))
+
+    jm = JobManager("127.0.0.1:0", log_dir=str(tmp_path / "logs"))
+    sub = jm.submit("echo hello-from-job",
+                    runtime_env={"container": {"image": "my/image:1"}})
+    for _ in range(100):
+        st = jm.status(sub)
+        if st["status"] != "RUNNING":
+            break
+        import time as _t
+        _t.sleep(0.05)
+    assert st["status"] == "SUCCEEDED", st
+    logs = jm.logs(sub)
+    assert "CONTAINER-RAN" in logs
+    assert "my/image:1" in logs
+    assert "--network=host" in logs
+    jm.shutdown()
+
+
+def test_job_container_requires_runtime(tmp_path, monkeypatch):
+    from ray_tpu.dashboard.head import JobManager
+
+    monkeypatch.delenv("RAYT_CONTAINER_RUNTIME", raising=False)
+    monkeypatch.setattr("shutil.which", lambda _: None)
+    jm = JobManager("127.0.0.1:0", log_dir=str(tmp_path / "logs"))
+    with pytest.raises(RuntimeError, match="podman or docker"):
+        jm.submit("echo x",
+                  runtime_env={"container": {"image": "img"}})
+    jm.shutdown()
