@@ -10,7 +10,8 @@ from ray_tpu.data.aggregate import (AggregateFn, Count, Max,  # noqa: F401
 from ray_tpu.data.dataset import (DataIterator, Dataset,  # noqa: F401
                                   from_items_rows)
 from ray_tpu.data.datasource import (read_csv, read_json,  # noqa: F401
-                                     read_parquet, read_text, write_parquet)
+                                     read_npz, read_parquet, read_text,
+                                     write_parquet)
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
 
 

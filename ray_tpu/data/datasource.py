@@ -104,3 +104,21 @@ def write_parquet(dataset, path: str) -> None:
             continue
         pq.write_table(table,
                        os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+def read_npz(paths):
+    """One columnar NumpyBlock per .npz file: the multi-dim-column
+    format (token matrices, image stacks) Arrow files can't carry.
+    Producer side: ray_tpu.rl.offline.write_offline_dataset or plain
+    np.savez of equal-length arrays."""
+    from ray_tpu.data.block import NumpyBlock
+    from ray_tpu.data.dataset import Dataset
+
+    def read_file(path: str):
+        import numpy as np
+
+        with np.load(path) as z:
+            return NumpyBlock({k: z[k] for k in z.files})
+
+    task = rt.remote(num_cpus=1)(read_file)
+    return Dataset([task.remote(p) for p in _expand(paths)])

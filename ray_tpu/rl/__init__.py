@@ -12,8 +12,9 @@ from ray_tpu.rl.learner import (JaxLearner, PPOLearnerConfig,  # noqa: F401
 from ray_tpu.rl.module import (CNNModuleConfig,  # noqa: F401
                                MLPModuleConfig, make_module_config)
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
-from ray_tpu.rl.impala import (IMPALA, AggregatorActor,  # noqa: F401
-                               IMPALAConfig, IMPALALearner)
+from ray_tpu.rl.impala import (APPOConfig, IMPALA,  # noqa: F401
+                               AggregatorActor, IMPALAConfig,
+                               IMPALALearner)
 from ray_tpu.rl.vtrace import vtrace  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNRunner  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer  # noqa: F401
@@ -23,3 +24,7 @@ from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
                                     MultiAgentVectorEnv,
                                     make_multi_agent_env,
                                     register_multi_agent_env)
+from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,  # noqa: F401
+                                collect_transitions, evaluate_policy,
+                                read_offline_dataset,
+                                write_offline_dataset)

@@ -61,10 +61,24 @@ class IMPALAConfig:
     # raise on oversubscribed hosts where a saturated core stretches
     # actor-call latency far past the defaults
     call_timeout_s: float = 120.0
+    # APPO (ref: algorithms/appo/appo.py): replace the plain V-trace
+    # policy-gradient with PPO's clipped surrogate over V-trace
+    # advantages — stale-rollout updates can't push the policy
+    # arbitrarily far, so higher broadcast_interval stays stable
+    use_appo_loss: bool = False
+    clip_eps: float = 0.2
     seed: int = 0
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    """Async PPO (ref: algorithms/appo/appo.py:64 — IMPALA's async
+    architecture + the clipped surrogate objective)."""
+    use_appo_loss: bool = True
+    broadcast_interval: int = 2
 
 
 class AggregatorActor:
@@ -169,7 +183,16 @@ class IMPALALearner:
                 batch["logp"], target_logp, batch["rewards"], values,
                 boot_value, batch["dones"], batch["trunc_values"],
                 gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
-            pg_loss = -(pg_adv * target_logp).mean()
+            if cfg.use_appo_loss:
+                # APPO: clipped surrogate on V-trace advantages
+                ratio = jnp.exp(target_logp - batch["logp"])
+                adv = jax.lax.stop_gradient(pg_adv)
+                pg_loss = -jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - cfg.clip_eps,
+                             1 + cfg.clip_eps) * adv).mean()
+            else:
+                pg_loss = -(pg_adv * target_logp).mean()
             vf_loss = 0.5 * ((values - vs) ** 2).mean()
             entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
             loss = (pg_loss + cfg.vf_coeff * vf_loss

@@ -1,0 +1,67 @@
+"""Offline RL (VERDICT r4 missing #5 breadth; ref analogs:
+rllib/offline/offline_data.py, algorithms/bc, algorithms/cql): record
+transitions through the columnar data plane, train BC and CQL purely
+from the dataset, beat the random baseline on evaluation rollouts."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+def _expert_policy(obs):
+    """CartPole heuristic: push toward the pole's fall direction —
+    ~120+ mean return (random is ~20)."""
+    theta, theta_dot = obs[:, 2], obs[:, 3]
+    return (theta + 0.5 * theta_dot > 0).astype(np.int32)
+
+
+@pytest.fixture
+def offline_dataset(local_cluster, tmp_path):
+    from ray_tpu.rl import collect_transitions, write_offline_dataset
+
+    trans = collect_transitions("CartPole-v1", _expert_policy,
+                                num_steps=6000, num_envs=8, seed=0)
+    path = str(tmp_path / "cartpole-expert")
+    n = write_offline_dataset(trans, path, shard_rows=1024)
+    assert n >= 6000
+    return path
+
+
+def test_dataset_roundtrip_columnar(offline_dataset):
+    from ray_tpu.data.block import is_numpy_block
+    from ray_tpu.rl import read_offline_dataset
+
+    ds = read_offline_dataset(offline_dataset)
+    blocks = [rt.get(r) for r in ds._iter_block_refs()]
+    assert all(is_numpy_block(b) for b in blocks)  # multi-dim obs ride
+    assert blocks[0].cols["obs"].shape[1] == 4
+    total = sum(b.num_rows for b in blocks)
+    assert total >= 6000
+    batch = next(ds.iter_batches(batch_size=256))
+    assert batch["obs"].shape == (256, 4)
+
+
+def test_bc_imitates_expert(offline_dataset):
+    from ray_tpu.rl import BCConfig, evaluate_policy
+
+    algo = BCConfig(dataset_path=offline_dataset,
+                    epochs_per_iteration=2, lr=3e-3, seed=0).build()
+    losses = []
+    for _ in range(4):
+        r = algo.train()
+        losses.append(r["loss"])
+    assert r["loss"] < losses[0]  # fitting the expert, monotone-ish
+    score = algo.evaluate(num_episodes=10)
+    assert score > 60, score  # random is ~20; heuristic ~120
+
+
+def test_cql_learns_from_offline_data(offline_dataset):
+    from ray_tpu.rl import CQLConfig
+
+    algo = CQLConfig(dataset_path=offline_dataset,
+                     updates_per_iteration=400, seed=0).build()
+    for _ in range(3):
+        r = algo.train()
+    score = algo.evaluate(num_episodes=10)
+    assert score > 60, score
