@@ -27,18 +27,27 @@ SEQ = 2048
 STEPS = 15
 
 CONFIGS = [
-    # (preset, batch, remat_policy)
-    ("410m", 8, "dots"),          # round-3 champion (21.4k tok/s)
-    ("410m", 8, "nothing"),       # recompute-cost A/B at equal batch
-    ("410m", 16, "nothing"),      # the batch headroom "dots" OOMs on
-    ("410m", 24, "nothing"),
-    ("410m-hd128", 8, "dots"),    # MXU-aligned head_dim
-    ("410m-hd128", 16, "nothing"),
-    ("410m-hd128", 24, "nothing"),
+    # (preset, batch, remat_policy, attn_impl)
+    ("410m", 8, "dots", "flash"),       # round-3 champion (21.4k tok/s)
+    ("410m", 8, "nothing", "flash"),    # recompute A/B at equal batch
+    ("410m", 16, "nothing", "flash"),   # the batch headroom "dots" OOMs on
+    ("410m", 24, "nothing", "flash"),
+    # MXU-aligned head_dim. Flash at d=128 wedges THIS env's remote
+    # compile helper (PERF.md "hd128 dead end") — try it first with a
+    # tight timeout, but ALSO measure hd128 via plain XLA attention:
+    # XLA lowers d=128 attention natively (no mosaic), and a full-width
+    # contraction may beat flash-at-half-width even without the fused
+    # kernel. Untried on chip as of round 4.
+    ("410m-hd128", 8, "dots", "xla"),
+    ("410m-hd128", 16, "nothing", "xla"),
+    ("410m-hd128", 24, "nothing", "xla"),
+    ("410m-hd128", 8, "dots", "flash"),
+    ("410m-hd128", 16, "nothing", "flash"),
 ]
 
 
-def measure(preset: str, batch: int, policy: str) -> dict:
+def measure(preset: str, batch: int, policy: str,
+            attn_impl: str = "flash") -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -48,7 +57,7 @@ def measure(preset: str, batch: int, policy: str) -> dict:
     from ray_tpu.parallel.spmd import build_train_step, shard_batch
 
     cfg = llama.config_for(preset, max_seq_len=SEQ, remat=True,
-                           remat_policy=policy, attn_impl="flash")
+                           remat_policy=policy, attn_impl=attn_impl)
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     step, state = build_train_step(
@@ -74,13 +83,20 @@ def measure(preset: str, batch: int, policy: str) -> dict:
 def main():
     budget = float(os.environ.get("RAYT_SWEEP_TIMEOUT_S", "900"))
     results = []
-    for preset, batch, policy in CONFIGS:
-        label = {"preset": preset, "batch": batch, "policy": policy}
+    for preset, batch, policy, attn in CONFIGS:
+        label = {"preset": preset, "batch": batch, "policy": policy,
+                 "attn": attn}
+        # flash at hd128 is known to wedge this env's compile helper:
+        # give it a short leash so the sweep's budget goes to configs
+        # that can actually finish
+        cfg_budget = (min(budget, 420.0)
+                      if attn == "flash" and "hd128" in preset
+                      else budget)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--one",
-                 preset, str(batch), policy],
-                capture_output=True, text=True, timeout=budget)
+                 preset, str(batch), policy, attn],
+                capture_output=True, text=True, timeout=cfg_budget)
         except subprocess.TimeoutExpired:
             print(json.dumps({"cfg": label, "error": "timeout"}),
                   flush=True)
@@ -101,7 +117,8 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) >= 5 and sys.argv[1] == "--one":
-        print(json.dumps(measure(sys.argv[2], int(sys.argv[3]),
-                                 sys.argv[4])), flush=True)
+        print(json.dumps(measure(
+            sys.argv[2], int(sys.argv[3]), sys.argv[4],
+            sys.argv[5] if len(sys.argv) > 5 else "flash")), flush=True)
     else:
         main()
