@@ -104,6 +104,9 @@ class TaskSpec:
     # jax.Array returns stay in the executing worker's device memory and
     # the owner records a device-object ref (core/device_objects.py).
     tensor_transport: bool = False
+    # W3C traceparent carrier (ref: _private/tracing _inject_tracing):
+    # links the executing worker's OTel span to the submitter's trace.
+    trace_ctx: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -61,6 +61,16 @@ def _dumps_code(fn) -> bytes:
     return dumps_code(fn)
 
 
+def _trace_carrier():
+    """Active OTel span context for TaskSpec.trace_ctx (None when
+    tracing is off — the common, zero-overhead case)."""
+    from ray_tpu._internal import otel
+
+    if not otel.tracing_enabled():
+        return None
+    return otel.current_context_carrier()
+
+
 @dataclass
 class RefArg:
     """Marker for an ObjectRef positioned as a top-level task argument."""
@@ -910,7 +920,8 @@ class CoreWorker:
             retry_exceptions=options.retry_exceptions,
             scheduling_strategy=options.scheduling_strategy,
             runtime_env=self._package_runtime_env(options.runtime_env),
-            tensor_transport=options.tensor_transport)
+            tensor_transport=options.tensor_transport,
+            trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
         self._spawn_from_thread(self._run_normal_task(spec))
         if spec.num_returns == -1:
@@ -1338,7 +1349,8 @@ class CoreWorker:
             owner=self.worker_info, actor_id=actor_id,
             is_actor_creation=True, actor_options=options,
             scheduling_strategy=options.scheduling_strategy,
-            runtime_env=self._package_runtime_env(options.runtime_env))
+            runtime_env=self._package_runtime_env(options.runtime_env),
+            trace_ctx=_trace_carrier())
         self.io.run(self.gcs.register_actor(spec))
         return actor_id
 
@@ -1370,7 +1382,8 @@ class CoreWorker:
             resources={}, owner=self.worker_info,
             max_retries=max_retries,
             actor_id=actor_id, method_name=method_name,
-            tensor_transport=options.tensor_transport)
+            tensor_transport=options.tensor_transport,
+            trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
         sub = self.get_actor_submitter(actor_id)
         self._spawn_from_thread(sub.submit(spec))
@@ -1476,8 +1489,18 @@ class CoreWorker:
             self.executor, self._execute_task, spec)
 
     def _execute_task(self, spec: TaskSpec):
+        from ray_tpu._internal import otel
+
         t_wall, t0 = time.time(), time.perf_counter()
-        out = self._execute_task_body(spec)
+        # execution span parents remotely on the submitter's span: one
+        # trace id across the whole task tree (ref: _private/tracing
+        # _wrap_task_execution). No-op context when tracing is off.
+        with otel.execute_span(
+                spec.name or "task", getattr(spec, "trace_ctx", None),
+                task_id=spec.task_id.hex()) as sp:
+            out = self._execute_task_body(spec)
+            sp["ok"] = not (isinstance(out, tuple) and out
+                            and out[0] == "task_error")
         self.task_events.record(
             name=spec.name or "task", task_id=spec.task_id.hex(),
             kind="task", start_s=t_wall, dur_s=time.perf_counter() - t0,
@@ -1627,22 +1650,36 @@ class CoreWorker:
     async def _run_async_method(self, spec: TaskSpec):
         import inspect
 
+        from ray_tpu._internal import otel
+
         self._exec_ctx.task_id = spec.task_id
-        try:
-            method = getattr(self.actor_instance, spec.method_name)
-            args = self._resolve_args_async(spec.args)
-            kwargs = self._resolve_args_async(spec.kwargs)
-            if spec.num_returns == -1 and inspect.isasyncgenfunction(method):
-                return await self._stream_returns_async(
-                    spec, method(*args, **kwargs))
-            result = await method(*args, **kwargs)
-            if spec.num_returns == -1:
-                return await self._stream_returns_async(spec, result)
-            return self._package_returns(spec, result)
-        except Exception as e:
-            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
-        finally:
-            self._exec_ctx.task_id = None
+        # span covers the async execution path too (trace ids stay
+        # consistent; interleaved async spans are handled by the
+        # tracer's entry-removal discipline)
+        with otel.execute_span(
+                spec.method_name or "actor_task",
+                getattr(spec, "trace_ctx", None),
+                task_id=spec.task_id.hex(),
+                actor_id=(self.actor_id.hex()
+                          if self.actor_id else "")) as sp:
+            try:
+                method = getattr(self.actor_instance, spec.method_name)
+                args = self._resolve_args_async(spec.args)
+                kwargs = self._resolve_args_async(spec.kwargs)
+                if spec.num_returns == -1 and \
+                        inspect.isasyncgenfunction(method):
+                    return await self._stream_returns_async(
+                        spec, method(*args, **kwargs))
+                result = await method(*args, **kwargs)
+                if spec.num_returns == -1:
+                    return await self._stream_returns_async(spec, result)
+                return self._package_returns(spec, result)
+            except Exception as e:
+                sp["ok"] = False
+                return ("task_error", serialize_to_bytes(e),
+                        traceback.format_exc())
+            finally:
+                self._exec_ctx.task_id = None
 
     def _resolve_args_async(self, args):
         # async path: refs resolved via blocking get on a worker thread would
@@ -1651,8 +1688,18 @@ class CoreWorker:
         return self._resolve_args(args)
 
     def _execute_actor_task(self, spec: TaskSpec):
+        from ray_tpu._internal import otel
+
         t_wall, t0 = time.time(), time.perf_counter()
-        out = self._execute_actor_task_body(spec)
+        with otel.execute_span(
+                spec.method_name or "actor_task",
+                getattr(spec, "trace_ctx", None),
+                task_id=spec.task_id.hex(),
+                actor_id=(self.actor_id.hex()
+                          if self.actor_id else "")) as sp:
+            out = self._execute_actor_task_body(spec)
+            sp["ok"] = not (isinstance(out, tuple) and out
+                            and out[0] == "task_error")
         self.task_events.record(
             name=spec.method_name or "actor_task",
             task_id=spec.task_id.hex(), kind="actor_task",

@@ -1,0 +1,77 @@
+"""Distributed OpenTelemetry spans (VERDICT §5 tracing gap; ref analog:
+python/ray/_private/tracing): submit-side context rides TaskSpec, the
+executing worker's span joins the same trace as a remote child."""
+
+import os
+
+import pytest
+
+import ray_tpu as rt
+
+
+def test_cross_process_trace_propagation(tmp_path, monkeypatch):
+    trace_dir = str(tmp_path / "spans")
+    monkeypatch.setenv("RAYT_TRACING_DIR", trace_dir)
+    # fresh per-test gate resolution in THIS process
+    from ray_tpu._internal import otel
+
+    monkeypatch.setattr(otel, "_enabled", None)
+    monkeypatch.setattr(otel, "_out_path", None)
+
+    rt.init()
+    try:
+        assert otel.tracing_enabled()
+
+        @rt.remote
+        def traced(x):
+            return x + 1
+
+        with otel.submit_span("driver-root"):
+            ref = traced.remote(41)
+            assert rt.get(ref, timeout=60) == 42
+
+        @rt.remote
+        class A:
+            def m(self):
+                return "ok"
+
+        a = A.remote()
+        with otel.submit_span("driver-actor"):
+            assert rt.get(a.m.remote(), timeout=60) == "ok"
+    finally:
+        rt.shutdown()
+
+    spans = otel.read_spans(trace_dir)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # the worker's execution span exists and shares the DRIVER's trace
+    root = by_name["driver-root"][0]
+    execs = by_name.get("execute traced", [])
+    assert execs, sorted(by_name)
+    assert execs[0]["trace_id"] == root["trace_id"]
+    assert execs[0]["parent_id"] == root["span_id"]
+    actor_root = by_name["driver-actor"][0]
+    actor_execs = by_name.get("execute m", [])
+    assert actor_execs and \
+        actor_execs[0]["trace_id"] == actor_root["trace_id"]
+
+
+def test_tracing_off_is_noop(tmp_path, local_cluster):
+    """With tracing off, the span context managers are no-ops and no
+    span files appear anywhere near the run."""
+    from ray_tpu._internal import otel
+
+    if os.environ.get("RAYT_TRACING_DIR"):
+        pytest.skip("tracing enabled in ambient env")
+    assert otel.tracing_enabled() is False
+
+    @rt.remote
+    def f(x):
+        return x
+
+    with otel.submit_span("noop") as sp:
+        assert rt.get(f.remote(1), timeout=60) == 1
+        assert sp == {"ok": True}  # nullcontext handle, nothing recorded
+    assert otel._out_path is None
+    assert not list(tmp_path.glob("*.spans.jsonl"))
