@@ -48,10 +48,26 @@ class _Request:
 
 @dataclass
 class _Slot:
-    """One occupied decode slot: a request mid-generation."""
+    """One occupied decode slot: a request mid-generation.
+    emitted == -1 marks a slot RESERVED by an in-progress chunked
+    prefill: decode steps skip it, refill can't double-book it."""
     req: _Request
     emitted: int = 0
     length: int = 0  # host view of the row's cache depth
+
+
+@dataclass
+class _PendingPrefill:
+    """A long prompt being prefilled one chunk per engine round, so
+    active decode streams keep emitting between chunks (vLLM-style
+    chunked prefill; no reference analog — TPU-native static shapes:
+    one trace per (chunk, bucket) pair)."""
+    req: _Request
+    slot: int
+    prompts: Any            # np [1, bucket]
+    small: Any              # per-request prefill cache
+    bucket: int
+    pos: int = 0            # tokens already prefilled
 
 
 class LLMEngine:
@@ -71,6 +87,7 @@ class LLMEngine:
     def __init__(self, preset: str = "debug", *, tp: int | None = None,
                  max_batch: int = 4, max_seq_len: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 128, 512, 1024),
+                 prefill_chunk: int = 256,
                  eos_token_id: int | None = None,
                  params: Any = None, seed: int = 0):
         devices = jax.devices()
@@ -81,6 +98,10 @@ class LLMEngine:
             cfg = llama.config_for(preset, max_seq_len=max_seq_len)
         self.cfg = cfg
         self.max_batch = max_batch
+        # chunked prefill: prompts longer than this prefill one chunk
+        # per engine round instead of stalling decode for the whole
+        # prompt (0 disables)
+        self.prefill_chunk = int(prefill_chunk)
         self.prompt_buckets = tuple(
             b for b in prompt_buckets if b < cfg.max_seq_len) or (
                 cfg.max_seq_len // 2,)
@@ -145,10 +166,12 @@ class LLMEngine:
         self._cur = jnp.zeros((max_batch,), jnp.int32)
         self._temps = jnp.zeros((max_batch, 1), jnp.float32)
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
+        self._pending_prefills: list[_PendingPrefill] = []
         # perf counters (for the serve bench)
         self.generated_tokens = 0
         self.batches = 0       # decode steps executed
         self.prefills = 0
+        self.prefill_chunks = 0
 
     # ------------------------------------------------------------ serving
     async def ensure_started(self):
@@ -177,6 +200,9 @@ class LLMEngine:
                 for s_ in self._slots:
                     if s_ is not None:
                         _notify(s_.req)
+                for pf in self._pending_prefills:
+                    _notify(pf.req)
+                self._pending_prefills = []
                 if self._queue is not None:
                     while True:
                         try:
@@ -239,7 +265,18 @@ class LLMEngine:
             while (not queue.empty()
                    and any(s is None for s in self._slots)):
                 await _admit(queue.get_nowait())
-            if any(s is not None for s in self._slots):
+            if self._pending_prefills:
+                # one chunk per round: a long prompt costs active
+                # streams ~one chunk of latency per step, not the
+                # whole-prompt stall
+                try:
+                    await loop.run_in_executor(
+                        None, self._advance_prefill, epoch)
+                except Exception:
+                    if epoch != self._epoch:
+                        return
+            if any(s is not None and s.emitted >= 0
+                   for s in self._slots):
                 try:
                     await loop.run_in_executor(
                         None, self._decode_step_all, epoch)
@@ -288,13 +325,70 @@ class LLMEngine:
         small = llama.init_kv_cache(cfg, 1, max_len=bucket)
         small["start"] = jnp.asarray([bucket - len(toks)], jnp.int32)
         small = jax.device_put(small, self._cache_sharding)
+        if self.prefill_chunk and bucket > self.prefill_chunk:
+            # long prompt: reserve the slot, prefill chunk-by-chunk
+            # between decode steps (engine loop drives _advance_prefill).
+            # Left-pad chunks are skipped entirely: they carry no
+            # information (masked by `start`), so begin at the last
+            # chunk boundary before the first real token.
+            skip = ((bucket - len(toks)) // self.prefill_chunk
+                    ) * self.prefill_chunk
+            if skip:
+                small["length"] = jnp.int32(skip)
+            self._slots[slot] = _Slot(req, emitted=-1, length=0)
+            self._pending_prefills.append(_PendingPrefill(
+                req=req, slot=slot, prompts=prompts, small=small,
+                bucket=bucket, pos=skip))
+            return
         temps1 = jnp.asarray([[req.temperature]], np.float32)
         nxt, small, self._key = self._step(
             self.params, small, jnp.asarray(prompts), self._key, temps1)
-        first = int(np.asarray(nxt)[0])
         self.prefills += 1
+        self._finish_prefill(req, slot, small, int(np.asarray(nxt)[0]),
+                             bucket, bucket - len(toks))
 
-        # deliver the prefill's token before joining the decode batch
+    def _advance_prefill(self, epoch: int):
+        with self._mutex:
+            if epoch != self._epoch or not self._pending_prefills:
+                return
+            pf = self._pending_prefills[0]
+            try:
+                chunk = min(self.prefill_chunk, pf.bucket - pf.pos)
+                tokens = jnp.asarray(pf.prompts[:, pf.pos:pf.pos + chunk])
+                temps1 = jnp.asarray([[pf.req.temperature]], np.float32)
+                nxt, pf.small, self._key = self._step(
+                    self.params, pf.small, tokens, self._key, temps1)
+                pf.pos += chunk
+                self.prefill_chunks += 1
+                if pf.pos < pf.bucket:
+                    return
+                self._pending_prefills.pop(0)
+                self.prefills += 1
+                self._slots[pf.slot] = None  # release the reservation
+                self._finish_prefill(
+                    pf.req, pf.slot, pf.small, int(np.asarray(nxt)[0]),
+                    pf.bucket, pf.bucket - len(pf.req.tokens))
+            except BaseException as e:
+                # a failed chunk step donated pf.small's buffers, and a
+                # failed final insert already removed pf from the lists
+                # _poison_recover notifies — either way, retrying is
+                # impossible and the consumer must hear about it
+                if self._pending_prefills and \
+                        self._pending_prefills[0] is pf:
+                    self._pending_prefills.pop(0)
+                if self._slots[pf.slot] is not None and \
+                        self._slots[pf.slot].emitted < 0:
+                    self._slots[pf.slot] = None
+                pf.req.loop.call_soon_threadsafe(
+                    pf.req.out.put_nowait,
+                    e if isinstance(e, Exception)
+                    else RuntimeError(repr(e)))
+                raise
+
+    def _finish_prefill(self, req: _Request, slot: int, small, first: int,
+                        bucket: int, start: int):
+        """Deliver the prefill's sampled token and graft the KV rows
+        into the slot (callers hold _mutex)."""
         if self.eos_token_id is not None and first == self.eos_token_id:
             req.loop.call_soon_threadsafe(req.out.put_nowait, None)
             return
@@ -303,12 +397,10 @@ class LLMEngine:
         if req.max_new_tokens <= 1:
             req.loop.call_soon_threadsafe(req.out.put_nowait, None)
             return
-
         try:
             self._decode_cache = self._insert_row(
                 self._decode_cache, small["k"], small["v"],
-                jnp.int32(slot), jnp.int32(bucket),
-                jnp.int32(bucket - len(toks)))
+                jnp.int32(slot), jnp.int32(bucket), jnp.int32(start))
         except BaseException:
             # insert_row donates the shared cache: a failure here loses
             # every active slot's KV, not just the new request's
@@ -327,6 +419,9 @@ class LLMEngine:
         for s in self._slots:
             if s is not None:
                 s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, err)
+        for pf in self._pending_prefills:
+            pf.req.loop.call_soon_threadsafe(pf.req.out.put_nowait, err)
+        self._pending_prefills = []
         self._slots = [None] * self.max_batch
         self._decode_cache = None
         self._cur = jnp.zeros((self.max_batch,), jnp.int32)
@@ -352,7 +447,7 @@ class LLMEngine:
         self._cur = nxt  # stays on device for the next step
         self.batches += 1
         for i, s in enumerate(self._slots):
-            if s is None:
+            if s is None or s.emitted < 0:  # free or mid-prefill
                 continue
             t = int(toks[i])
             s.length += 1
@@ -370,6 +465,7 @@ class LLMEngine:
         return {"generated_tokens": self.generated_tokens,
                 "batches": self.batches,
                 "prefills": self.prefills,
+                "prefill_chunks": self.prefill_chunks,
                 "active_slots": sum(1 for s in self._slots
                                     if s is not None),
                 "tp": self.mesh.shape.get("tensor", 1)}
