@@ -6,7 +6,9 @@ from ray_tpu.rl.connectors import (CastF32, Connector,  # noqa: F401
                                    ConnectorPipeline, FlattenObs,
                                    NormalizeImage)
 from ray_tpu.rl.env import (CartPoleVectorEnv, CatchVectorEnv,  # noqa: F401
-                            VectorEnv, make_vector_env, register_env)
+                            LineReachVectorEnv, PendulumVectorEnv,
+                            VectorEnv, make_vector_env, register_env,
+                            require_discrete)
 from ray_tpu.rl.learner import (JaxLearner, PPOLearnerConfig,  # noqa: F401
                                 compute_gae)
 from ray_tpu.rl.module import (CNNModuleConfig,  # noqa: F401
@@ -24,6 +26,7 @@ from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
                                     MultiAgentVectorEnv,
                                     make_multi_agent_env,
                                     register_multi_agent_env)
+from ray_tpu.rl.sac import SAC, SACConfig, SACRunner  # noqa: F401
 from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,  # noqa: F401
                                 collect_transitions, evaluate_policy,
                                 read_offline_dataset,

@@ -19,12 +19,12 @@ import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
-from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env import make_vector_env, require_discrete
 from ray_tpu.rl.module import MLPModuleConfig
-from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.replay import ReplayBuffer, ReplayRolloutMixin
 
 
-class DQNRunner:
+class DQNRunner(ReplayRolloutMixin):
     """Epsilon-greedy rollout actor producing replay transitions."""
 
     def __init__(self, env_name: str, num_envs: int, seed: int,
@@ -54,48 +54,18 @@ class DQNRunner:
         from ray_tpu.rl import module as rlm
 
         assert self._params is not None, "set_weights first"
-        T, N = num_steps, self.env.num_envs
-        obs_l, act_l, rew_l, nxt_l, done_l = [], [], [], [], []
-        for _ in range(T):
-            q, _ = rlm.forward(self._params, jnp.asarray(self._obs))
+        N = self.env.num_envs
+
+        def select(obs):
+            q, _ = rlm.forward(self._params, jnp.asarray(obs))
             greedy = np.asarray(jnp.argmax(q, axis=-1))
             explore = self._rng.random(N) < epsilon
-            action = np.where(
+            return np.where(
                 explore,
                 self._rng.integers(0, self.module_cfg.num_actions, N),
                 greedy).astype(np.int32)
-            obs_l.append(self._obs.copy())
-            (next_obs, reward, terminated, truncated,
-             final_obs) = self.env.step(action)
-            # truncation is NOT a terminal for bootstrapping: done only on
-            # true termination; the stored next_obs of a truncated env is
-            # its final_obs (rllib truncation semantics)
-            truncated = truncated & ~terminated
-            stored_next = next_obs.copy()
-            if truncated.any():
-                idxs = np.nonzero(truncated)[0]
-                stored_next[idxs] = final_obs[idxs]
-            act_l.append(action)
-            rew_l.append(reward.astype(np.float32))
-            nxt_l.append(stored_next)
-            done_l.append(terminated.copy())
-            self._ep_return += reward
-            for i in np.nonzero(terminated | truncated)[0]:
-                self._completed.append(float(self._ep_return[i]))
-                self._ep_return[i] = 0.0
-            self._obs = next_obs
-        completed, self._completed = self._completed, []
-        return {
-            "transitions": {
-                "obs": np.concatenate(obs_l),
-                "actions": np.concatenate(act_l),
-                "rewards": np.concatenate(rew_l),
-                "next_obs": np.concatenate(nxt_l),
-                "dones": np.concatenate(done_l),
-            },
-            "episode_returns": completed,
-            "steps": T * N,
-        }
+
+        return self._rollout(num_steps, select)
 
     def ping(self) -> bool:
         return True
@@ -133,6 +103,7 @@ class DQN:
 
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
+        require_discrete(probe, "DQN")
         self.module_cfg = MLPModuleConfig(
             observation_size=probe.observation_size,
             num_actions=probe.num_actions, hidden=config.hidden)

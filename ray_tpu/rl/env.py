@@ -15,6 +15,11 @@ class VectorEnv:
     num_envs: int
     observation_size: int
     num_actions: int
+    # continuous-action envs set these instead of num_actions (SAC path):
+    # actions are float arrays [n, action_size] in [-action_high, action_high]
+    continuous: bool = False
+    action_size: int = 0
+    action_high: float = 1.0
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         raise NotImplementedError
@@ -156,8 +161,115 @@ class CatchVectorEnv(VectorEnv):
         return self._render(), reward, terminated, truncated, final_obs
 
 
+class PendulumVectorEnv(VectorEnv):
+    """Inverted-pendulum swing-up with a continuous torque action
+    (dynamics match gymnasium's Pendulum-v1: obs [cos th, sin th, thdot],
+    torque in [-2, 2], 200-step truncation, never terminates)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    GRAVITY = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    MAX_STEPS = 200
+
+    continuous = True
+    action_size = 1
+    action_high = MAX_TORQUE
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_size = 3
+        self.num_actions = 0
+        self._rng = np.random.RandomState(seed)
+        self._theta = np.zeros(num_envs, np.float64)
+        self._thdot = np.zeros(num_envs, np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._thdot], axis=1).astype(np.float32)
+
+    def _reset_envs(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._theta[mask] = self._rng.uniform(-np.pi, np.pi, n)
+            self._thdot[mask] = self._rng.uniform(-1.0, 1.0, n)
+            self._steps[mask] = 0
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._reset_envs(np.ones(self.num_envs, bool))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._theta, self._thdot
+        # angle normalized to [-pi, pi] for the cost
+        th_norm = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        g, m, ln, dt = self.GRAVITY, self.MASS, self.LENGTH, self.DT
+        thdot = thdot + (3 * g / (2 * ln) * np.sin(th)
+                         + 3.0 / (m * ln ** 2) * u) * dt
+        thdot = np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = th + thdot * dt
+        self._thdot = thdot
+        self._steps += 1
+        terminated = np.zeros(self.num_envs, bool)
+        truncated = self._steps >= self.MAX_STEPS
+        final_obs = self._obs()
+        self._reset_envs(truncated)
+        return (self._obs(), -cost.astype(np.float32),
+                terminated, truncated, final_obs)
+
+
+class LineReachVectorEnv(VectorEnv):
+    """One-step continuous bandit: observe a target t ~ U(-1, 1), act with
+    a in [-1, 1], reward -(a - 0.7 t)^2, episode ends. The optimal policy
+    mean is 0.7*obs — a fast deterministic learning gate for SAC-style
+    actor-critic on a single-core CI host (Pendulum needs ~10k steps)."""
+
+    continuous = True
+    action_size = 1
+    action_high = 1.0
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_size = 1
+        self.num_actions = 0
+        self._rng = np.random.RandomState(seed)
+        self._target = np.zeros(num_envs, np.float64)
+
+    def _spawn(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._target[mask] = self._rng.uniform(-1.0, 1.0, n)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._spawn(np.ones(self.num_envs, bool))
+        return self._target[:, None].astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        a = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -1.0, 1.0)
+        reward = -((a - 0.7 * self._target) ** 2).astype(np.float32)
+        terminated = np.ones(self.num_envs, bool)
+        truncated = np.zeros(self.num_envs, bool)
+        final_obs = self._target[:, None].astype(np.float32)
+        self._spawn(terminated)
+        return (self._target[:, None].astype(np.float32), reward,
+                terminated, truncated, final_obs)
+
+
 _ENV_REGISTRY = {"CartPole-v1": CartPoleVectorEnv,
-                 "Catch-v0": CatchVectorEnv}
+                 "Catch-v0": CatchVectorEnv,
+                 "Pendulum-v1": PendulumVectorEnv,
+                 "LineReach-v0": LineReachVectorEnv}
 
 
 def register_env(name: str, creator):
@@ -169,3 +281,14 @@ def make_vector_env(name: str, num_envs: int, seed: int = 0) -> VectorEnv:
     if name not in _ENV_REGISTRY:
         raise KeyError(f"unknown env {name!r}; register_env() it first")
     return _ENV_REGISTRY[name](num_envs, seed)
+
+
+def require_discrete(env: VectorEnv, algo: str):
+    """Fail fast when a discrete-action algorithm is pointed at a
+    continuous env (the SAC constructor guards the reverse direction —
+    without this the failure is an opaque zero-width-head jax shape
+    error deep inside the first forward pass)."""
+    if env.continuous:
+        raise ValueError(
+            f"{algo} needs a discrete-action env; this one is continuous "
+            f"(action_size={env.action_size}) — use SAC")

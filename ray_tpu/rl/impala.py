@@ -24,7 +24,7 @@ import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
-from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env import make_vector_env, require_discrete
 from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.module import MLPModuleConfig
 
@@ -282,6 +282,7 @@ class IMPALA:
 
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
+        require_discrete(probe, type(self).__name__)
         obs_shape = getattr(probe, "observation_shape", None)
         if obs_shape is not None:
             # image env -> CNN module (config #4's Atari-shaped path)

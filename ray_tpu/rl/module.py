@@ -77,12 +77,7 @@ def init_params(cfg, key: jax.Array) -> dict:
         return _init_cnn(cfg, key)
     dims = (cfg.observation_size,) + tuple(cfg.hidden)
     keys = jax.random.split(key, len(dims) + 1)
-    torso = [
-        {"w": (jax.random.normal(k, (a, b))
-               * math.sqrt(2.0 / a)).astype(jnp.float32),
-         "b": jnp.zeros((b,), jnp.float32)}
-        for k, a, b in zip(keys, dims[:-1], dims[1:])
-    ]
+    torso = _mlp_params(dims, keys)
     h = dims[-1]
     return {"torso": torso,
             **_head_params(h, cfg.num_actions, keys[-2], keys[-1])}
@@ -138,6 +133,85 @@ def forward(params: dict, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
     return logits, value
+
+
+# --- continuous control (SAC path; ref analog: the actor/critic nets in
+# rllib/algorithms/sac/torch/default_sac_torch_rl_module.py — squashed
+# Gaussian actor + twin Q critics, re-derived as jax pytrees) ---
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousModuleConfig:
+    observation_size: int
+    action_size: int
+    action_high: float = 1.0
+    hidden: tuple = (64, 64)
+
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+def _mlp_params(dims: tuple, keys) -> list:
+    return [
+        {"w": (jax.random.normal(k, (a, b))
+               * math.sqrt(2.0 / a)).astype(jnp.float32),
+         "b": jnp.zeros((b,), jnp.float32)}
+        for k, a, b in zip(keys, dims[:-1], dims[1:])
+    ]
+
+
+def _mlp_forward(layers: list, x: jax.Array) -> jax.Array:
+    for layer in layers[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+def init_continuous_params(cfg: ContinuousModuleConfig, key: jax.Array):
+    """-> {"actor", "q1", "q2"}: actor maps obs -> [mean, log_std] (2*A
+    outputs); each critic maps concat(obs, action) -> scalar Q."""
+    ka, k1, k2 = jax.random.split(key, 3)
+    A = cfg.action_size
+    actor_dims = (cfg.observation_size,) + tuple(cfg.hidden) + (2 * A,)
+    q_dims = (cfg.observation_size + A,) + tuple(cfg.hidden) + (1,)
+    return {
+        "actor": _mlp_params(actor_dims,
+                             jax.random.split(ka, len(actor_dims))),
+        "q1": _mlp_params(q_dims, jax.random.split(k1, len(q_dims))),
+        "q2": _mlp_params(q_dims, jax.random.split(k2, len(q_dims))),
+    }
+
+
+def actor_forward(actor_params: list, obs: jax.Array):
+    """-> (mean [B, A], log_std [B, A]) of the pre-squash Gaussian."""
+    out = _mlp_forward(actor_params, obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def q_forward(q_params: list, obs: jax.Array, action: jax.Array) -> jax.Array:
+    """-> Q values [B]."""
+    return _mlp_forward(q_params, jnp.concatenate([obs, action],
+                                                  axis=-1))[:, 0]
+
+
+def sample_squashed(mean: jax.Array, log_std: jax.Array, key: jax.Array,
+                    action_high: float = 1.0):
+    """Reparameterized tanh-Gaussian sample -> (action [B, A], logp [B]).
+
+    logp includes the tanh change-of-variables correction
+    (log det = sum 2*(log2 - u - softplus(-2u)), the numerically stable
+    form), and the action-scale log|action_high| term."""
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    # diagonal Gaussian log-density of u
+    logp = -0.5 * (((u - mean) / std) ** 2
+                   + 2.0 * log_std + math.log(2.0 * math.pi))
+    logp = logp.sum(axis=-1)
+    # tanh squash correction, per dimension
+    logp -= (2.0 * (math.log(2.0) - u
+                    - jax.nn.softplus(-2.0 * u))).sum(axis=-1)
+    if action_high != 1.0:
+        logp -= mean.shape[-1] * math.log(action_high)
+    return jnp.tanh(u) * action_high, logp
 
 
 def sample_actions(params: dict, obs: np.ndarray, key: jax.Array):

@@ -14,7 +14,7 @@ import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
-from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env import make_vector_env, require_discrete
 from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.learner import JaxLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rl.module import MLPModuleConfig
@@ -58,6 +58,7 @@ class PPO:
 
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
+        require_discrete(probe, "PPO")
         obs_shape = getattr(probe, "observation_shape", None)
         if obs_shape is not None:
             self.module_cfg = CNNModuleConfig(

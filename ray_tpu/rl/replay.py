@@ -13,6 +13,52 @@ from __future__ import annotations
 import numpy as np
 
 
+class ReplayRolloutMixin:
+    """Shared rollout loop for off-policy runner actors (DQN/SAC). The
+    host class provides `self.env`, `self._obs`, `self._ep_return`,
+    `self._completed`; action selection is the only per-algorithm part.
+
+    Truncation semantics (rllib's): truncation is NOT a terminal for
+    bootstrapping — `dones` records true terminations only, and the
+    stored next_obs of a truncated env is its pre-reset final_obs so the
+    critic can bootstrap from the real final state."""
+
+    def _rollout(self, num_steps: int, select_action) -> dict:
+        env = self.env
+        obs_l, act_l, rew_l, nxt_l, done_l = [], [], [], [], []
+        for _ in range(num_steps):
+            action = select_action(self._obs)
+            obs_l.append(self._obs.copy())
+            (next_obs, reward, terminated, truncated,
+             final_obs) = env.step(action)
+            truncated = truncated & ~terminated
+            stored_next = next_obs.copy()
+            if truncated.any():
+                idxs = np.nonzero(truncated)[0]
+                stored_next[idxs] = final_obs[idxs]
+            act_l.append(action)
+            rew_l.append(reward.astype(np.float32))
+            nxt_l.append(stored_next)
+            done_l.append(terminated.copy())
+            self._ep_return += reward
+            for i in np.nonzero(terminated | truncated)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = next_obs
+        completed, self._completed = self._completed, []
+        return {
+            "transitions": {
+                "obs": np.concatenate(obs_l),
+                "actions": np.concatenate(act_l),
+                "rewards": np.concatenate(rew_l),
+                "next_obs": np.concatenate(nxt_l),
+                "dones": np.concatenate(done_l),
+            },
+            "episode_returns": completed,
+            "steps": num_steps * env.num_envs,
+        }
+
+
 class ReplayBuffer:
     """Uniform-sampling ring buffer over transition dicts."""
 

@@ -133,8 +133,18 @@ def test_chunked_prefill_interleaves_with_decode():
                     prompt_buckets=(32, 512), prefill_chunk=64)
 
     async def run():
-        first = asyncio.ensure_future(
-            _agen_list(eng.generate([1, 2, 3], max_new_tokens=40)))
+        # record the engine's prefill progress at each first-stream token
+        # so we can assert tokens kept flowing DURING the chunked prefill
+        chunks_at_token = []
+
+        async def consume_first():
+            out = []
+            async for t in eng.generate([1, 2, 3], max_new_tokens=40):
+                out.append(t)
+                chunks_at_token.append(eng.prefill_chunks)
+            return out
+
+        first = asyncio.ensure_future(consume_first())
         while eng.batches < 3:
             await asyncio.sleep(0.01)
         # inject a LONG prompt (bucket 512 -> 8 chunks of 64)
@@ -142,14 +152,18 @@ def test_chunked_prefill_interleaves_with_decode():
         late = await _agen_list(eng.generate(long_prompt,
                                              max_new_tokens=3))
         out_first = await first
-        return out_first, late
+        return out_first, late, chunks_at_token
 
-    out_first, late = asyncio.run(run())
+    out_first, late, chunks_at_token = asyncio.run(run())
     assert len(out_first) == 40
     assert len(late) == 3
     # 300 real tokens in a 512 bucket, chunk 64: pad chunks are skipped
     # (192 of 212 pad tokens), leaving ceil(320/64) = 5 chunk rounds
     assert eng.prefill_chunks == 5
+    # the actual interleaving claim: first-stream tokens were emitted
+    # while the long prefill was mid-flight (a drain-prefill-first engine
+    # would show every token at chunks 0 or 5)
+    assert any(0 < c < 5 for c in chunks_at_token), chunks_at_token
     # parity: the chunked path produces the same tokens as monolithic
     eng2 = LLMEngine("debug", tp=2, max_batch=4, max_seq_len=1024,
                      prompt_buckets=(32, 512), prefill_chunk=0, seed=0)
