@@ -44,19 +44,12 @@ def child_env(pkg_root: str, base: dict | None = None) -> dict:
 
 
 _site_thread: threading.Thread | None = None
+_site_wanted = False
+_site_lock = threading.Lock()
 
 
-def import_site_background():
-    """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path.
-
-    Skipped entirely when the process is explicitly CPU-pinned: the TPU
-    plugin isn't needed then, and importing it can block forever on an
-    unreachable TPU tunnel WHILE HOLDING the import lock — which would
-    deadlock every later `import jax` in this process."""
+def _start_site_thread():
     global _site_thread
-
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        return
 
     def _go():
         try:
@@ -69,10 +62,51 @@ def import_site_background():
     _site_thread.start()
 
 
+def import_site_background():
+    """Import sitecustomize (PJRT/TPU registration, etc.) off the boot path.
+
+    Skipped entirely when the process is explicitly CPU-pinned: the TPU
+    plugin isn't needed then, and importing it can block forever on an
+    unreachable TPU tunnel WHILE HOLDING the import lock — which would
+    deadlock every later `import jax` in this process.
+
+    RAYT_SITE_IMPORT selects the mode:
+      * ``eager`` (default) — start the import thread now; device tasks
+        overlap plugin registration with worker boot.
+      * ``lazy`` — defer until the first :func:`wait_site_ready` call, so
+        workers that never touch the device backend never load the plugin.
+        A PJRT plugin pointed at an unreachable device endpoint can spin
+        retrying inside its own runtime threads (~half a core, measured on
+        the tunneled-TPU sandbox), which on small hosts starves the actual
+        workload; lazy mode is the right setting for CPU-only fleets and
+        substrate microbenchmarks.
+      * ``off`` — never import; ``import jax`` still works (site-packages
+        rides PYTHONPATH) but only built-in backends are available."""
+    global _site_wanted
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return
+    mode = os.environ.get("RAYT_SITE_IMPORT", "eager").strip().lower()
+    if mode == "off":
+        return
+    _site_wanted = True
+    if mode != "lazy":
+        _start_site_thread()
+
+
 def wait_site_ready(timeout: float = 15.0) -> None:
     """Block until the background sitecustomize import finished. Call
     before initializing a jax backend in a worker — the PJRT plugin the
-    env points at (JAX_PLATFORMS) may still be registering."""
-    t = _site_thread
+    env points at (JAX_PLATFORMS) may still be registering. Under
+    RAYT_SITE_IMPORT=lazy this is what triggers the import."""
+    global _site_wanted
+    with _site_lock:
+        # check-then-start must be atomic: a second waiter racing the first
+        # could otherwise observe (no thread, not wanted) and return before
+        # the import has begun — defeating the barrier
+        if _site_thread is None and _site_wanted:
+            _site_wanted = False
+            _start_site_thread()
+        t = _site_thread
     if t is not None:
         t.join(timeout)

@@ -24,7 +24,7 @@ from typing import Any, Optional
 import numpy as np
 
 import ray_tpu as rt
-from ray_tpu.rl.env import make_vector_env
+from ray_tpu.rl.env import make_vector_env, require_discrete
 from ray_tpu.rl.module import MLPModuleConfig
 
 
@@ -119,6 +119,7 @@ class _OfflineAlgo:
 
         self.config = config
         probe = make_vector_env(config.env, 1, config.seed)
+        require_discrete(probe, type(self).__name__)
         self.module_cfg = MLPModuleConfig(
             observation_size=probe.observation_size,
             num_actions=probe.num_actions, hidden=tuple(config.hidden))

@@ -245,6 +245,11 @@ def cmd_microbenchmark(args):
     import ray_tpu as rt
     from ray_tpu._internal.perf import run_microbenchmarks
 
+    # Substrate benchmark: workers never touch the device backend, and an
+    # eagerly-imported PJRT plugin with an unreachable endpoint can spin
+    # ~half a core per process (see spawn.import_site_background), which
+    # turns the measurement into plugin noise on small hosts.
+    os.environ.setdefault("RAYT_SITE_IMPORT", "lazy")
     rt.init(num_cpus=args.num_cpus or None)
     try:
         for row in run_microbenchmarks(duration=args.duration):

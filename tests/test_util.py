@@ -132,3 +132,42 @@ def test_experimental_internal_kv_and_tqdm(local_cluster):
 
     assert rt.get(work.remote(), timeout=60) == 45
     assert sum(tqdm(range(4), desc="driver")) == 6
+
+
+def test_site_import_modes(monkeypatch):
+    """RAYT_SITE_IMPORT=lazy defers the sitecustomize replay to the first
+    wait_site_ready() call, so CPU-only workers never load a PJRT plugin
+    that could spin against an unreachable device endpoint."""
+    from ray_tpu._internal import spawn
+
+    # CPU pin short-circuits everything regardless of mode
+    monkeypatch.setattr(spawn, "_site_thread", None)
+    monkeypatch.setattr(spawn, "_site_wanted", False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("RAYT_SITE_IMPORT", "eager")
+    spawn.import_site_background()
+    assert spawn._site_thread is None and not spawn._site_wanted
+
+    # lazy: no thread at registration, thread starts on wait.
+    # Stub sitecustomize so the test never loads a real PJRT plugin into
+    # this long-lived pytest process.
+    import sys
+    import types
+
+    monkeypatch.setitem(sys.modules, "sitecustomize",
+                        types.ModuleType("sitecustomize"))
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("RAYT_SITE_IMPORT", "lazy")
+    spawn.import_site_background()
+    assert spawn._site_thread is None and spawn._site_wanted
+    spawn.wait_site_ready(timeout=30.0)
+    assert spawn._site_thread is not None
+    assert not spawn._site_thread.is_alive()  # joined
+
+    # off: never imports, wait is a no-op
+    monkeypatch.setattr(spawn, "_site_thread", None)
+    monkeypatch.setattr(spawn, "_site_wanted", False)
+    monkeypatch.setenv("RAYT_SITE_IMPORT", "off")
+    spawn.import_site_background()
+    spawn.wait_site_ready(timeout=1.0)
+    assert spawn._site_thread is None
