@@ -252,10 +252,25 @@ def cmd_microbenchmark(args):
     os.environ.setdefault("RAYT_SITE_IMPORT", "lazy")
     rt.init(num_cpus=args.num_cpus or None)
     try:
-        for row in run_microbenchmarks(duration=args.duration):
+        rows = run_microbenchmarks(duration=args.duration)
+        for row in rows:
             print(f"{row['benchmark']}: {row['rate_per_s']}")
     finally:
         rt.shutdown()
+    if args.json_out:
+        import platform
+
+        doc = {"suite": "rayt microbenchmark",
+               "host": {"cpus": os.cpu_count(),
+                        "platform": platform.platform()},
+               "note": ("measured with RAYT_SITE_IMPORT=lazy (this "
+                        "command's default): substrate workers never load "
+                        "a PJRT plugin, so an unreachable device endpoint "
+                        "cannot spin-steal cores from the measurement"),
+               "results": rows}
+        with open(args.json_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {args.json_out}")
 
 
 def _dash_request(args, path, data=None):
@@ -457,6 +472,9 @@ def main(argv=None):
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.add_argument("--duration", type=float, default=2.0)
     sp.add_argument("--num-cpus", type=int)
+    sp.add_argument("--json-out", metavar="PATH",
+                    help="also write results as JSON (MICROBENCH.json "
+                         "format)")
     sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("stack", help="stack traces of all workers")

@@ -1,0 +1,213 @@
+"""Scalability-envelope benchmark -> ENVELOPE.json (ref analog:
+release/benchmarks/README.md tables + release/benchmarks/distributed/*.
+
+The reference publishes *envelope* numbers (max nodes / actors / queued
+tasks / PGs / object shapes it has demonstrated) rather than golden
+throughputs. This harness demonstrates the same envelope dimensions at
+sandbox scale (defaults sized for a 1-core CI box; every dimension is a
+flag, so a real cluster can push the same legs to reference scale) and
+records measured values + wall time per leg.
+
+Run: python tools/envelope_bench.py [--nodes 16 --actors 64 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-only workload: never load a PJRT plugin in the fleet (see
+# spawn.import_site_background — a wedged device endpoint spins cores).
+os.environ.setdefault("RAYT_SITE_IMPORT", "lazy")
+
+import numpy as np  # noqa: E402
+
+
+def _leg(results, dimension, unit, reference, fn):
+    t0 = time.monotonic()
+    try:
+        value = fn()
+        row = {"dimension": dimension, "value": value, "unit": unit,
+               "elapsed_s": round(time.monotonic() - t0, 2),
+               "reference_envelope": reference}
+    except Exception as e:  # record honestly, keep going
+        row = {"dimension": dimension, "error": f"{type(e).__name__}: {e}",
+               "elapsed_s": round(time.monotonic() - t0, 2),
+               "reference_envelope": reference}
+    print(json.dumps(row))
+    results.append(row)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=16)
+    p.add_argument("--actors", type=int, default=64)
+    p.add_argument("--queued-tasks", type=int, default=20_000)
+    p.add_argument("--object-args", type=int, default=2_000)
+    p.add_argument("--task-returns", type=int, default=300)
+    p.add_argument("--get-objects", type=int, default=5_000)
+    p.add_argument("--big-object-gib", type=float, default=1.0)
+    p.add_argument("--broadcast-mib", type=int, default=128)
+    p.add_argument("--placement-groups", type=int, default=50)
+    p.add_argument("--out", default="ENVELOPE.json")
+    args = p.parse_args()
+
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+
+    results = []
+
+    # ---- multi-node legs on an in-process cluster (ref: the 2000-node
+    # distributed table; node_main processes stand in for machines) ----
+    cluster = Cluster(head_resources={"CPU": 4.0})
+    handles = []
+
+    def add_nodes():
+        for _ in range(args.nodes - 1):
+            handles.append(cluster.add_node(num_cpus=2))
+        rt_nodes = len(cluster._cluster_view())
+        assert rt_nodes >= args.nodes, rt_nodes
+        return rt_nodes
+
+    _leg(results, "nodes_registered_and_heartbeating", "nodes",
+         "2000+ (64-core machines)", add_nodes)
+
+    cluster.connect()
+    try:
+        @rt.remote(num_cpus=0.01)
+        class Trivial:
+            def ping(self):
+                return 1
+
+        def actor_fleet():
+            actors = [Trivial.remote() for _ in range(args.actors)]
+            assert all(rt.get([a.ping.remote() for a in actors],
+                              timeout=600))
+            for a in actors:
+                rt.kill(a)
+            return args.actors
+
+        _leg(results, "actors_alive_simultaneously", "actors",
+             "40,000+", actor_fleet)
+
+        @rt.remote
+        def tiny(i=0):
+            return i
+
+        def queue_storm():
+            refs = [tiny.remote(i) for i in range(args.queued_tasks)]
+            rt.get(refs[-1], timeout=1200)  # drain (FIFO-ish: last ~ done)
+            rt.get(refs, timeout=1200)
+            return args.queued_tasks
+
+        _leg(results, "tasks_queued_then_drained_one_driver", "tasks",
+             "1,000,000+ queued (single node)", queue_storm)
+
+        def many_args():
+            refs = [rt.put(i) for i in range(args.object_args)]
+
+            @rt.remote
+            def count(*xs):
+                return len(xs)
+
+            got = rt.get(count.remote(*refs), timeout=600)
+            assert got == args.object_args, got
+            return got
+
+        _leg(results, "object_args_to_single_task", "objects",
+             "10,000+", many_args)
+
+        def many_returns():
+            n = args.task_returns
+
+            @rt.remote(num_returns=n)
+            def fan():
+                return list(range(n))
+
+            refs = fan.remote()
+            vals = rt.get(refs, timeout=600)
+            assert vals == list(range(n))
+            return n
+
+        _leg(results, "returns_from_single_task", "objects",
+             "3,000+", many_returns)
+
+        def one_big_get():
+            refs = [rt.put(np.float64(i)) for i in range(args.get_objects)]
+            vals = rt.get(refs, timeout=600)
+            assert len(vals) == args.get_objects
+            return args.get_objects
+
+        _leg(results, "objects_in_single_get", "objects",
+             "10,000+", one_big_get)
+
+        def big_object():
+            nbytes = int(args.big_object_gib * (1 << 30))
+            arr = np.zeros(nbytes, np.uint8)
+            t0 = time.monotonic()
+            ref = rt.put(arr)
+            out = rt.get(ref, timeout=600)
+            dt = time.monotonic() - t0
+            assert out.nbytes == nbytes
+            del out
+            return {"gib": args.big_object_gib,
+                    "roundtrip_gib_per_s": round(
+                        2 * args.big_object_gib / dt, 2)}
+
+        _leg(results, "max_numpy_object", "GiB",
+             "100+ GiB", big_object)
+
+        def broadcast():
+            arr = np.zeros(args.broadcast_mib << 20, np.uint8)
+            ref = rt.put(arr)
+
+            @rt.remote(scheduling_strategy="SPREAD")
+            def fetch(x):
+                return x.nbytes
+
+            fetchers = min(8, args.nodes)
+            sizes = rt.get([fetch.remote(ref) for _ in range(fetchers)],
+                           timeout=600)
+            assert all(s == arr.nbytes for s in sizes)
+            return {"mib": args.broadcast_mib, "fetchers": fetchers,
+                    "nodes": args.nodes}
+
+        _leg(results, "object_broadcast_across_nodes", "MiB",
+             "1 GiB to 50+ nodes", broadcast)
+
+        def pg_storm():
+            # placement_group() is synchronous: bundles are reserved (2-
+            # phase commit) by the time it returns
+            pgs = [rt.placement_group([{"CPU": 0.01}], strategy="PACK")
+                   for _ in range(args.placement_groups)]
+            assert all(pg.placement for pg in pgs)
+            for pg in pgs:
+                rt.remove_placement_group(pg)
+            return args.placement_groups
+
+        _leg(results, "placement_groups_ready_simultaneously", "PGs",
+             "1,000+", pg_storm)
+    finally:
+        cluster.shutdown()
+
+    doc = {
+        "suite": "scalability envelope (sandbox scale)",
+        "host": {"cpus": os.cpu_count()},
+        "note": ("reference envelope numbers were demonstrated on 2000-node"
+                 " clusters / 64-core machines (release/benchmarks); these"
+                 " legs exercise the same dimensions on a 1-core CI sandbox"
+                 " — every scale is a flag for real-cluster runs"),
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
