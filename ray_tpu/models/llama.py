@@ -67,6 +67,11 @@ class LlamaConfig:
     remat_policy: str = "dots"
     # attention impl: "auto" | "xla" | "flash" | "ring" | "ulysses"
     attn_impl: str = "auto"
+    # flash-kernel tile shapes (PERF.md: attention is the MFU sink at the
+    # bench geometry; wider K blocks feed the MXU a longer contraction
+    # between softmax rescales — sweep via tools/mfu_sweep.py)
+    attn_block_q: int = 512
+    attn_block_k: int = 512
     seq_axis: str = "seq"          # mesh axis used by ring/ulysses attention
     # LoRA: scale numerator for the low-rank path (scale = alpha / rank,
     # rank inferred from the adapter's shape; see models/lora.py)
@@ -237,7 +242,9 @@ def _attention(cfg: LlamaConfig, q, k, v):
         from ray_tpu.ops.ring_attention import ulysses_attention
 
         return ulysses_attention(q, k, v, cfg.seq_axis, causal=True)
-    return dot_product_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    return dot_product_attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                                 block_q=cfg.attn_block_q,
+                                 block_k=cfg.attn_block_k)
 
 
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):

@@ -260,13 +260,15 @@ def cmd_microbenchmark(args):
     if args.json_out:
         import platform
 
+        mode = os.environ.get("RAYT_SITE_IMPORT", "lazy")
         doc = {"suite": "rayt microbenchmark",
                "host": {"cpus": os.cpu_count(),
                         "platform": platform.platform()},
-               "note": ("measured with RAYT_SITE_IMPORT=lazy (this "
-                        "command's default): substrate workers never load "
-                        "a PJRT plugin, so an unreachable device endpoint "
-                        "cannot spin-steal cores from the measurement"),
+               "note": (f"measured with RAYT_SITE_IMPORT={mode} (this "
+                        "command defaults to lazy so substrate workers "
+                        "never load a PJRT plugin — an unreachable device "
+                        "endpoint would spin-steal cores from the "
+                        "measurement)"),
                "results": rows}
         with open(args.json_out, "w") as f:
             json.dump(doc, f, indent=1)

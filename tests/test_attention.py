@@ -71,3 +71,26 @@ def test_flash_bwd_parity_gqa():
     for a, b, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (32, 256)])
+def test_flash_parity_rectangular_blocks(bq, bk):
+    """Non-square tiles (the mfu_sweep retune axis: wider K blocks feed
+    the MXU a longer contraction per softmax rescale) must stay exact in
+    fwd and bwd."""
+    q, k, v = _make_qkv(1, 256, 2, 2, 64, seed=5)
+
+    out = flash_attention(q, k, v, True, None, bq, bk)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) * jnp.arange(
+                q.shape[1], dtype=q.dtype)[None, :, None, None]).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    gf = loss(lambda q, k, v: flash_attention(q, k, v, True, None, bq, bk))
+    gr = loss(lambda q, k, v: xla_attention(q, k, v, causal=True))
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)

@@ -53,6 +53,8 @@ def main():
     p.add_argument("--get-objects", type=int, default=5_000)
     p.add_argument("--big-object-gib", type=float, default=1.0)
     p.add_argument("--broadcast-mib", type=int, default=128)
+    p.add_argument("--broadcast-fetchers", type=int, default=0,
+                   help="0 = min(8, nodes)")
     p.add_argument("--placement-groups", type=int, default=50)
     p.add_argument("--out", default="ENVELOPE.json")
     args = p.parse_args()
@@ -65,11 +67,10 @@ def main():
     # ---- multi-node legs on an in-process cluster (ref: the 2000-node
     # distributed table; node_main processes stand in for machines) ----
     cluster = Cluster(head_resources={"CPU": 4.0})
-    handles = []
 
     def add_nodes():
         for _ in range(args.nodes - 1):
-            handles.append(cluster.add_node(num_cpus=2))
+            cluster.add_node(num_cpus=2)  # cluster tracks for shutdown
         rt_nodes = len(cluster._cluster_view())
         assert rt_nodes >= args.nodes, rt_nodes
         return rt_nodes
@@ -170,7 +171,7 @@ def main():
             def fetch(x):
                 return x.nbytes
 
-            fetchers = min(8, args.nodes)
+            fetchers = args.broadcast_fetchers or min(8, args.nodes)
             sizes = rt.get([fetch.remote(ref) for _ in range(fetchers)],
                            timeout=600)
             assert all(s == arr.nbytes for s in sizes)

@@ -27,27 +27,36 @@ SEQ = 2048
 STEPS = 15
 
 CONFIGS = [
-    # (preset, batch, remat_policy, attn_impl)
-    ("410m", 8, "dots", "flash"),       # round-3 champion (21.4k tok/s)
-    ("410m", 8, "nothing", "flash"),    # recompute A/B at equal batch
-    ("410m", 16, "nothing", "flash"),   # the batch headroom "dots" OOMs on
-    ("410m", 24, "nothing", "flash"),
+    # (preset, batch, remat_policy, attn_impl, block_q, block_k)
+    ("410m", 8, "dots", "flash", 512, 512),   # round-3 champion (21.4k)
+    ("410m", 8, "nothing", "flash", 512, 512),  # recompute A/B, equal b
+    ("410m", 16, "nothing", "flash", 512, 512),  # headroom "dots" OOMs on
+    ("410m", 24, "nothing", "flash", 512, 512),
+    # flash tile retune at the champion geometry (VERDICT r4 #2): the
+    # kernel runs 13.4% MFU at hd64 — wider K blocks lengthen the MXU
+    # contraction per softmax rescale; smaller Q blocks cut the f32
+    # acc/scratch footprint so the wider K fits VMEM
+    ("410m", 8, "dots", "flash", 512, 1024),
+    ("410m", 8, "dots", "flash", 256, 1024),
+    ("410m", 8, "dots", "flash", 256, 2048),
+    ("410m", 8, "dots", "flash", 1024, 512),
     # MXU-aligned head_dim. Flash at d=128 wedges THIS env's remote
     # compile helper (PERF.md "hd128 dead end") — try it first with a
     # tight timeout, but ALSO measure hd128 via plain XLA attention:
     # XLA lowers d=128 attention natively (no mosaic), and a full-width
     # contraction may beat flash-at-half-width even without the fused
     # kernel. Untried on chip as of round 4.
-    ("410m-hd128", 8, "dots", "xla"),
-    ("410m-hd128", 16, "nothing", "xla"),
-    ("410m-hd128", 24, "nothing", "xla"),
-    ("410m-hd128", 8, "dots", "flash"),
-    ("410m-hd128", 16, "nothing", "flash"),
+    ("410m-hd128", 8, "dots", "xla", 512, 512),
+    ("410m-hd128", 16, "nothing", "xla", 512, 512),
+    ("410m-hd128", 24, "nothing", "xla", 512, 512),
+    ("410m-hd128", 8, "dots", "flash", 512, 512),
+    ("410m-hd128", 16, "nothing", "flash", 512, 512),
 ]
 
 
 def measure(preset: str, batch: int, policy: str,
-            attn_impl: str = "flash") -> dict:
+            attn_impl: str = "flash", block_q: int = 512,
+            block_k: int = 512) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -57,7 +66,8 @@ def measure(preset: str, batch: int, policy: str,
     from ray_tpu.parallel.spmd import build_train_step, shard_batch
 
     cfg = llama.config_for(preset, max_seq_len=SEQ, remat=True,
-                           remat_policy=policy, attn_impl=attn_impl)
+                           remat_policy=policy, attn_impl=attn_impl,
+                           attn_block_q=block_q, attn_block_k=block_k)
     mesh = build_mesh({"data": 1}, jax.devices()[:1])
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     step, state = build_train_step(
@@ -83,9 +93,9 @@ def measure(preset: str, batch: int, policy: str,
 def main():
     budget = float(os.environ.get("RAYT_SWEEP_TIMEOUT_S", "900"))
     results = []
-    for preset, batch, policy, attn in CONFIGS:
+    for preset, batch, policy, attn, bq, bk in CONFIGS:
         label = {"preset": preset, "batch": batch, "policy": policy,
-                 "attn": attn}
+                 "attn": attn, "block_q": bq, "block_k": bk}
         # flash at hd128 is known to wedge this env's compile helper:
         # give it a short leash so the sweep's budget goes to configs
         # that can actually finish
@@ -95,7 +105,7 @@ def main():
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--one",
-                 preset, str(batch), policy, attn],
+                 preset, str(batch), policy, attn, str(bq), str(bk)],
                 capture_output=True, text=True, timeout=cfg_budget)
         except subprocess.TimeoutExpired:
             print(json.dumps({"cfg": label, "error": "timeout"}),
@@ -119,6 +129,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 5 and sys.argv[1] == "--one":
         print(json.dumps(measure(
             sys.argv[2], int(sys.argv[3]), sys.argv[4],
-            sys.argv[5] if len(sys.argv) > 5 else "flash")), flush=True)
+            sys.argv[5] if len(sys.argv) > 5 else "flash",
+            int(sys.argv[6]) if len(sys.argv) > 6 else 512,
+            int(sys.argv[7]) if len(sys.argv) > 7 else 512)), flush=True)
     else:
         main()
