@@ -17,14 +17,15 @@ Core surface:
 __version__ = "0.1.0"
 
 from ray_tpu.api import (ActorClass, ActorHandle, PlacementGroup,  # noqa: F401
-                         available_resources, cluster_resources, get,
+                         available_resources, cancel, cluster_resources, get,
                          get_actor, kill, nodes, placement_group, put,
                          put_device, remote, remove_placement_group, wait)
 from ray_tpu.core.common import (ActorDiedError, GetTimeoutError,  # noqa: F401
                                  NodeAffinitySchedulingStrategy,
                                  NodeLabelSchedulingStrategy, ObjectLostError,
                                  PlacementGroupSchedulingStrategy, RayTpuError,
-                                 TaskError, WorkerCrashedError)
+                                 TaskCancelledError, TaskError,
+                                 WorkerCrashedError)
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
 from ray_tpu.core.runtime import init, is_initialized, shutdown  # noqa: F401
 

@@ -233,6 +233,19 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None):
                                timeout=timeout)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Best-effort cancel of the task producing `ref` (ref analog:
+    ray.cancel). Queued tasks fail immediately; running tasks get an
+    async TaskCancelledError (force=True kills the executing worker —
+    the only way to interrupt C-blocked calls like sleep/IO). Once this
+    returns True, get() on the task's returns raises TaskCancelledError
+    even if the worker raced to a result; returns False when the task
+    already finished (its value stands). Caveat: a force-killed worker
+    may hold device-plane results of earlier tasks (lease reuse) — those
+    owners fall back to lineage reconstruction."""
+    return _core_worker().cancel_task(ref, force)
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True):
     _core_worker().kill_actor(actor._actor_id, no_restart)
 

@@ -220,5 +220,11 @@ class ObjectLostError(RayTpuError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """Raised by get() on a ref whose task was cancelled (ref analog:
+    ray.exceptions.TaskCancelledError via ray.cancel)."""
+    pass
+
+
 class GetTimeoutError(RayTpuError):
     pass

@@ -39,8 +39,8 @@ from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
                                  NodeLabelSchedulingStrategy,
                                  ObjectLostError, ObjectMeta,
                                  PlacementGroupSchedulingStrategy,
-                                 TaskError, TaskSpec, WorkerCrashedError,
-                                 WorkerInfo)
+                                 TaskCancelledError, TaskError, TaskSpec,
+                                 WorkerCrashedError, WorkerInfo)
 from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
 from ray_tpu.core.object_ref import ObjectRef, set_core_worker
 from ray_tpu.core.device_objects import (DeviceObjectStore,
@@ -84,6 +84,8 @@ class _PendingTask:
     retries_left: int
     pinned: list[ObjectID] = field(default_factory=list)
     done: bool = False
+    cancelled: bool = False
+    running_on: Any = None     # WorkerInfo while pushed to a worker
 
 
 @dataclass
@@ -141,6 +143,8 @@ class CoreWorker:
         # worker-mode execution state
         self.executor = ThreadPoolExecutor(max_workers=1,
                                            thread_name_prefix="rayt-exec")
+        self._running_normal_task: TaskID | None = None
+        self._exec_thread_ident: int | None = None
         self.actor_instance = None
         self.actor_id: ActorID | None = None
         self._actor_async_loop: EventLoopThread | None = None
@@ -1243,12 +1247,26 @@ class CoreWorker:
             except Exception as e:
                 self._fail_task(spec, TaskError(e, spec.name, ""))
                 return
+            if pt.cancelled or pt.done:
+                # cancelled while queued: returns were already failed by
+                # cancel_task; just hand the lease back
+                self._recycle_lease(spec.resources, winfo, token, nm_addr,
+                                    strat)
+                return
             try:
+                pt.running_on = winfo
                 conn = await self._conn_to(winfo.address)
                 reply = await conn.call("push_task", spec,
                                         timeout=_TASK_PUSH_TIMEOUT)
             except (ConnectionLost, RpcError, OSError) as e:
+                pt.running_on = None
                 await self._release_lease(winfo, token, nm_addr, reusable=False)
+                if pt.cancelled:
+                    # force-cancel kills the worker mid-task; that death is
+                    # the cancellation succeeding, not a crash
+                    self._fail_task(spec, TaskCancelledError(
+                        f"task {spec.name} cancelled while running"))
+                    return
                 if pt.retries_left > 0:
                     pt.retries_left -= 1
                     logger.warning("task %s worker crash, retrying (%s)",
@@ -1258,6 +1276,7 @@ class CoreWorker:
                 self._fail_task(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
                 return
+            pt.running_on = None
             if strat == "SPREAD":
                 # no sticky reuse for SPREAD: recycling would funnel the
                 # whole wave onto the first-granted node; releasing makes
@@ -1268,6 +1287,12 @@ class CoreWorker:
             else:
                 self._recycle_lease(spec.resources, winfo, token, nm_addr,
                                     strat)
+            if pt.cancelled:
+                # cancel() already returned True to the caller — it wins
+                # even when the worker raced to a result or an error
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name} cancelled while running"))
+                return
             if reply[0] == "task_error":
                 _, err_blob, tb = reply
                 if spec.retry_exceptions and pt.retries_left > 0:
@@ -1284,6 +1309,8 @@ class CoreWorker:
 
     def _complete_task(self, spec: TaskSpec, results: list, winfo: WorkerInfo):
         pt = self.pending_tasks.get(spec.task_id)
+        if pt is not None and pt.done:
+            return  # lost the race with a cancel-fail; returns hold errors
         for i, entry in enumerate(results):
             if entry[0] == "stream_done":
                 # all generator_item RPCs were acked before this reply was
@@ -1319,6 +1346,11 @@ class CoreWorker:
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
         pt = self.pending_tasks.get(spec.task_id)
+        if pt is not None and pt.done:
+            # already failed/completed (e.g. cancelled while queued, then
+            # the lease path errored too): a second pass would double-
+            # decrement the arg pins
+            return
         stream = self._streams.get(spec.task_id)
         if stream is not None:
             stream.abort(error)
@@ -1395,6 +1427,55 @@ class CoreWorker:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.io.run(self.gcs.kill_actor(actor_id, no_restart))
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> bool:
+        """Best-effort cancel of the normal task producing `ref` (ref
+        analog: core_worker.cc CancelTask / ray.cancel).
+
+        Queued tasks fail immediately with TaskCancelledError; a running
+        task gets an async exception raised between bytecodes (blocked C
+        calls — sleep, IO — are only interrupted by force=True, which
+        kills the executing worker; same limitation as the reference).
+        Returns False when the task already finished — its value stands."""
+        tid = self._return_to_task.get(ref.id)
+        if tid is None:
+            raise ValueError(
+                "cancel() needs a task-return ObjectRef owned by this "
+                "driver (for actors use rt.kill)")
+        if tid.has_actor():
+            raise ValueError(
+                "cancelling actor tasks is not supported; rt.kill(actor) "
+                "tears down the whole actor")
+        # all bookkeeping on the IO loop: serializes against
+        # _run_normal_task/_complete_task (they run there too), so the
+        # done-check, flag set, and immediate fail are atomic
+        return self.io.run(self._cancel_on_loop(tid, force))
+
+    async def _cancel_on_loop(self, tid: TaskID, force: bool) -> bool:
+        pt = self.pending_tasks.get(tid)
+        if pt is None or pt.done:
+            return False
+        pt.cancelled = True
+        pt.retries_left = 0
+        winfo = pt.running_on
+        if winfo is None:
+            # not yet on a worker: fail the returns now; the in-flight
+            # lease acquisition notices pt.cancelled and releases
+            self._fail_task(pt.spec, TaskCancelledError(
+                f"task {pt.spec.name} cancelled before it started"))
+            return True
+
+        async def _send():
+            try:
+                conn = await self._conn_to(winfo.address)
+                await conn.call("cancel_task", (tid, force), timeout=10)
+            except Exception:
+                pass  # worker may be mid-death; push path handles it
+            # If the worker replied False (push not yet arrived, or body
+            # finished), pt.cancelled is still set: the push reply path
+            # fails the task with TaskCancelledError either way.
+        self._spawn(_send())
+        return True
 
     # --------------------------------------------------- streaming (owner)
     async def rpc_generator_item(self, conn, arg):
@@ -1483,30 +1564,90 @@ class CoreWorker:
                 break
         return ("ok", [("stream_done", count)])
 
+    def _ensure_executor_alive(self):
+        """A stale cancellation async-exc can, in a narrow window, land in
+        the pooled executor thread's idle loop and kill it silently —
+        ThreadPoolExecutor never replaces dead threads, so every later
+        push would hang. Detect and rebuild."""
+        ident = self._exec_thread_ident
+        if ident is None:
+            return
+        if any(t.ident == ident for t in threading.enumerate()):
+            return
+        self.executor = ThreadPoolExecutor(max_workers=1,
+                                           thread_name_prefix="rayt-exec")
+        self._exec_thread_ident = None
+
     async def rpc_push_task(self, conn, spec: TaskSpec):
         loop = asyncio.get_running_loop()
+        self._ensure_executor_alive()
         return await loop.run_in_executor(
             self.executor, self._execute_task, spec)
 
     def _execute_task(self, spec: TaskSpec):
         from ray_tpu._internal import otel
 
+        # visible to the RPC loop thread for cancel_task (the exec context
+        # is a threading.local, so it can't serve cross-thread lookups)
+        self._exec_thread_ident = threading.get_ident()
+        self._running_normal_task = spec.task_id
         t_wall, t0 = time.time(), time.perf_counter()
         # execution span parents remotely on the submitter's span: one
         # trace id across the whole task tree (ref: _private/tracing
         # _wrap_task_execution). No-op context when tracing is off.
-        with otel.execute_span(
-                spec.name or "task", getattr(spec, "trace_ctx", None),
-                task_id=spec.task_id.hex()) as sp:
-            out = self._execute_task_body(spec)
-            sp["ok"] = not (isinstance(out, tuple) and out
-                            and out[0] == "task_error")
+        try:
+            with otel.execute_span(
+                    spec.name or "task", getattr(spec, "trace_ctx", None),
+                    task_id=spec.task_id.hex()) as sp:
+                out = self._execute_task_body(spec)
+                sp["ok"] = not (isinstance(out, tuple) and out
+                                and out[0] == "task_error")
+        finally:
+            self._running_normal_task = None
         self.task_events.record(
             name=spec.name or "task", task_id=spec.task_id.hex(),
             kind="task", start_s=t_wall, dur_s=time.perf_counter() - t0,
             ok=not (isinstance(out, tuple) and out
                     and out[0] == "task_error"))
         return out
+
+    def rpc_cancel_task(self, conn, arg):
+        """Worker-side cancel (ref analog: CoreWorker::HandleCancelTask).
+
+        Non-force: raise TaskCancelledError asynchronously in the executor
+        thread — delivered between bytecodes, so C-blocked calls (sleep,
+        IO) keep running until they return (reference has the same
+        limitation). Force: kill this worker process shortly after the
+        reply flushes; the owner maps the resulting connection loss to
+        TaskCancelledError. A cancel that races task completion may land
+        after the body returns — the in-flight result is then dropped via
+        the errored push reply, which cancellation semantics allow."""
+        tid, force = arg
+        if self._running_normal_task != tid:
+            return False  # finished or never arrived; owner handles it
+        if force:
+            # NOTE: this process may hold device-plane results of EARLIER
+            # tasks (lease reuse); they die with it and their owners fall
+            # back to lineage reconstruction (api.cancel documents this)
+            threading.Timer(0.05, os._exit, args=(1,)).start()
+            return True
+        ident = self._exec_thread_ident
+        if ident is None:
+            return False
+        import ctypes
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError))
+        # TOCTOU guard: if the body finished between our check and the
+        # raise, the pending exception would fire in the idle executor
+        # loop (killing the pooled thread) or inside the NEXT task.
+        # Re-check and revoke (SetAsyncExc with NULL clears a pending
+        # async exc); _ensure_executor_alive covers the residual window.
+        if self._running_normal_task != tid:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), None)
+            return False
+        return True
 
     def _execute_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id

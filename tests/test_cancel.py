@@ -1,0 +1,97 @@
+"""Task cancellation (ref analog: ray.cancel + TaskCancelledError;
+core_worker.cc CancelTask / HandleCancelTask)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import TaskCancelledError
+
+
+def test_cancel_queued_task(local_cluster):
+    """A task still waiting for a worker fails immediately on cancel."""
+    @rt.remote(num_cpus=4)
+    def blocker():
+        time.sleep(8)
+        return "done"
+
+    @rt.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    b = blocker.remote()          # occupies all 4 CPUs
+    time.sleep(0.5)
+    q = queued.remote()           # stuck behind the blocker
+    assert rt.cancel(q) is True
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        rt.get(q, timeout=5)
+    assert time.monotonic() - t0 < 2.0  # failed NOW, not after blocker
+    assert rt.get(b, timeout=30) == "done"  # blocker unaffected
+
+
+def test_cancel_running_python_loop(local_cluster):
+    """Non-force cancel interrupts a running pure-Python loop via the
+    async exception (delivered between bytecodes)."""
+    @rt.remote
+    def spin():
+        x = 0
+        while True:       # interruptible: pure bytecode loop
+            x += 1
+        return x
+
+    ref = spin.remote()
+    time.sleep(1.0)       # let it start executing
+    assert rt.cancel(ref) is True
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=10)
+
+    # the worker survives non-force cancel and keeps serving tasks
+    @rt.remote
+    def ok():
+        return 42
+
+    assert rt.get(ok.remote(), timeout=30) == 42
+
+
+def test_force_cancel_kills_blocked_worker(local_cluster):
+    """force=True is the only way to interrupt a C-blocked call (sleep);
+    the worker death maps to TaskCancelledError, not WorkerCrashedError,
+    and is not retried."""
+    @rt.remote(max_retries=3)
+    def sleeper():
+        time.sleep(60)
+
+    ref = sleeper.remote()
+    time.sleep(1.0)
+    assert rt.cancel(ref, force=True) is True
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=15)
+    assert time.monotonic() - t0 < 12.0
+
+
+def test_cancel_finished_task_returns_false(local_cluster):
+    @rt.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert rt.get(ref, timeout=30) == 7
+    assert rt.cancel(ref) is False
+    assert rt.get(ref) == 7  # value stands
+
+
+def test_cancel_actor_task_rejected(local_cluster):
+    @rt.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    ref = a.m.remote()
+    with pytest.raises(ValueError, match="actor"):
+        rt.cancel(ref)
+    assert rt.get(ref, timeout=30) == 1
+    rt.kill(a)
