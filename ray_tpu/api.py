@@ -11,7 +11,7 @@ from ray_tpu._internal.ids import ActorID, PlacementGroupID
 from ray_tpu.core.common import (ActorOptions, ActorState, ResourceSpec,
                                  TaskOptions)
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.runtime import get_runtime_context
+from ray_tpu.core.runtime import get_runtime_context as _infra_runtime_context
 
 
 def _core_worker():
@@ -20,7 +20,7 @@ def _core_worker():
     cw = get_core_worker()
     if cw is not None:
         return cw  # inside a worker process, or an initialized driver
-    return get_runtime_context().core_worker
+    return _infra_runtime_context().core_worker
 
 
 def _make_resources(num_cpus=None, num_tpus=None, memory=None,
@@ -231,6 +231,41 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None):
         return [], []
     return _core_worker().wait(list(refs), num_returns=num_returns,
                                timeout=timeout)
+
+
+class RuntimeContext:
+    """User-facing identity of the current driver/worker process (ref
+    analog: ray.runtime_context.RuntimeContext via
+    ray.get_runtime_context()). Inside a task, get_task_id() names the
+    executing task; inside an actor, get_actor_id() names the actor."""
+
+    def __init__(self, cw):
+        self._cw = cw
+
+    def get_job_id(self) -> str:
+        # inside a task: the owning job from the executing spec (pool
+        # workers are job-agnostic, their process job id is the null job)
+        jid = getattr(self._cw._exec_ctx, "job_id", None)
+        return (jid or self._cw.job_id).hex()
+
+    def get_node_id(self) -> str:
+        return self._cw.node_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._cw.worker_id.hex()
+
+    def get_task_id(self) -> str | None:
+        tid = self._cw._exec_ctx.task_id
+        return tid.hex() if tid is not None else None
+
+    def get_actor_id(self) -> str | None:
+        aid = self._cw.actor_id
+        return aid.hex() if aid is not None else None
+
+
+def get_runtime_context() -> RuntimeContext:
+    """ref analog: ray.get_runtime_context() (_private/worker.py)."""
+    return RuntimeContext(_core_worker())
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> bool:

@@ -86,6 +86,7 @@ class _PendingTask:
     done: bool = False
     cancelled: bool = False
     running_on: Any = None     # WorkerInfo while pushed to a worker
+    lease_waiter: Any = None   # (pool, fut) while queued for a lease
 
 
 @dataclass
@@ -101,6 +102,7 @@ class _LeasePool:
 
 class _ExecutionContext(threading.local):
     task_id: TaskID | None = None
+    job_id: JobID | None = None     # owning job of the executing task
 
 
 class CoreWorker:
@@ -1077,12 +1079,14 @@ class CoreWorker:
             self._lease_cache[key] = pool
         return pool
 
-    async def _acquire_lease(self, demand: dict[str, float], strategy=None):
+    async def _acquire_lease(self, demand: dict[str, float], strategy=None,
+                             pt: "_PendingTask | None" = None):
         """Get a leased worker for `demand`: reuse an idle cached lease if
         one exists, otherwise queue as a waiter and make sure enough lease
         fetches are in flight (ref: normal_task_submitter.cc:291 — one
         scheduling-key pipeline, workers handed task-to-task without a
-        raylet round-trip)."""
+        raylet round-trip). `pt` registers the waiter for withdrawal on
+        cancel (a cancelled queued task must stop competing for capacity)."""
         key = self._lease_key(demand, strategy)
         pool = self._lease_pool_for(key)
         if pool.idle:
@@ -1090,11 +1094,17 @@ class CoreWorker:
             return entry[0], entry[1], entry[2]
         fut = asyncio.get_running_loop().create_future()
         pool.waiters.append(fut)
+        if pt is not None:
+            pt.lease_waiter = (pool, fut)
         if pool.inflight < len(pool.waiters):
             pool.inflight += 1
             self._spawn(
                 self._fetch_lease(key, demand, pool, strategy))
-        entry = await fut
+        try:
+            entry = await fut
+        finally:
+            if pt is not None:
+                pt.lease_waiter = None
         return entry[0], entry[1], entry[2]
 
     async def _fetch_lease(self, key: tuple, demand: dict[str, float],
@@ -1243,7 +1253,11 @@ class CoreWorker:
         while True:
             try:
                 winfo, token, nm_addr = await self._acquire_lease(
-                    spec.resources, strat)
+                    spec.resources, strat, pt)
+            except asyncio.CancelledError:
+                if pt.cancelled or pt.done:
+                    return  # waiter withdrawn by cancel(); returns failed
+                raise      # shutdown sweep — propagate
             except Exception as e:
                 self._fail_task(spec, TaskError(e, spec.name, ""))
                 return
@@ -1277,6 +1291,15 @@ class CoreWorker:
                     f"worker died running {spec.name}: {e}"))
                 return
             pt.running_on = None
+            if pt.cancelled:
+                # cancel() already returned True — it wins even when the
+                # worker raced to a result. Never recycle this lease: on
+                # force-cancel the worker is milliseconds from os._exit.
+                self._spawn(self._release_lease(
+                    winfo, token, nm_addr, reusable=False))
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name} cancelled while running"))
+                return
             if strat == "SPREAD":
                 # no sticky reuse for SPREAD: recycling would funnel the
                 # whole wave onto the first-granted node; releasing makes
@@ -1287,12 +1310,6 @@ class CoreWorker:
             else:
                 self._recycle_lease(spec.resources, winfo, token, nm_addr,
                                     strat)
-            if pt.cancelled:
-                # cancel() already returned True to the caller — it wins
-                # even when the worker raced to a result or an error
-                self._fail_task(spec, TaskCancelledError(
-                    f"task {spec.name} cancelled while running"))
-                return
             if reply[0] == "task_error":
                 _, err_blob, tb = reply
                 if spec.retry_exceptions and pt.retries_left > 0:
@@ -1459,8 +1476,16 @@ class CoreWorker:
         pt.retries_left = 0
         winfo = pt.running_on
         if winfo is None:
-            # not yet on a worker: fail the returns now; the in-flight
-            # lease acquisition notices pt.cancelled and releases
+            # not yet on a worker: fail the returns now and withdraw the
+            # pending lease waiter — a cancelled task must stop competing
+            # for capacity (and feeding autoscaler demand)
+            lw, pt.lease_waiter = pt.lease_waiter, None
+            if lw is not None:
+                pool, fut = lw
+                if fut in pool.waiters:
+                    pool.waiters.remove(fut)
+                if not fut.done():
+                    fut.cancel()
             self._fail_task(pt.spec, TaskCancelledError(
                 f"task {pt.spec.name} cancelled before it started"))
             return True
@@ -1651,6 +1676,7 @@ class CoreWorker:
 
     def _execute_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
+        self._exec_ctx.job_id = spec.job_id
         restore_env = None
         try:
             restore_env = self._apply_runtime_env(spec)
@@ -1670,6 +1696,7 @@ class CoreWorker:
                 except Exception:
                     pass
             self._exec_ctx.task_id = None
+            self._exec_ctx.job_id = None
 
     def _resolve_args(self, args):
         if isinstance(args, dict):
@@ -1733,6 +1760,7 @@ class CoreWorker:
 
     def _instantiate_actor(self, spec: TaskSpec) -> str | None:
         self._exec_ctx.task_id = spec.task_id
+        self._exec_ctx.job_id = spec.job_id
         try:
             self._apply_runtime_env(spec)
             cls = cloudpickle.loads(spec.function_blob)
@@ -1753,6 +1781,7 @@ class CoreWorker:
             return traceback.format_exc()
         finally:
             self._exec_ctx.task_id = None
+            self._exec_ctx.job_id = None
 
     async def rpc_push_actor_task(self, conn, arg):
         """Ordered actor-task execution (ref: actor_scheduling_queue.cc).
@@ -1794,6 +1823,7 @@ class CoreWorker:
         from ray_tpu._internal import otel
 
         self._exec_ctx.task_id = spec.task_id
+        self._exec_ctx.job_id = spec.job_id
         # span covers the async execution path too (trace ids stay
         # consistent; interleaved async spans are handled by the
         # tracer's entry-removal discipline)
@@ -1821,6 +1851,7 @@ class CoreWorker:
                         traceback.format_exc())
             finally:
                 self._exec_ctx.task_id = None
+                self._exec_ctx.job_id = None
 
     def _resolve_args_async(self, args):
         # async path: refs resolved via blocking get on a worker thread would
@@ -1852,6 +1883,7 @@ class CoreWorker:
 
     def _execute_actor_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
+        self._exec_ctx.job_id = spec.job_id
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
@@ -1876,6 +1908,7 @@ class CoreWorker:
             return ("task_error", serialize_to_bytes(e), traceback.format_exc())
         finally:
             self._exec_ctx.task_id = None
+            self._exec_ctx.job_id = None
 
     async def _task_event_flush_loop(self):
         """Ship buffered task events to the GCS ring every second (ref:

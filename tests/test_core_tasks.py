@@ -206,3 +206,35 @@ def test_cluster_resources_api(cluster):
     assert total.get("TPU") == 8.0
     avail = rt.available_resources()
     assert avail.get("CPU", 0) > 0
+
+
+def test_runtime_context_ids(cluster):
+    """ref analog: ray.get_runtime_context() — job/node ids everywhere,
+    task id inside tasks, actor id inside actors."""
+    import ray_tpu as rt
+
+    ctx = rt.get_runtime_context()
+    int(ctx.get_job_id(), 16)
+    int(ctx.get_node_id(), 16)
+    int(ctx.get_worker_id(), 16)
+    assert ctx.get_task_id() is None      # driver, not a task
+
+    @rt.remote
+    def who():
+        c = rt.get_runtime_context()
+        return (c.get_job_id(), c.get_task_id(), c.get_actor_id())
+
+    job, task, actor = rt.get(who.remote(), timeout=30)
+    assert job == ctx.get_job_id()
+    assert task is not None and actor is None
+
+    @rt.remote
+    class A:
+        def who(self):
+            c = rt.get_runtime_context()
+            return (c.get_actor_id(), c.get_task_id())
+
+    a = A.remote()
+    actor_id, task_id = rt.get(a.who.remote(), timeout=30)
+    assert actor_id is not None and task_id is not None
+    rt.kill(a)
