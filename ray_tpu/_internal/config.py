@@ -109,6 +109,18 @@ class Config:
     default_actor_max_restarts: int = 0
     actor_death_cache_size: int = 1024
 
+    # ---- metrics / observability ----
+    # GCS time-series store: history kept per series, and the bin width
+    # records aggregate into (queries downsample to multiples of it).
+    metrics_retention_s: float = 900.0
+    metrics_resolution_s: float = 5.0
+    # Per-process metric batcher: records aggregate locally and flush to
+    # the GCS metrics channel at this cadence (hot paths never pay an
+    # RPC per Counter.inc / Histogram.observe).
+    metrics_flush_interval_s: float = 0.2
+    # Node managers publish resource-utilization gauges at this period.
+    node_metrics_period_s: float = 2.0
+
     # ---- logging ----
     log_level: str = "INFO"
     log_dir: str = ""

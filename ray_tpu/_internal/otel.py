@@ -23,6 +23,7 @@ Opt-in and zero-overhead when off:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
 import os
 import secrets
@@ -33,7 +34,15 @@ from typing import Optional
 _lock = threading.Lock()
 _enabled: Optional[bool] = None
 _out_path: Optional[str] = None
-_tls = threading.local()
+# span stack as a ContextVar of an IMMUTABLE tuple: every asyncio task
+# gets its own copy-on-write view (Task captures the context at
+# creation), so interleaved tasks on one loop thread can no longer
+# parent a submit_span under another task's execute_span — the failure
+# mode of the previous threading.local stack. Plain threads still get
+# independent stacks (each thread has its own context), and immutability
+# means a child task's pushes never leak back into the parent.
+_stack_var: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "rayt_otel_span_stack", default=())
 
 
 def enable_tracing(out_dir: Optional[str] = None) -> None:
@@ -63,8 +72,8 @@ def tracing_enabled() -> bool:
 
 
 def _current() -> Optional[tuple[str, str]]:
-    """(trace_id, span_id) of this thread's active span."""
-    stack = getattr(_tls, "stack", None)
+    """(trace_id, span_id) of the current context's active span."""
+    stack = _stack_var.get()
     return stack[-1] if stack else None
 
 
@@ -105,11 +114,8 @@ def _span(name: str, kind: str, trace_id: Optional[str],
     tuples)."""
     span_id = secrets.token_hex(8)
     trace_id = trace_id or secrets.token_hex(16)
-    stack = getattr(_tls, "stack", None)
-    if stack is None:
-        stack = _tls.stack = []
     entry = (trace_id, span_id)
-    stack.append(entry)
+    _stack_var.set(_stack_var.get() + (entry,))
     start = time.time_ns()
     handle = {"ok": True}
     try:
@@ -118,12 +124,11 @@ def _span(name: str, kind: str, trace_id: Optional[str],
         handle["ok"] = False
         raise
     finally:
-        # remove THIS span's entry, not blindly the top: interleaved
-        # async tasks on one loop thread exit out of LIFO order
-        try:
-            stack.remove(entry)
-        except ValueError:
-            pass
+        # remove THIS span's entry, not blindly the top: even within one
+        # context, generator-driven spans can exit out of LIFO order
+        cur = _stack_var.get()
+        if entry in cur:
+            _stack_var.set(tuple(e for e in cur if e is not entry))
         _export({
             "name": name, "kind": kind,
             "trace_id": trace_id, "span_id": span_id,

@@ -152,6 +152,9 @@ class CoreWorker:
         self._actor_async_loop: EventLoopThread | None = None
         self._actor_seq_state: dict[str, dict] = {}
         self._shutdown = False
+        # approximate in-flight count backing the queue-depth gauge
+        # (racy += is fine for telemetry; never used for control flow)
+        self._inflight_tasks = 0
         # every fire-and-forget coroutine goes through _spawn (on-loop) or
         # _spawn_from_thread (foreign threads) so shutdown can
         # cancel-and-await them: an abandoned pending task at loop
@@ -929,6 +932,16 @@ class CoreWorker:
             tensor_transport=options.tensor_transport,
             trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
+        try:
+            from ray_tpu.util import builtin_metrics as _bm
+
+            self._inflight_tasks += 1
+            _bm.tasks_submitted.inc()
+            _bm.task_queue_depth.set(
+                float(self._inflight_tasks),
+                tags={"owner": self.worker_id.hex()[:12]})
+        except Exception:
+            pass  # telemetry must never fail a submission
         self._spawn_from_thread(self._run_normal_task(spec))
         if spec.num_returns == -1:
             from ray_tpu.core.streaming import ObjectRefGenerator
@@ -1250,10 +1263,15 @@ class CoreWorker:
         strat = spec.scheduling_strategy
         if isinstance(strat, PlacementGroupSchedulingStrategy):
             strat = None
+        t_sched = time.perf_counter()
         while True:
             try:
                 winfo, token, nm_addr = await self._acquire_lease(
                     spec.resources, strat, pt)
+                if t_sched is not None:  # first grant only, not retries
+                    self._observe_sched_latency(
+                        time.perf_counter() - t_sched)
+                    t_sched = None
             except asyncio.CancelledError:
                 if pt.cancelled or pt.done:
                     return  # waiter withdrawn by cancel(); returns failed
@@ -1324,6 +1342,27 @@ class CoreWorker:
             self._complete_task(spec, reply[1], winfo)
             return
 
+    @staticmethod
+    def _observe_sched_latency(dur_s: float):
+        try:
+            from ray_tpu.util import builtin_metrics as _bm
+
+            _bm.task_sched_latency.observe(dur_s)
+        except Exception:
+            pass
+
+    def _task_finished(self, status: str):
+        try:
+            from ray_tpu.util import builtin_metrics as _bm
+
+            self._inflight_tasks = max(0, self._inflight_tasks - 1)
+            _bm.tasks_finished.inc(tags={"status": status})
+            _bm.task_queue_depth.set(
+                float(self._inflight_tasks),
+                tags={"owner": self.worker_id.hex()[:12]})
+        except Exception:
+            pass
+
     def _complete_task(self, spec: TaskSpec, results: list, winfo: WorkerInfo):
         pt = self.pending_tasks.get(spec.task_id)
         if pt is not None and pt.done:
@@ -1360,6 +1399,8 @@ class CoreWorker:
             pt.done = True
             for oid in pt.pinned:
                 self.reference_counter.remove_task_pin(oid)
+            if spec.actor_id is None:  # actor calls aren't counted at
+                self._task_finished("ok")  # submit; keep the pair honest
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
         pt = self.pending_tasks.get(spec.task_id)
@@ -1381,6 +1422,8 @@ class CoreWorker:
             pt.done = True
             for oid in pt.pinned:
                 self.reference_counter.remove_task_pin(oid)
+            if spec.actor_id is None:
+                self._task_finished("error")
 
     # ------------------------------------------------------ actor lifecycle
     def create_actor(self, cls: Any, args: tuple, kwargs: dict,
@@ -1599,9 +1642,17 @@ class CoreWorker:
             return
         if any(t.ident == ident for t in threading.enumerate()):
             return
+        # release the dead executor's bookkeeping (its work queue and
+        # thread registry otherwise leak for the worker's lifetime);
+        # wait=False since the only thread is already gone
+        old = self.executor
         self.executor = ThreadPoolExecutor(max_workers=1,
                                            thread_name_prefix="rayt-exec")
         self._exec_thread_ident = None
+        try:
+            old.shutdown(wait=False)
+        except Exception:
+            pass
 
     async def rpc_push_task(self, conn, spec: TaskSpec):
         loop = asyncio.get_running_loop()
@@ -1629,12 +1680,23 @@ class CoreWorker:
                                 and out[0] == "task_error")
         finally:
             self._running_normal_task = None
+        dur = time.perf_counter() - t0
         self.task_events.record(
             name=spec.name or "task", task_id=spec.task_id.hex(),
-            kind="task", start_s=t_wall, dur_s=time.perf_counter() - t0,
+            kind="task", start_s=t_wall, dur_s=dur,
             ok=not (isinstance(out, tuple) and out
                     and out[0] == "task_error"))
+        self._observe_exec_latency(dur, "task")
         return out
+
+    @staticmethod
+    def _observe_exec_latency(dur_s: float, kind: str):
+        try:
+            from ray_tpu.util import builtin_metrics as _bm
+
+            _bm.task_exec_latency.observe(dur_s, tags={"kind": kind})
+        except Exception:
+            pass
 
     def rpc_cancel_task(self, conn, arg):
         """Worker-side cancel (ref analog: CoreWorker::HandleCancelTask).
@@ -1751,9 +1813,17 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         opts = spec.actor_options
         if opts is not None and opts.max_concurrency > 1:
+            # same leak as _ensure_executor_alive: the default 1-thread
+            # executor this replaces is idle on a fresh worker — shut it
+            # down rather than stranding its thread + queue
+            old = self.executor
             self.executor = ThreadPoolExecutor(
                 max_workers=opts.max_concurrency,
                 thread_name_prefix="rayt-actor")
+            try:
+                old.shutdown(wait=False)
+            except Exception:
+                pass
         err = await loop.run_in_executor(
             None, self._instantiate_actor, spec)
         return err
@@ -1872,13 +1942,15 @@ class CoreWorker:
             out = self._execute_actor_task_body(spec)
             sp["ok"] = not (isinstance(out, tuple) and out
                             and out[0] == "task_error")
+        dur = time.perf_counter() - t0
         self.task_events.record(
             name=spec.method_name or "actor_task",
             task_id=spec.task_id.hex(), kind="actor_task",
             actor_id=self.actor_id.hex() if self.actor_id else "",
-            start_s=t_wall, dur_s=time.perf_counter() - t0,
+            start_s=t_wall, dur_s=dur,
             ok=not (isinstance(out, tuple) and out
                     and out[0] == "task_error"))
+        self._observe_exec_latency(dur, "actor")
         return out
 
     def _execute_actor_task_body(self, spec: TaskSpec):

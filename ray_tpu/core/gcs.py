@@ -25,10 +25,12 @@ from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import Connection, RpcServer, connect
 from ray_tpu.core.common import (ActorInfo, ActorState, Address, NodeInfo,
                                  TaskSpec, now)
+from ray_tpu.util.metrics import CH_METRICS
 
 logger = setup_logger("gcs")
 
-# Pubsub channel names
+# Pubsub channel names (CH_METRICS is canonical in util/metrics.py — the
+# emit side owns it; re-exported here next to its siblings)
 CH_NODE = "node_events"          # {"event": "added"|"removed", "node": NodeInfo}
 CH_ACTOR = "actor_events"        # ActorInfo
 CH_ERROR = "error_events"
@@ -91,6 +93,14 @@ class GcsServer:
         self._dedup_inflight: dict[tuple, asyncio.Future] = {}
         # task-event ring for `rayt timeline` (ref: gcs_task_manager.h)
         self._task_events: deque = deque(maxlen=50_000)
+        # metrics time-series store fed by the `metrics` pubsub channel
+        # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
+        from ray_tpu.core.metrics_store import MetricsStore
+
+        cfg = get_config()
+        self.metrics_store = MetricsStore(
+            retention_s=cfg.metrics_retention_s,
+            resolution_s=cfg.metrics_resolution_s)
         # channel -> set of subscribed connections
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
@@ -154,14 +164,14 @@ class GcsServer:
         }, pending_blobs)
 
     def _write_snapshot(self):
+        """Synchronous snapshot (tests / non-loop callers). Runtime
+        paths (_flush_loop, stop) pickle on the loop and write via
+        run_in_executor instead — a blocking put from the event loop
+        would stall every handler for a remote store's RTT."""
         import pickle
 
-        # serialize on the caller (event-loop) thread — the tables are
-        # mutated by handlers on that loop, so pickling from an executor
-        # thread would race ("dict changed size during iteration")
         state, blobs = self._snapshot_state()
-        data = pickle.dumps(state, protocol=4)
-        self._write_snapshot_bytes(data, blobs)
+        self._write_snapshot_bytes(pickle.dumps(state, protocol=4), blobs)
 
     def _write_snapshot_bytes(self, data: bytes, blobs: dict):
         for digest, value in blobs.items():
@@ -223,19 +233,25 @@ class GcsServer:
         logger.info("GCS snapshot loaded: %d nodes, %d actors, %d jobs",
                     len(self.nodes), len(self.actors), len(self.jobs))
 
-    async def _flush_loop(self):
+    async def _flush_off_loop(self):
+        """Pickle on the loop thread (consistent table view — handlers
+        mutate these dicts on this loop), write off-loop (a blocking put
+        from the loop would stall every handler for a remote store's
+        RTT). Shared by the periodic flush and the shutdown flush."""
         import pickle
 
+        state, blobs = self._snapshot_state()
+        data = pickle.dumps(state, protocol=4)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write_snapshot_bytes, data, blobs)
+
+    async def _flush_loop(self):
         while True:
             await asyncio.sleep(0.1)
             if self._dirty:
                 self._dirty = False
                 try:
-                    # pickle on the loop (consistent view), write off-loop
-                    state, blobs = self._snapshot_state()
-                    data = pickle.dumps(state, protocol=4)
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, self._write_snapshot_bytes, data, blobs)
+                    await self._flush_off_loop()
                 except Exception:
                     self._dirty = True  # don't lose the mutation
                     logger.exception("GCS snapshot write failed")
@@ -255,8 +271,20 @@ class GcsServer:
                         t - self.node_last_heartbeat.get(nid, t) > timeout:
                     await self._on_node_lost(nid)
 
+    async def _metrics_prune_loop(self):
+        """Drop metric series idle past 2x retention so the name
+        directory (and per-query scans) stay bounded on long-lived
+        clusters with churning tag sets (finished train experiments)."""
+        while True:
+            await asyncio.sleep(60.0)
+            try:
+                self.metrics_store.prune()
+            except Exception:
+                pass
+
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         port = await self.server.start(host, port)
+        self._bg.append(asyncio.ensure_future(self._metrics_prune_loop()))
         if self._backend is not None:
             self._bg.append(asyncio.ensure_future(self._flush_loop()))
             self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
@@ -274,7 +302,7 @@ class GcsServer:
             t.cancel()
         if self._backend is not None and self._dirty:
             try:
-                self._write_snapshot()
+                await self._flush_off_loop()
             except Exception:
                 pass
         if self._backend is not None:
@@ -285,8 +313,12 @@ class GcsServer:
     async def publish(self, channel: str, message: Any):
         if channel == CH_ACTOR:
             self.mark_dirty()  # every actor event is a table mutation
-        if channel == "metrics":
-            self._aggregate_metric(message)
+        if channel == CH_METRICS:
+            # batched publishes (util/metrics.py flusher) arrive as lists
+            if isinstance(message, list):
+                self.metrics_store.ingest_many(message)
+            else:
+                self.metrics_store.ingest(message)
         dead = []
         for conn in self.subscribers.get(channel, ()):  # push-based pubsub
             if conn.closed:
@@ -918,28 +950,6 @@ class GcsServer:
         return self.placement_groups.get(pg_id)
 
     # ------------------------------------------------------------ metrics
-    def _aggregate_metric(self, msg: dict):
-        """Cluster-wide metric aggregation (ref analog:
-        _private/metrics_agent.py:483 aggregating per-node metrics for
-        Prometheus): counters accumulate, gauges last-write-wins,
-        histograms keep count+sum."""
-        if not hasattr(self, "metrics_store"):
-            self.metrics_store: dict = {}
-        try:
-            key = (msg["name"], msg["kind"],
-                   tuple(sorted((msg.get("tags") or {}).items())))
-            entry = self.metrics_store.setdefault(
-                key, {"value": 0.0, "count": 0, "sum": 0.0})
-            if msg["kind"] == "counter":
-                entry["value"] += float(msg["value"])
-            elif msg["kind"] == "gauge":
-                entry["value"] = float(msg["value"])
-            else:  # histogram observation
-                entry["count"] += 1
-                entry["sum"] += float(msg["value"])
-        except Exception:
-            pass
-
     def rpc_add_task_events(self, conn, events: list):
         """Bounded task-event ring (ref: gcs_task_manager.h event store)."""
         self._task_events.extend(events)
@@ -949,11 +959,16 @@ class GcsServer:
         return list(self._task_events)
 
     def rpc_metrics_snapshot(self, conn, arg=None):
-        store = getattr(self, "metrics_store", {})
-        return [
-            {"name": name, "kind": kind, "tags": dict(tags), **entry}
-            for (name, kind, tags), entry in store.items()
-        ]
+        return self.metrics_store.snapshot()
+
+    def rpc_metrics_names(self, conn, arg=None):
+        return self.metrics_store.names()
+
+    def rpc_metrics_query(self, conn, arg):
+        """arg: {"name", "window_s"?, "step_s"?, "agg"?, "tags"?,
+        "merge"?} — the dashboard's /api/metrics/query backend, also
+        reachable by any GCS client (state API)."""
+        return self.metrics_store.query(**dict(arg or {}))
 
     def rpc_report_task_demand(self, conn, demand: dict):
         """A driver's task found no feasible node: remember the demand
@@ -1094,10 +1109,14 @@ class GcsClient:
         "get_all_nodes", "get_cluster_resources", "get_all_jobs",
         "get_actor_info", "get_named_actor", "get_all_actors",
         "actor_handle_state", "get_placement_group", "metrics_snapshot",
+        "metrics_names", "metrics_query",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU
         "report_task_demand", "add_task_events",
+        # pubsub events are best-effort/at-least-once by nature; the
+        # 200ms metric batches especially must not churn the dedup LRU
+        "publish",
         # conn-bound: GCS stores the calling connection for death
         # detection, so the retry MUST re-execute on the new connection
         # (re-registration is idempotent on the tables)

@@ -256,6 +256,7 @@ class NodeManager:
         # change (the full-view protocol was self-healing; deltas aren't)
         self._hb_lock = asyncio.Lock()
         self._spread_counter = 0
+        self._last_metrics_pub = 0.0
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
         self._pull_manager = _PullManager(self)
@@ -324,11 +325,41 @@ class NodeManager:
             try:
                 await self._push_heartbeat()
                 await self._refresh_view()
+                await self._publish_node_metrics()
             except Exception:
                 if self.gcs_conn is not None and self.gcs_conn.closed \
                         and not self._stopping:
                     await self._reconnect_gcs()
             await asyncio.sleep(get_config().gcs_health_check_period_s)
+
+    async def _publish_node_metrics(self):
+        """Resource-utilization gauges onto the GCS metrics channel (ref
+        analog: the per-node metrics agent's node gauges). This process
+        has no core worker, so it publishes raw records directly on the
+        persistent GCS connection, throttled to node_metrics_period_s."""
+        t = time.time()
+        if t - self._last_metrics_pub < get_config().node_metrics_period_s:
+            return
+        self._last_metrics_pub = t
+        from ray_tpu.util.builtin_metrics import node_gauge_records
+        from ray_tpu.util.metrics import CH_METRICS
+
+        try:
+            store_bytes = self._unspilled_bytes()
+            store_cap = self._store_capacity()
+        except Exception:
+            store_bytes, store_cap = 0, 0
+        recs = node_gauge_records(
+            self.node_id.hex(),
+            resources_total=self.resources_total,
+            resources_available=self.resources_available,
+            num_workers=len(self.workers),
+            object_store_bytes=store_bytes,
+            object_store_capacity=store_cap, ts=t)
+        try:
+            await self.gcs_conn.call("publish", (CH_METRICS, recs))
+        except Exception:
+            pass  # metrics are best-effort; heartbeats carry liveness
 
     async def _refresh_view(self):
         resp = await self.gcs_conn.call("get_cluster_resources_delta",

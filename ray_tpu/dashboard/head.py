@@ -4,12 +4,19 @@ job_manager.py:59 + job_supervisor.py subprocess-driver jobs,
 _private/metrics_agent.py:483 Prometheus text export).
 
 Endpoints:
-  GET  /metrics                 — Prometheus text format
+  GET  /metrics                 — Prometheus text (cluster-consolidated
+                                  from the GCS time-series store, full
+                                  histogram buckets included)
   GET  /api/cluster_status      — GCS cluster summary
-  GET  /api/nodes | /api/actors | /api/jobs
+  GET  /api/nodes | /api/actors | /api/jobs | /api/serve
+  GET  /api/metrics/names       — metric directory (name/kind/tag keys)
+  GET  /api/metrics/query       — ?name=&window=&step=&agg=&merge=&tag.K=V
+                                  aligned time series from the store
+  GET  /api/timeline            — Chrome trace JSON of the GCS task-event
+                                  ring (load in Perfetto / chrome://tracing)
   POST /api/jobs                — {"entrypoint": "...", "env": {...}}
   GET  /api/jobs/{id}           — submission status
-  GET  /api/jobs/{id}/logs      — captured stdout+stderr
+  GET  /api/jobs/{id}/logs      — captured stdout+stderr (?offset= tails)
 """
 
 from __future__ import annotations
@@ -220,20 +227,28 @@ def _prom_escape(value: str) -> str:
 
 
 def prometheus_text(snapshot: list[dict]) -> str:
-    """Render the GCS metric snapshot in Prometheus exposition format."""
+    """Render the GCS time-series store snapshot in Prometheus
+    exposition format — cluster-consolidated (every process's records
+    aggregated by the store), histograms with full cumulative buckets
+    (ref: _private/metrics_agent.py:483 text export)."""
     lines: list[str] = []
     seen_types: set[str] = set()
     for m in snapshot:
         name = m["name"].replace(".", "_").replace("-", "_")
-        kind = {"counter": "counter", "gauge": "gauge"}.get(
-            m["kind"], "summary")
+        kind = {"counter": "counter", "gauge": "gauge",
+                "histogram": "histogram"}.get(m["kind"], "untyped")
         if name not in seen_types:
             seen_types.add(name)
             lines.append(f"# TYPE {name} {kind}")
+        tag_items = sorted(m.get("tags", {}).items())
         tags = ",".join(f'{k}="{_prom_escape(str(v))}"'
-                        for k, v in sorted(m.get("tags", {}).items()))
+                        for k, v in tag_items)
         label = f"{{{tags}}}" if tags else ""
         if m["kind"] == "histogram":
+            for le, cum in m.get("buckets", []):
+                bt = ",".join([tags, f'le="{le}"'] if tags
+                              else [f'le="{le}"'])
+                lines.append(f"{name}_bucket{{{bt}}} {cum}")
             lines.append(f"{name}_count{label} {m['count']}")
             lines.append(f"{name}_sum{label} {m['sum']}")
         else:
@@ -260,6 +275,10 @@ class DashboardHead:
         app.router.add_get("/api/cluster_status", self._cluster_status)
         app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/serve", self._serve)
+        app.router.add_get("/api/metrics/names", self._metrics_names)
+        app.router.add_get("/api/metrics/query", self._metrics_query)
+        app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/jobs", self._jobs_list)
         app.router.add_post("/api/jobs", self._jobs_submit)
         app.router.add_get("/api/jobs/{sub_id}", self._job_status)
@@ -328,6 +347,103 @@ class DashboardHead:
             for aid, info in self.gcs.actors.items()
         ]
         return web.json_response(actors)
+
+    async def _serve(self, request):
+        """Serve overview derived from the metrics pipeline + actor
+        table: per-deployment QPS / latency percentiles from the
+        time-series store, replica-actor liveness from the GCS (no actor
+        RPC needed — the head stays a pure reader)."""
+        from aiohttp import web
+
+        store = self.gcs.metrics_store
+
+        def last_value(points):
+            """Prefer the last COMPLETE step: the final point covers the
+            partially-elapsed current minute, so its rate undercounts by
+            the un-elapsed fraction (sawtooth at minute boundaries)."""
+            full = [v for _, v in points[:-1] if v is not None]
+            if full:
+                return full[-1]
+            return next((v for _, v in reversed(points)
+                         if v is not None), None)
+
+        deployments: dict[tuple, dict] = {}
+        qps = store.query("rayt_serve_requests_total", window_s=120.0,
+                          step_s=60.0)
+        for s in qps["series"]:
+            t = s["tags"]
+            key = (t.get("app", ""), t.get("deployment", ""))
+            deployments.setdefault(key, {})["qps"] = \
+                last_value(s["points"]) or 0.0
+        for agg in ("p50", "p99"):
+            lat = store.query("rayt_serve_request_latency_s",
+                              window_s=120.0, step_s=60.0, agg=agg)
+            for s in lat["series"]:
+                t = s["tags"]
+                key = (t.get("app", ""), t.get("deployment", ""))
+                deployments.setdefault(key, {})[f"latency_{agg}_s"] = \
+                    last_value(s["points"])
+        totals = {tuple(sorted(m["tags"].items())): m["value"]
+                  for m in store.snapshot()
+                  if m["name"] == "rayt_serve_requests_total"}
+        for key, entry in deployments.items():
+            app, dep = key
+            entry["requests_total"] = totals.get(
+                tuple(sorted({"app": app,
+                              "deployment": dep}.items())), 0.0)
+        replicas_alive = sum(
+            1 for info in self.gcs.actors.values()
+            if info.class_name == "ReplicaActor" and info.state == "ALIVE")
+        return web.json_response({
+            "deployments": [
+                {"app": app, "deployment": dep, **entry}
+                for (app, dep), entry in sorted(deployments.items())],
+            "replicas_alive": replicas_alive,
+        })
+
+    async def _metrics_names(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.gcs.metrics_store.names())
+
+    async def _metrics_query(self, request):
+        from aiohttp import web
+
+        q = request.query
+        name = q.get("name")
+        if not name:
+            return web.json_response({"error": "name required"},
+                                     status=400)
+        tags = {k[4:]: v for k, v in q.items() if k.startswith("tag.")}
+        try:
+            out = self.gcs.metrics_store.query(
+                name,
+                window_s=float(q.get("window", 300.0)),
+                step_s=float(q["step"]) if "step" in q else None,
+                agg=q.get("agg") or None,
+                merge=q.get("merge", "") in ("1", "true", "yes"),
+                tags=tags or None)
+        except (ValueError, KeyError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
+    async def _timeline(self, request):
+        from aiohttp import web
+
+        from ray_tpu._internal.tracing import to_chrome_trace
+
+        # ?count=1: cheap poll for the SPA — converting + serializing
+        # the full 50k-event ring on the GCS event loop per 2s refresh
+        # would stall heartbeat/lease handling
+        if request.query.get("count"):
+            return web.json_response(
+                {"events": len(self.gcs._task_events)})
+        # full download: copy the ring on-loop (cheap), build + serialize
+        # the multi-MB trace off-loop so heartbeats/leases don't stall
+        events = list(self.gcs._task_events)
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: json.dumps(to_chrome_trace(events)))
+        return web.Response(text=body, content_type="application/json")
 
     async def _jobs_list(self, request):
         from aiohttp import web

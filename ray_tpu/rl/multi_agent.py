@@ -382,32 +382,6 @@ class MultiAgentPPO:
             "time_this_iter_s": time.perf_counter() - t0,
         }
 
-    def _build_batch(self, trajs: list[dict]):
-        cfg = self.config
-        obs, acts, logps, advs, rets = [], [], [], [], []
-        ep_returns: list[float] = []
-        steps = 0
-        for s in trajs:
-            adv, ret = compute_gae(
-                s["rewards"], s["values"], s["dones"], s["last_value"],
-                cfg.gamma, cfg.gae_lambda, s.get("trunc_values"))
-            T, S = s["rewards"].shape
-            steps += T * S
-            obs.append(s["obs"].reshape((T * S,) + s["obs"].shape[2:]))
-            acts.append(s["actions"].reshape(T * S))
-            logps.append(s["logp"].reshape(T * S))
-            advs.append(adv.reshape(T * S))
-            rets.append(ret.reshape(T * S))
-            ep_returns.extend(s["episode_returns"])
-        batch = {
-            "obs": np.concatenate(obs),
-            "actions": np.concatenate(acts),
-            "logp_old": np.concatenate(logps),
-            "advantages": np.concatenate(advs),
-            "returns": np.concatenate(rets),
-        }
-        return batch, ep_returns, steps
-
     def get_weights(self) -> dict:
         return self._weights
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import time
 from typing import Any, Optional
 
 import cloudpickle
@@ -52,12 +53,28 @@ class ReplicaActor:
             return DeploymentHandle(arg.deployment_name, arg.app_name)
         return arg
 
+    def _record_request(self, t0: float):
+        """QPS + latency telemetry (ref analog: serve's
+        serve_deployment_request_counter / processing_latency_ms);
+        batched per-process, never an RPC on the request path."""
+        try:
+            from ray_tpu.util import builtin_metrics as bm
+
+            tags = {"app": self.app_name,
+                    "deployment": self.deployment_name}
+            bm.serve_requests.inc(tags=tags)
+            bm.serve_request_latency.observe(
+                time.perf_counter() - t0, tags=tags)
+        except Exception:
+            pass
+
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict, model_id: str = "") -> Any:
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
         self._ongoing += 1
         self._total += 1
+        t0 = time.perf_counter()
         token = _set_model_id(model_id)
         try:
             if method_name == "__call__":
@@ -75,6 +92,7 @@ class ReplicaActor:
         finally:
             _reset_model_id(token)
             self._ongoing -= 1
+            self._record_request(t0)
 
     async def handle_request_streaming(self, method_name: str, args: tuple,
                                        kwargs: dict, model_id: str = ""):
@@ -86,6 +104,7 @@ class ReplicaActor:
 
         self._ongoing += 1
         self._total += 1
+        t0 = time.perf_counter()
         token = _set_model_id(model_id)
         try:
             if method_name == "__call__":
@@ -112,6 +131,7 @@ class ReplicaActor:
         finally:
             _reset_model_id(token)
             self._ongoing -= 1
+            self._record_request(t0)
 
     def get_stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total}

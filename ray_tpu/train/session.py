@@ -12,6 +12,7 @@ import collections
 import os
 import shutil
 import threading
+import time
 from typing import Any, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -35,6 +36,7 @@ class TrainContext:
         # resume past existing step dirs so a restarted worker group never
         # reuses checkpoint_* names the controller has already seen
         self._report_index = self._next_free_index(experiment_path)
+        self._last_report_t: float | None = None
 
     @staticmethod
     def _next_free_index(experiment_path: str) -> int:
@@ -73,7 +75,41 @@ class TrainContext:
         axes = self.mesh_axes or {"data": -1}
         return build_mesh(dict(axes), devices)
 
+    def _emit_metrics(self, metrics: dict):
+        """Per-report training telemetry onto the cluster metrics
+        pipeline (TorchTitan-style per-step throughput — PAPERS.md):
+        tokens/sec (passthrough or tokens/dt), MFU, and a generic gauge
+        per scalar key so any reported metric charts on the dashboard."""
+        from ray_tpu.util import builtin_metrics as bm
+
+        t = time.monotonic()
+        dt = (t - self._last_report_t
+              if self._last_report_t is not None else None)
+        self._last_report_t = t
+        tags = {"experiment": self.experiment_name, "rank": str(self.rank)}
+
+        def scalar(key):
+            v = metrics.get(key)
+            return float(v) if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else None
+
+        tps = scalar("tokens_per_s")
+        if tps is None and dt and dt > 0 and scalar("tokens") is not None:
+            tps = scalar("tokens") / dt
+        if tps is not None:
+            bm.train_tokens_per_s.set(tps, tags=tags)
+        mfu = scalar("mfu")
+        if mfu is not None:
+            bm.train_mfu.set(mfu, tags=tags)
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                bm.train_metric.set(float(v), tags={**tags, "key": str(k)})
+
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        try:
+            self._emit_metrics(metrics)
+        except Exception:
+            pass  # telemetry must never fail a train step
         entry = {"metrics": dict(metrics), "rank": self.rank,
                  "index": self._report_index, "checkpoint_dir": None}
         if checkpoint is not None:

@@ -1,0 +1,99 @@
+"""Built-in instrumentation metrics emitted from the hot paths (ref
+analog: the reference's ray_metrics_* / serve_* / train telemetry
+families surfaced on every cluster by default).
+
+One module owns the definitions so the dashboard, tests, and call sites
+agree on names and tag keys. All emission rides the batched publisher in
+util/metrics.py, so a call here costs a lock + dict update. Tag keys are
+deliberately low-cardinality: task metrics tag only by kind
+(task/actor), never by task name.
+
+Families:
+* ``rayt_task_*`` — core worker: scheduling (submit→lease) and
+  execution latency histograms, owner queue depth, submit/finish
+  counters.
+* ``rayt_node_*`` — node manager resource gauges (emitted directly on
+  the node manager's GCS connection; see node_manager.py — that process
+  has no core worker).
+* ``rayt_serve_*`` — replica QPS counter + request latency histogram.
+* ``rayt_train_*`` — per-report tokens/sec + MFU gauges and a generic
+  per-key gauge for everything else a train loop reports.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# sub-millisecond to a minute: covers scheduling RTTs and user tasks
+LATENCY_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# ---- core worker ----
+task_sched_latency = Histogram(
+    "rayt_task_sched_latency_s",
+    "Submission-to-lease-grant latency (owner-side queueing + "
+    "scheduling)", boundaries=LATENCY_BOUNDS)
+task_exec_latency = Histogram(
+    "rayt_task_exec_latency_s",
+    "Task body execution wall time on the worker",
+    boundaries=LATENCY_BOUNDS, tag_keys=("kind",))
+task_queue_depth = Gauge(
+    "rayt_task_queue_depth",
+    "Tasks submitted by this owner and not yet finished",
+    tag_keys=("owner",))  # per-owner series; without the tag every
+# process would last-write-win the same series and the chart would flap
+tasks_submitted = Counter(
+    "rayt_tasks_submitted_total", "Normal tasks submitted")
+tasks_finished = Counter(
+    "rayt_tasks_finished_total", "Normal tasks finished",
+    tag_keys=("status",))
+
+# ---- serve ----
+serve_requests = Counter(
+    "rayt_serve_requests_total", "Requests handled per deployment",
+    tag_keys=("app", "deployment"))
+serve_request_latency = Histogram(
+    "rayt_serve_request_latency_s", "Replica request handling latency",
+    boundaries=LATENCY_BOUNDS, tag_keys=("app", "deployment"))
+
+# ---- train ----
+train_tokens_per_s = Gauge(
+    "rayt_train_tokens_per_s",
+    "Training throughput from session.report (tokens_per_s passthrough "
+    "or tokens/dt)", tag_keys=("experiment", "rank"))
+train_mfu = Gauge(
+    "rayt_train_mfu", "Model FLOPs utilization reported by the train "
+    "loop", tag_keys=("experiment", "rank"))
+train_metric = Gauge(
+    "rayt_train_metric", "Generic per-key gauge of scalar train-report "
+    "metrics", tag_keys=("experiment", "rank", "key"))
+
+
+def node_gauge_records(node_hex: str, *, resources_total: dict,
+                       resources_available: dict, num_workers: int,
+                       object_store_bytes: int,
+                       object_store_capacity: int, ts: float) -> list:
+    """Build the node manager's resource-utilization gauge records.
+
+    The node manager has no core worker, so it can't use the Gauge
+    class; it publishes raw records on its GCS connection instead. This
+    helper keeps the names/tags next to the rest of the family."""
+    recs = []
+
+    def g(name, value, **tags):
+        recs.append({"name": name, "kind": "gauge", "value": float(value),
+                     "tags": {"node": node_hex, **tags}, "ts": ts})
+
+    for res, total in resources_total.items():
+        avail = float(resources_available.get(res, 0.0))
+        g("rayt_node_resource_total", total, resource=res)
+        g("rayt_node_resource_available", avail, resource=res)
+        if total:
+            g("rayt_node_resource_utilization", 1.0 - avail / total,
+              resource=res)
+    g("rayt_node_workers", num_workers)
+    g("rayt_node_object_store_bytes", object_store_bytes)
+    if object_store_capacity:
+        g("rayt_node_object_store_utilization",
+          object_store_bytes / object_store_capacity)
+    return recs

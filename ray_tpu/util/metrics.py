@@ -3,11 +3,20 @@ python/ray/util/metrics.py:137,187,262).
 
 Metrics register in a per-process registry; each record also publishes to
 the GCS metrics channel (best-effort, dropped when no cluster is up) so
-the state API / dashboard can aggregate cluster-wide.
+the GCS time-series store / dashboard can aggregate cluster-wide.
+
+Publishing is BATCHED (ref analog: the reference's per-node metrics
+agent shipping aggregated OpenCensus views, not raw records): records
+merge into a process-local buffer — counters sum their deltas, gauges
+last-write-win, histogram observations pre-bucket into their metric's
+boundaries — and a flusher on the core worker's IO loop ships one
+publish per ``metrics_flush_interval_s``. Hot paths (per-task latency
+histograms) therefore cost a lock + dict update, never an RPC.
 """
 
 from __future__ import annotations
 
+import asyncio
 import bisect
 import threading
 import time
@@ -19,16 +28,141 @@ _registry_lock = threading.Lock()
 CH_METRICS = "metrics"
 
 
-def _publish(name: str, kind: str, value: float, tags: dict):
-    try:
-        from ray_tpu.core.object_ref import get_core_worker
+class _Batcher:
+    """Process-local record aggregation + periodic flush to the GCS.
 
-        cw = get_core_worker()
-        if cw is None or cw.gcs is None:
+    Thread-safe: metric calls land from any thread; the flush coroutine
+    runs on the core worker's IO loop. When no cluster is connected,
+    records are dropped at the door (matching the old per-record
+    behavior) so the buffer can't grow unbounded in clusterless runs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        # (name, tags) -> {"bounds": tuple, "counts": list, "sum", "count"}
+        self._hists: dict[tuple, dict] = {}
+        self._scheduled = False
+        self._scheduled_at = 0.0
+        self._interval: float | None = None  # cached from config
+
+    def _stale_after(self) -> float:
+        """A flush scheduled longer ago than this is presumed dropped
+        (the core worker it was spawned on shut down mid-flight — e.g.
+        an rt.shutdown()/rt.init() cycle); the next record reschedules
+        on the CURRENT core worker instead of waiting forever. Scales
+        with the configured interval so a >2s flush cadence isn't
+        mistaken for a dead flush."""
+        return max(2.0, 2.0 * (self._interval or 0.0) + 0.5)
+
+    def add(self, kind: str, name: str, value: float, tags: dict,
+            bounds: Optional[tuple] = None):
+        cw = self._core_worker()
+        if cw is None:
             return
-        cw.io.spawn(cw.gcs.publish(CH_METRICS, {
-            "name": name, "kind": kind, "value": value, "tags": tags,
-            "ts": time.time()}))
+        key = (name, tuple(sorted(tags.items())))
+        with self._lock:
+            if kind == "counter":
+                self._counters[key] = self._counters.get(key, 0.0) + value
+            elif kind == "gauge":
+                self._gauges[key] = value
+            else:
+                h = self._hists.get(key)
+                if h is None or h["bounds"] != bounds:
+                    h = self._hists[key] = {
+                        "bounds": bounds,
+                        "counts": [0] * (len(bounds) + 1),
+                        "sum": 0.0, "count": 0}
+                h["counts"][bisect.bisect_left(bounds, value)] += 1
+                h["sum"] += value
+                h["count"] += 1
+            now = time.monotonic()
+            schedule = (not self._scheduled
+                        or now - self._scheduled_at > self._stale_after())
+            if schedule:
+                self._scheduled = True
+                self._scheduled_at = now
+        if schedule:
+            self._spawn_flush(cw)
+
+    @staticmethod
+    def _core_worker():
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            if cw is None or cw.gcs is None:
+                return None
+            return cw
+        except Exception:
+            return None
+
+    def _spawn_flush(self, cw):
+        try:
+            # shutdown-tracked spawn: the sweep cancels it instead of
+            # leaving a destroyed-pending task at loop teardown
+            cw._spawn_from_thread(self._flush_later(cw))
+        except Exception:
+            with self._lock:
+                self._scheduled = False
+
+    def _drain(self) -> list[dict]:
+        ts = time.time()
+        with self._lock:
+            counters, self._counters = self._counters, {}
+            gauges, self._gauges = self._gauges, {}
+            hists, self._hists = self._hists, {}
+        out: list[dict] = []
+        for (name, tags), v in counters.items():
+            out.append({"name": name, "kind": "counter", "value": v,
+                        "tags": dict(tags), "ts": ts})
+        for (name, tags), v in gauges.items():
+            out.append({"name": name, "kind": "gauge", "value": v,
+                        "tags": dict(tags), "ts": ts})
+        for (name, tags), h in hists.items():
+            out.append({"name": name, "kind": "histogram",
+                        "tags": dict(tags), "ts": ts,
+                        "bounds": list(h["bounds"]),
+                        "counts": h["counts"], "sum": h["sum"],
+                        "count": h["count"]})
+        return out
+
+    async def _flush_later(self, cw):
+        from ray_tpu._internal.config import get_config
+
+        try:
+            self._interval = get_config().metrics_flush_interval_s
+            await asyncio.sleep(self._interval)
+        except Exception:
+            pass
+        records = self._drain()
+        try:
+            if records and cw.gcs is not None:
+                await cw.gcs.publish(CH_METRICS, records)
+        except Exception:
+            pass  # best-effort: dropped on GCS hiccup / shutdown
+        resume = False
+        with self._lock:
+            if self._counters or self._gauges or self._hists:
+                resume = True  # records raced in during the publish
+                self._scheduled_at = time.monotonic()
+            else:
+                self._scheduled = False
+        if resume:
+            try:
+                cw._spawn(self._flush_later(cw))  # already on the IO loop
+            except Exception:
+                with self._lock:
+                    self._scheduled = False
+
+
+_batcher = _Batcher()
+
+
+def _publish(name: str, kind: str, value: float, tags: dict,
+             bounds: Optional[tuple] = None):
+    try:
+        _batcher.add(kind, name, value, tags, bounds)
     except Exception:
         pass
 
@@ -124,7 +258,8 @@ class Histogram(Metric):
             counts = self._buckets.setdefault(
                 key, [0] * (len(self._boundaries) + 1))
             counts[bisect.bisect_left(self._boundaries, value)] += 1
-        _publish(self._name, "histogram", float(value), merged)
+        _publish(self._name, "histogram", float(value), merged,
+                 bounds=tuple(self._boundaries))
 
     def buckets(self, tags: Optional[Dict[str, str]] = None) -> list:
         key = tuple(sorted(self._merged_tags(tags).items()))

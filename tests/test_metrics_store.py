@@ -1,0 +1,218 @@
+"""Unit tests for the GCS metrics time-series store: bin retention,
+downsampling, counter→rate conversion, and cross-node histogram
+percentile merge (ref analog: metrics_agent aggregation semantics)."""
+
+from __future__ import annotations
+
+import pytest
+
+from ray_tpu.core.metrics_store import (MetricsStore, _bucket_percentile)
+
+T0 = 1_700_000_000.0  # fixed epoch so tests are deterministic
+
+
+def rec(name, kind, value=None, ts=T0, tags=None, **extra):
+    out = {"name": name, "kind": kind, "tags": tags or {}, "ts": ts}
+    if value is not None:
+        out["value"] = value
+    out.update(extra)
+    return out
+
+
+def series_points(out, idx=0):
+    return out["series"][idx]["points"]
+
+
+def nonnull(points):
+    return [(t, v) for t, v in points if v is not None]
+
+
+class TestCounter:
+    def test_rate_conversion(self):
+        s = MetricsStore(retention_s=120, resolution_s=1.0)
+        # 10 increments of 2.0 spread over 10 seconds
+        for i in range(10):
+            s.ingest(rec("c", "counter", 2.0, ts=T0 + i))
+        out = s.query("c", window_s=20, step_s=10, now=T0 + 10)
+        assert out["kind"] == "counter" and out["agg"] == "rate"
+        # rate * step recovers the total increase
+        total = sum(v * out["step_s"] for _, v in nonnull(
+            series_points(out)))
+        assert total == pytest.approx(20.0)
+
+    def test_increase_agg_and_downsample(self):
+        s = MetricsStore(retention_s=120, resolution_s=1.0)
+        for i in range(10):
+            s.ingest(rec("c", "counter", 1.0, ts=T0 + i))
+        out = s.query("c", window_s=10, step_s=5, agg="increase",
+                      now=T0 + 9.5)
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert sum(vals) == pytest.approx(10.0)
+        assert len(vals) == 2  # two 5s steps, 5 increments each
+        assert vals == [pytest.approx(5.0), pytest.approx(5.0)]
+
+    def test_tag_sets_are_separate_series(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest(rec("c", "counter", 1.0, tags={"route": "a"}))
+        s.ingest(rec("c", "counter", 3.0, tags={"route": "b"}))
+        out = s.query("c", window_s=10, step_s=10, now=T0 + 1)
+        assert len(out["series"]) == 2
+        flt = s.query("c", window_s=10, step_s=10, now=T0 + 1,
+                      tags={"route": "b"})
+        assert len(flt["series"]) == 1
+        total = sum(v * flt["step_s"] for _, v in nonnull(
+            series_points(flt)))
+        assert total == pytest.approx(3.0)
+
+
+class TestGauge:
+    def test_last_write_wins_within_step(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        # one set per second; a 5s step must report the LAST value,
+        # never the sum of the five sets
+        for i in range(5):
+            s.ingest(rec("g", "gauge", float(i + 1), ts=T0 + i))
+        out = s.query("g", window_s=5, step_s=5, now=T0 + 4.5)
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert vals == [pytest.approx(5.0)]
+
+    def test_retention_drops_old_bins(self):
+        s = MetricsStore(retention_s=10, resolution_s=1.0)
+        s.ingest(rec("g", "gauge", 111.0, ts=T0))
+        for i in range(20):  # push the ring past retention
+            s.ingest(rec("g", "gauge", float(i), ts=T0 + 5 + i))
+        out = s.query("g", window_s=10, step_s=1, now=T0 + 25)
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert 111.0 not in vals
+        assert vals[-1] == pytest.approx(19.0)
+
+    def test_merge_sums_across_nodes(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest(rec("g", "gauge", 2.0, tags={"node": "a"}))
+        s.ingest(rec("g", "gauge", 3.0, tags={"node": "b"}))
+        out = s.query("g", window_s=10, step_s=10, merge=True,
+                      now=T0 + 1)
+        assert len(out["series"]) == 1
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert vals == [pytest.approx(5.0)]
+
+
+class TestHistogram:
+    BOUNDS = [0.1, 1.0, 10.0]
+
+    def test_raw_observations_bucket(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        for v in (0.05, 0.5, 5.0, 50.0):
+            s.ingest(rec("h", "histogram", v, bounds=self.BOUNDS))
+        snap = {m["name"]: m for m in s.snapshot()}["h"]
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(55.55)
+        # cumulative buckets: [0.1]=1, [1.0]=2, [10.0]=3, +Inf=4
+        assert [c for _, c in snap["buckets"]] == [1, 2, 3, 4]
+
+    def test_batched_bucket_delta_record(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest(rec("h", "histogram", bounds=self.BOUNDS,
+                     counts=[3, 2, 1, 0], sum=4.0, count=6))
+        snap = {m["name"]: m for m in s.snapshot()}["h"]
+        assert snap["count"] == 6 and snap["sum"] == pytest.approx(4.0)
+        out = s.query("h", window_s=10, step_s=10, agg="count",
+                      now=T0 + 1)
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert vals == [pytest.approx(0.6)]  # 6 obs / 10s step
+
+    def test_cross_node_percentile_merge(self):
+        """Two nodes publish the same histogram with different node
+        tags; merge=True combines their buckets for cluster
+        percentiles."""
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        # node a: 100 observations all <= 0.1
+        s.ingest(rec("h", "histogram", tags={"node": "a"},
+                     bounds=self.BOUNDS, counts=[100, 0, 0, 0],
+                     sum=5.0, count=100))
+        # node b: 100 observations in (1.0, 10.0]
+        s.ingest(rec("h", "histogram", tags={"node": "b"},
+                     bounds=self.BOUNDS, counts=[0, 0, 100, 0],
+                     sum=500.0, count=100))
+        p50 = s.query("h", window_s=10, step_s=10, agg="p50",
+                      merge=True, now=T0 + 1)
+        assert len(p50["series"]) == 1
+        v50 = nonnull(series_points(p50))[0][1]
+        assert v50 <= 0.1 + 1e-9  # median sits at the end of bucket 0
+        p99 = s.query("h", window_s=10, step_s=10, agg="p99",
+                      merge=True, now=T0 + 1)
+        v99 = nonnull(series_points(p99))[0][1]
+        assert 1.0 < v99 <= 10.0  # deep inside node b's bucket
+        mean = s.query("h", window_s=10, step_s=10, agg="mean",
+                       merge=True, now=T0 + 1)
+        vm = nonnull(series_points(mean))[0][1]
+        assert vm == pytest.approx(505.0 / 200)
+
+    def test_same_tags_merge_at_ingest(self):
+        """Identical (name, tags) from different processes land in ONE
+        series — cross-node merge needs no query-side work."""
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        for _ in range(2):  # two 'processes'
+            s.ingest(rec("h", "histogram", tags={}, bounds=self.BOUNDS,
+                         counts=[1, 1, 0, 0], sum=0.6, count=2))
+        out = s.query("h", window_s=10, step_s=10, agg="count",
+                      now=T0 + 1)
+        assert len(out["series"]) == 1
+        vals = [v for _, v in nonnull(series_points(out))]
+        assert vals == [pytest.approx(0.4)]  # 4 obs / 10s
+
+
+class TestStoreHygiene:
+    def test_names_directory(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest(rec("a", "counter", 1.0, tags={"x": "1"}))
+        s.ingest(rec("a", "counter", 1.0, tags={"x": "2", "y": "z"}))
+        s.ingest(rec("b", "gauge", 1.0))
+        names = {n["name"]: n for n in s.names()}
+        assert names["a"]["kind"] == "counter"
+        assert names["a"]["num_series"] == 2
+        assert names["a"]["tag_keys"] == ["x", "y"]
+        assert names["b"]["kind"] == "gauge"
+
+    def test_malformed_records_dropped_not_raised(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest({"name": "x"})  # no kind
+        s.ingest(rec("x", "mystery", 1.0))
+        s.ingest(rec("x", "counter", "not-a-number"))
+        assert s.dropped_records == 3
+        assert s.names() == []  # no phantom series from bad records
+
+    def test_series_cap_evicts_lru(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0, max_series=4)
+        for i in range(8):
+            s.ingest(rec("m", "counter", 1.0, ts=T0 + i,
+                         tags={"i": str(i)}))
+        assert sum(n["num_series"] for n in s.names()) == 4
+
+    def test_prune_idle_series(self):
+        s = MetricsStore(retention_s=10, resolution_s=1.0)
+        s.ingest(rec("old", "gauge", 1.0, ts=T0))
+        s.ingest(rec("new", "gauge", 1.0, ts=T0 + 100))
+        assert s.prune(now=T0 + 100) == 1
+        assert [n["name"] for n in s.names()] == ["new"]
+
+    def test_query_unknown_metric_is_empty(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        out = s.query("nope", window_s=10, now=T0)
+        assert out["series"] == [] and out["kind"] is None
+
+    def test_bad_agg_raises(self):
+        s = MetricsStore(retention_s=60, resolution_s=1.0)
+        s.ingest(rec("c", "counter", 1.0))
+        with pytest.raises(ValueError):
+            s.query("c", agg="p99", now=T0 + 1)
+
+
+def test_bucket_percentile_interpolation():
+    bounds = [1.0, 2.0]
+    # 10 obs uniformly in (1, 2]: p50 interpolates to ~1.5
+    assert _bucket_percentile(bounds, [0, 10, 0], 10, 0.5) == \
+        pytest.approx(1.5)
+    # overflow bucket clamps to the last bound
+    assert _bucket_percentile(bounds, [0, 0, 10], 10, 0.9) == \
+        pytest.approx(2.0)

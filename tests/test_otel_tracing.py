@@ -57,6 +57,48 @@ def test_cross_process_trace_propagation(tmp_path, monkeypatch):
         actor_execs[0]["trace_id"] == actor_root["trace_id"]
 
 
+def test_interleaved_async_tasks_keep_separate_span_stacks(
+        tmp_path, monkeypatch):
+    """Regression (ADVICE r5): with the span stack in threading.local,
+    two asyncio tasks interleaving on ONE loop thread shared a stack, so
+    a submit_span in task A could parent under task B's execute_span.
+    contextvars gives each task a copy-on-write stack."""
+    import asyncio
+
+    from ray_tpu._internal import otel
+
+    trace_dir = str(tmp_path / "spans")
+    monkeypatch.setenv("RAYT_TRACING_DIR", trace_dir)
+    monkeypatch.setattr(otel, "_enabled", None)
+    monkeypatch.setattr(otel, "_out_path", None)
+    otel.enable_tracing(trace_dir)
+
+    t1, t2 = "1" * 32, "2" * 32
+
+    async def task(name, trace_id, first_sleep):
+        carrier = {"traceparent": f"00-{trace_id}-{'a' * 16}-01"}
+        with otel.execute_span(name, carrier):
+            # force interleaving: both tasks sit inside their execute
+            # span before either opens its inner submit span
+            await asyncio.sleep(first_sleep)
+            with otel.submit_span(f"inner-{name}"):
+                await asyncio.sleep(0.01)
+
+    async def main():
+        await asyncio.gather(task("t1", t1, 0.03), task("t2", t2, 0.01))
+
+    asyncio.run(main())
+    by_name = {s["name"]: s for s in otel.read_spans(trace_dir)}
+    # each inner span must live in ITS OWN task's trace and parent on
+    # its own task's execute span — not whichever span pushed last
+    assert by_name["inner-t1"]["trace_id"] == t1
+    assert by_name["inner-t2"]["trace_id"] == t2
+    assert by_name["inner-t1"]["parent_id"] == \
+        by_name["execute t1"]["span_id"]
+    assert by_name["inner-t2"]["parent_id"] == \
+        by_name["execute t2"]["span_id"]
+
+
 def test_tracing_off_is_noop(tmp_path, local_cluster):
     """With tracing off, the span context managers are no-ops and no
     span files appear anywhere near the run."""

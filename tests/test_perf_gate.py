@@ -1,10 +1,10 @@
 """Microbenchmark regression gate (ref analog: release/microbenchmark/
 nightly runs of python/ray/_private/ray_perf.py:93).
 
-Floors are deliberately conservative (~10x below the numbers committed
-in MICROBENCH.json, which were measured on an idle dev box) so the gate
-catches order-of-magnitude regressions — e.g. a reintroduced poll loop
-or a lease-per-task path — without flaking on slow shared CI machines.
+Floors sit ~2-3x below the numbers committed in MICROBENCH.json
+(measured on this class of box): tight enough to catch a real
+regression — e.g. a reintroduced poll loop or a lease-per-task path —
+while leaving headroom for CI noise on slow shared machines.
 """
 
 from __future__ import annotations
