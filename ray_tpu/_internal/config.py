@@ -120,6 +120,15 @@ class Config:
     metrics_flush_interval_s: float = 0.2
     # Node managers publish resource-utilization gauges at this period.
     node_metrics_period_s: float = 2.0
+    # Task lifecycle events (ref: RAY_task_events_report_interval_ms /
+    # gcs_task_manager): workers+node managers record per-task state
+    # transitions into a local ring and flush them to the GCS task
+    # manager. Disabling removes the per-submit recording cost entirely.
+    task_events_enabled: bool = True
+    # GCS task-manager memory bound: max coalesced task records kept;
+    # beyond it the job holding the most records evicts oldest-first,
+    # with per-job dropped accounting (ref: RAY_task_events_max_num_...).
+    task_events_max_tasks: int = 10000
 
     # ---- logging ----
     log_level: str = "INFO"

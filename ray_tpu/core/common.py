@@ -90,6 +90,11 @@ class TaskSpec:
     owner: "WorkerInfo"
     max_retries: int = 0
     retry_exceptions: bool = False
+    # Current attempt number (0-based), set by the submitter before each
+    # (re)dispatch so the executing worker's lifecycle events carry it —
+    # the GCS task manager resolves a retried task's final verdict from
+    # the LATEST attempt (ref: task attempt in gcs_task_manager.h).
+    attempt: int = 0
     # Actor-task fields:
     actor_id: ActorID | None = None
     method_name: str = ""

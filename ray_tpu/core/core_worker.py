@@ -172,6 +172,29 @@ class CoreWorker:
         self.task_events = TaskEventBuffer(self.worker_id.hex(),
                                            self.node_id.hex())
 
+    def _emit_task_event(self, spec: TaskSpec, state: str, *,
+                         error: dict | None = None):
+        """Record one lifecycle state transition for `spec` (ref:
+        task_event_buffer.cc RecordTaskStatusEvent). Never fails the
+        caller — telemetry must not break submission/execution. The
+        attempt number rides the spec (set by the submitter before each
+        dispatch), so worker-side events carry it too."""
+        try:
+            if spec.is_actor_creation:
+                kind = "actor_creation"
+            elif spec.actor_id is not None:
+                kind = "actor_task"
+            else:
+                kind = "task"
+            self.task_events.record_transition(
+                task_id=spec.task_id.hex(),
+                name=spec.name or spec.method_name or "task",
+                kind=kind, state=state, job_id=spec.job_id.hex(),
+                actor_id=spec.actor_id.hex() if spec.actor_id else "",
+                attempt=getattr(spec, "attempt", 0), error=error)
+        except Exception:
+            pass
+
     def _spawn(self, coro) -> "asyncio.Task | None":
         """ensure_future + lifetime tracking (must run on the IO loop).
         During the shutdown sweep new background work is dropped — a task
@@ -932,6 +955,7 @@ class CoreWorker:
             tensor_transport=options.tensor_transport,
             trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
+        self._emit_task_event(spec, "PENDING_ARGS")
         try:
             from ray_tpu.util import builtin_metrics as _bm
 
@@ -1268,6 +1292,8 @@ class CoreWorker:
             try:
                 winfo, token, nm_addr = await self._acquire_lease(
                     spec.resources, strat, pt)
+                spec.attempt = spec.max_retries - pt.retries_left
+                self._emit_task_event(spec, "SCHEDULED")
                 if t_sched is not None:  # first grant only, not retries
                     self._observe_sched_latency(
                         time.perf_counter() - t_sched)
@@ -1287,6 +1313,7 @@ class CoreWorker:
                 return
             try:
                 pt.running_on = winfo
+                self._emit_task_event(spec, "DISPATCHED")
                 conn = await self._conn_to(winfo.address)
                 reply = await conn.call("push_task", spec,
                                         timeout=_TASK_PUSH_TIMEOUT)
@@ -1412,6 +1439,20 @@ class CoreWorker:
         stream = self._streams.get(spec.task_id)
         if stream is not None:
             stream.abort(error)
+        from ray_tpu._internal.tracing import truncate_error
+
+        cause = getattr(error, "cause", None)  # TaskError wraps the app exc
+        if not isinstance(cause, BaseException):
+            cause = error
+        # a deliberate rt.cancel() is CANCELLED, not a failure — it must
+        # not pollute `rayt list tasks --state FAILED` or failure counts
+        terminal = ("CANCELLED" if isinstance(error, TaskCancelledError)
+                    else "FAILED")
+        self._emit_task_event(
+            spec, terminal,
+            error=truncate_error(
+                type(cause).__name__, str(cause),
+                getattr(error, "remote_traceback", "")))
         for i in range(max(spec.num_returns, 0)):
             oid = ObjectID.for_return(spec.task_id, i)
             self.memory_store.put(oid, error, is_exception=True)
@@ -1477,6 +1518,7 @@ class CoreWorker:
             tensor_transport=options.tensor_transport,
             trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
+        self._emit_task_event(spec, "PENDING_ARGS")
         sub = self.get_actor_submitter(actor_id)
         self._spawn_from_thread(sub.submit(spec))
         if spec.num_returns == -1:
@@ -1660,6 +1702,19 @@ class CoreWorker:
         return await loop.run_in_executor(
             self.executor, self._execute_task, spec)
 
+    def _emit_task_failed(self, spec: TaskSpec, e: BaseException, tb: str):
+        """Terminal failure transition carrying the LIVE exception's
+        type/message plus the truncated traceback — recorded at the
+        catch site so the payload never degrades to a traceback
+        re-parse. A cancellation delivered into the body is CANCELLED,
+        not FAILED."""
+        from ray_tpu._internal.tracing import truncate_error
+
+        self._emit_task_event(
+            spec,
+            "CANCELLED" if isinstance(e, TaskCancelledError) else "FAILED",
+            error=truncate_error(type(e).__name__, str(e), tb))
+
     def _execute_task(self, spec: TaskSpec):
         from ray_tpu._internal import otel
 
@@ -1667,7 +1722,8 @@ class CoreWorker:
         # is a threading.local, so it can't serve cross-thread lookups)
         self._exec_thread_ident = threading.get_ident()
         self._running_normal_task = spec.task_id
-        t_wall, t0 = time.time(), time.perf_counter()
+        t0 = time.perf_counter()
+        self._emit_task_event(spec, "RUNNING")
         # execution span parents remotely on the submitter's span: one
         # trace id across the whole task tree (ref: _private/tracing
         # _wrap_task_execution). No-op context when tracing is off.
@@ -1681,11 +1737,9 @@ class CoreWorker:
         finally:
             self._running_normal_task = None
         dur = time.perf_counter() - t0
-        self.task_events.record(
-            name=spec.name or "task", task_id=spec.task_id.hex(),
-            kind="task", start_s=t_wall, dur_s=dur,
-            ok=not (isinstance(out, tuple) and out
-                    and out[0] == "task_error"))
+        if not (isinstance(out, tuple) and out and out[0] == "task_error"):
+            self._emit_task_event(spec, "FINISHED")
+        # (FAILED was emitted at the catch site with the live exception)
         self._observe_exec_latency(dur, "task")
         return out
 
@@ -1750,7 +1804,9 @@ class CoreWorker:
                 return self._stream_returns(spec, result)
             return self._package_returns(spec, result)
         except Exception as e:
-            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
+            tb = traceback.format_exc()
+            self._emit_task_failed(spec, e, tb)
+            return ("task_error", serialize_to_bytes(e), tb)
         finally:
             if restore_env is not None:
                 try:
@@ -1831,6 +1887,7 @@ class CoreWorker:
     def _instantiate_actor(self, spec: TaskSpec) -> str | None:
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
+        self._emit_task_event(spec, "RUNNING")
         try:
             self._apply_runtime_env(spec)
             cls = cloudpickle.loads(spec.function_blob)
@@ -1846,9 +1903,12 @@ class CoreWorker:
                    or inspect.isasyncgenfunction(getattr(cls, m, None))
                    for m in dir(cls) if not m.startswith("__")):
                 self._actor_async_loop = EventLoopThread("rayt-actor-async")
+            self._emit_task_event(spec, "FINISHED")
             return None
-        except Exception:
-            return traceback.format_exc()
+        except Exception as e:
+            tb = traceback.format_exc()
+            self._emit_task_failed(spec, e, tb)
+            return tb
         finally:
             self._exec_ctx.task_id = None
             self._exec_ctx.job_id = None
@@ -1894,6 +1954,7 @@ class CoreWorker:
 
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
+        self._emit_task_event(spec, "RUNNING")
         # span covers the async execution path too (trace ids stay
         # consistent; interleaved async spans are handled by the
         # tracer's entry-removal discipline)
@@ -1909,16 +1970,23 @@ class CoreWorker:
                 kwargs = self._resolve_args_async(spec.kwargs)
                 if spec.num_returns == -1 and \
                         inspect.isasyncgenfunction(method):
-                    return await self._stream_returns_async(
+                    out = await self._stream_returns_async(
                         spec, method(*args, **kwargs))
+                    self._emit_task_event(spec, "FINISHED")
+                    return out
                 result = await method(*args, **kwargs)
                 if spec.num_returns == -1:
-                    return await self._stream_returns_async(spec, result)
-                return self._package_returns(spec, result)
+                    out = await self._stream_returns_async(spec, result)
+                    self._emit_task_event(spec, "FINISHED")
+                    return out
+                out = self._package_returns(spec, result)
+                self._emit_task_event(spec, "FINISHED")
+                return out
             except Exception as e:
                 sp["ok"] = False
-                return ("task_error", serialize_to_bytes(e),
-                        traceback.format_exc())
+                tb = traceback.format_exc()
+                self._emit_task_failed(spec, e, tb)
+                return ("task_error", serialize_to_bytes(e), tb)
             finally:
                 self._exec_ctx.task_id = None
                 self._exec_ctx.job_id = None
@@ -1932,7 +2000,8 @@ class CoreWorker:
     def _execute_actor_task(self, spec: TaskSpec):
         from ray_tpu._internal import otel
 
-        t_wall, t0 = time.time(), time.perf_counter()
+        t0 = time.perf_counter()
+        self._emit_task_event(spec, "RUNNING")
         with otel.execute_span(
                 spec.method_name or "actor_task",
                 getattr(spec, "trace_ctx", None),
@@ -1943,13 +2012,8 @@ class CoreWorker:
             sp["ok"] = not (isinstance(out, tuple) and out
                             and out[0] == "task_error")
         dur = time.perf_counter() - t0
-        self.task_events.record(
-            name=spec.method_name or "actor_task",
-            task_id=spec.task_id.hex(), kind="actor_task",
-            actor_id=self.actor_id.hex() if self.actor_id else "",
-            start_s=t_wall, dur_s=dur,
-            ok=not (isinstance(out, tuple) and out
-                    and out[0] == "task_error"))
+        if not (isinstance(out, tuple) and out and out[0] == "task_error"):
+            self._emit_task_event(spec, "FINISHED")
         self._observe_exec_latency(dur, "actor")
         return out
 
@@ -1977,7 +2041,9 @@ class CoreWorker:
                 return self._stream_returns(spec, result)
             return self._package_returns(spec, result)
         except Exception as e:
-            return ("task_error", serialize_to_bytes(e), traceback.format_exc())
+            tb = traceback.format_exc()
+            self._emit_task_failed(spec, e, tb)
+            return ("task_error", serialize_to_bytes(e), tb)
         finally:
             self._exec_ctx.task_id = None
             self._exec_ctx.job_id = None
@@ -2141,7 +2207,10 @@ class _ActorTaskSubmitter:
             spec.seq_no = self.seq
             self.seq += 1
             address = self.address
+            spec.attempt = spec.max_retries - attempts
+            self.cw._emit_task_event(spec, "SCHEDULED")
             try:
+                self.cw._emit_task_event(spec, "DISPATCHED")
                 conn = await self.cw._conn_to(address)
                 reply = await conn.call(
                     "push_actor_task",

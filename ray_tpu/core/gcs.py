@@ -86,13 +86,19 @@ class GcsServer:
         # record before its retry lands: client_id -> (seq -> (ok,
         # payload)); each client's table is a bounded LRU, snapshotted so
         # a replay across a GCS restart still dedupes
-        from collections import OrderedDict, deque
+        from collections import OrderedDict
         self._dedup_results: OrderedDict[str, OrderedDict] = OrderedDict()
         self._dedup_total = 0
         self._spread_counter = 0
         self._dedup_inflight: dict[tuple, asyncio.Future] = {}
-        # task-event ring for `rayt timeline` (ref: gcs_task_manager.h)
-        self._task_events: deque = deque(maxlen=50_000)
+        # task lifecycle event store: per-job indexed, memory-bounded,
+        # server-side filtered queries (ref: gcs_task_manager.h)
+        from ray_tpu.core.gcs_task_manager import GcsTaskManager
+
+        cfg0 = get_config()
+        self.task_manager = GcsTaskManager(
+            max_tasks=cfg0.task_events_max_tasks)
+        self._task_events_enabled = cfg0.task_events_enabled
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -630,6 +636,7 @@ class GcsServer:
             class_name=spec.name)
         self.actors[spec.actor_id] = info
         self.actor_specs[spec.actor_id] = spec
+        self._record_task_transition(spec, "PENDING_ARGS")
         self.mark_dirty()
         await self.publish(CH_ACTOR, info)
         asyncio.ensure_future(self._schedule_actor(spec.actor_id))
@@ -684,6 +691,7 @@ class GcsServer:
                 await asyncio.sleep(0.2)
                 continue
             conn = self.node_conns[node_id]
+            self._record_task_transition(spec, "SCHEDULED")
             self._actors_placing.add(actor_id)
             try:
                 # Must exceed the node-side create_actor push timeout (300s,
@@ -949,14 +957,45 @@ class GcsServer:
     def rpc_get_placement_group(self, conn, pg_id):
         return self.placement_groups.get(pg_id)
 
-    # ------------------------------------------------------------ metrics
+    # -------------------------------------------------------- task events
+    def _record_task_transition(self, spec: TaskSpec, state: str,
+                                kind: str = "actor_creation"):
+        """GCS-side lifecycle emission for flows the GCS itself drives
+        (actor creation: registered -> placed); ingested directly, no
+        buffer/flush hop needed in-process."""
+        if not self._task_events_enabled:
+            return
+        from ray_tpu._internal.tracing import make_transition
+
+        self.task_manager.ingest([make_transition(
+            task_id=spec.task_id.hex(), name=spec.name or "Actor",
+            kind=kind, state=state, job_id=spec.job_id.hex(),
+            actor_id=spec.actor_id.hex() if spec.actor_id else "")])
+
     def rpc_add_task_events(self, conn, events: list):
-        """Bounded task-event ring (ref: gcs_task_manager.h event store)."""
-        self._task_events.extend(events)
+        """Ingest flushed worker/node-manager event batches into the
+        task manager (ref: gcs_task_manager.h AddTaskEventData)."""
+        self.task_manager.ingest(events)
         return True
 
     def rpc_get_task_events(self, conn, arg=None):
-        return list(self._task_events)
+        """Filtered coalesced task records (timeline / state API feed).
+        arg: optional {"job_id", "state", "name", "actor_id",
+        "start_us", "end_us", "limit"} — no more full-ring dumps; the
+        filter runs server-side."""
+        filters = dict(arg or {})
+        filters.setdefault("limit", 0)  # timeline export wants everything
+        return self.task_manager.records(**filters)
+
+    def rpc_list_tasks(self, conn, arg=None):
+        """State API `list_tasks` backend: filtered, limited, newest
+        first, with truncation + per-job dropped accounting."""
+        return self.task_manager.list(**dict(arg or {}))
+
+    def rpc_summarize_tasks(self, conn, arg=None):
+        """State API `summarize_tasks` backend: per-task-name state
+        counts + scheduling-vs-execution latency split."""
+        return self.task_manager.summarize(**dict(arg or {}))
 
     def rpc_metrics_snapshot(self, conn, arg=None):
         return self.metrics_store.snapshot()
@@ -1110,6 +1149,7 @@ class GcsClient:
         "get_actor_info", "get_named_actor", "get_all_actors",
         "actor_handle_state", "get_placement_group", "metrics_snapshot",
         "metrics_names", "metrics_query",
+        "get_task_events", "list_tasks", "summarize_tasks",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU

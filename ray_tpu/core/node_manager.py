@@ -262,6 +262,12 @@ class NodeManager:
         self._pull_manager = _PullManager(self)
         self._restore_futs: dict[ObjectID, asyncio.Future] = {}
         self._push_sem: asyncio.Semaphore | None = None
+        # task lifecycle events this daemon emits (actor-creation
+        # dispatch; ref: raylet-side task events feeding
+        # gcs_task_manager) — flushed on the heartbeat cadence
+        from ray_tpu._internal.tracing import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(node_id.hex(), node_id.hex())
         import threading
 
         self._spill_lock = threading.Lock()
@@ -326,6 +332,7 @@ class NodeManager:
                 await self._push_heartbeat()
                 await self._refresh_view()
                 await self._publish_node_metrics()
+                await self._flush_task_events()
             except Exception:
                 if self.gcs_conn is not None and self.gcs_conn.closed \
                         and not self._stopping:
@@ -360,6 +367,15 @@ class NodeManager:
             await self.gcs_conn.call("publish", (CH_METRICS, recs))
         except Exception:
             pass  # metrics are best-effort; heartbeats carry liveness
+
+    async def _flush_task_events(self):
+        events = self.task_events.drain()
+        if not events:
+            return
+        try:
+            await self.gcs_conn.call("add_task_events", events)
+        except Exception:
+            pass  # best-effort: lifecycle events are telemetry
 
     async def _refresh_view(self):
         resp = await self.gcs_conn.call("get_cluster_resources_delta",
@@ -735,6 +751,14 @@ class NodeManager:
         # instance still materializes — a ghost holding leased resources.
         budget = time.monotonic() + \
             get_config().actor_creation_push_timeout_s - 15.0
+        try:
+            self.task_events.record_transition(
+                task_id=spec.task_id.hex(), name=spec.name or "Actor",
+                kind="actor_creation", state="DISPATCHED",
+                job_id=spec.job_id.hex(),
+                actor_id=spec.actor_id.hex() if spec.actor_id else "")
+        except Exception:
+            pass
         logger.info("start_actor %s (%s): acquiring worker",
                     spec.actor_id, spec.name or "")
         try:

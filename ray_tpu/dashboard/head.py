@@ -12,8 +12,13 @@ Endpoints:
   GET  /api/metrics/names       — metric directory (name/kind/tag keys)
   GET  /api/metrics/query       — ?name=&window=&step=&agg=&merge=&tag.K=V
                                   aligned time series from the store
-  GET  /api/timeline            — Chrome trace JSON of the GCS task-event
-                                  ring (load in Perfetto / chrome://tracing)
+  GET  /api/tasks               — ?job=&state=&task_name=&limit= filtered
+                                  task lifecycle records (GCS task manager)
+  GET  /api/tasks/summary       — ?job= per-task-name state counts +
+                                  sched-vs-exec latency split
+  GET  /api/timeline            — Chrome trace JSON of the GCS task
+                                  lifecycle store: nested per-phase slices
+                                  (load in Perfetto / chrome://tracing)
   POST /api/jobs                — {"entrypoint": "...", "env": {...}}
   GET  /api/jobs/{id}           — submission status
   GET  /api/jobs/{id}/logs      — captured stdout+stderr (?offset= tails)
@@ -278,6 +283,8 @@ class DashboardHead:
         app.router.add_get("/api/serve", self._serve)
         app.router.add_get("/api/metrics/names", self._metrics_names)
         app.router.add_get("/api/metrics/query", self._metrics_query)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/tasks/summary", self._tasks_summary)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/jobs", self._jobs_list)
         app.router.add_post("/api/jobs", self._jobs_submit)
@@ -427,22 +434,49 @@ class DashboardHead:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response(out)
 
+    async def _tasks(self, request):
+        """Filtered task lifecycle records (GCS task manager; ref:
+        `ray list tasks` state API endpoint)."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.task_manager.list(
+                job_id=q.get("job") or None,
+                state=q.get("state") or None,
+                name=q.get("task_name") or None,
+                actor_id=q.get("actor") or None,
+                limit=int(q.get("limit", 100)))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
+    async def _tasks_summary(self, request):
+        from aiohttp import web
+
+        out = self.gcs.task_manager.summarize(
+            job_id=request.query.get("job") or None)
+        return web.json_response(out)
+
     async def _timeline(self, request):
         from aiohttp import web
 
         from ray_tpu._internal.tracing import to_chrome_trace
 
         # ?count=1: cheap poll for the SPA — converting + serializing
-        # the full 50k-event ring on the GCS event loop per 2s refresh
+        # the full lifecycle store on the GCS event loop per 2s refresh
         # would stall heartbeat/lease handling
         if request.query.get("count"):
             return web.json_response(
-                {"events": len(self.gcs._task_events)})
-        # full download: copy the ring on-loop (cheap), build + serialize
-        # the multi-MB trace off-loop so heartbeats/leases don't stall
-        events = list(self.gcs._task_events)
+                {"events": self.gcs.task_manager.num_transitions(),
+                 "tasks": self.gcs.task_manager.num_tasks()})
+        # full download: snapshot the filtered records on-loop (cheap),
+        # build + serialize the multi-MB trace off-loop so
+        # heartbeats/leases don't stall
+        records = self.gcs.task_manager.records(
+            job_id=request.query.get("job") or None)
         body = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: json.dumps(to_chrome_trace(events)))
+            None, lambda: json.dumps(to_chrome_trace(records)))
         return web.Response(text=body, content_type="application/json")
 
     async def _jobs_list(self, request):

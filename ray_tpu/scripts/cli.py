@@ -1,8 +1,8 @@
 """CLI (ref analog: python/ray/scripts/scripts.py command set +
 util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
 
-Commands: start, stop, status, summary, list {nodes,actors,jobs,pgs,
-workers}, microbenchmark, job {submit,status,logs,stop,list}
+Commands: start, stop, status, summary [tasks], list {nodes,actors,jobs,
+pgs,workers,tasks}, microbenchmark, job {submit,status,logs,stop,list}
 (ref analog for jobs: dashboard/modules/job/cli.py).
 """
 
@@ -163,7 +163,35 @@ def cmd_summary(args):
     from ray_tpu import state_api
 
     _attach(args)
+    if getattr(args, "kind", None) == "tasks":
+        _print_task_summary(state_api.summarize_tasks(
+            job_id=getattr(args, "job", None)))
+        return
     print(json.dumps(state_api.summary(), indent=2, default=str))
+
+
+def _print_task_summary(s: dict):
+    """`ray summary tasks`-style table: per-task-name state counts and
+    the scheduling-delay vs execution-time latency split."""
+    dropped = sum(s.get("dropped", {}).values())
+    print(f"{s['total_tasks']} tasks stored "
+          f"({dropped} evicted from the GCS store, "
+          f"{s.get('worker_buffer_dropped', 0)} dropped at worker "
+          "buffers cluster-wide)")
+    if not s["by_name"]:
+        return
+    fmt = "{:<32} {:>6} {:>12} {:>12}  {}"
+    print(fmt.format("name", "count", "sched_mean", "exec_mean",
+                     "states"))
+    for name, e in s["by_name"].items():
+        def dur(v):
+            return "—" if v is None else (
+                f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s")
+        states = " ".join(f"{k}={v}"
+                          for k, v in sorted(e["states"].items()))
+        print(fmt.format(name[:32], e["count"],
+                         dur(e["sched_delay_mean_s"]),
+                         dur(e["exec_time_mean_s"]), states))
 
 
 def cmd_list(args):
@@ -171,6 +199,12 @@ def cmd_list(args):
 
     _attach(args)
     kind = args.kind
+    if kind == "tasks":
+        out = state_api.list_tasks(
+            job_id=args.job or None, state=args.state or None,
+            name=args.task_name or None, limit=args.limit, detail=True)
+        print(json.dumps(out, indent=2, default=str))
+        return
     fn = {"nodes": state_api.list_nodes, "actors": state_api.list_actors,
           "jobs": state_api.list_jobs,
           "pgs": state_api.list_placement_groups,
@@ -231,12 +265,15 @@ def cmd_memory(args):
 
 
 def cmd_timeline(args):
-    """Chrome-trace export of the GCS task-event ring (ref analog:
-    `ray timeline`, scripts/scripts.py)."""
+    """Chrome-trace export of the GCS task lifecycle store (ref analog:
+    `ray timeline`, scripts/scripts.py): nested per-phase slices,
+    filtered server-side by job / time window / limit."""
     from ray_tpu import state_api
 
     _attach(args)
-    n = state_api.export_timeline(args.out)
+    n = state_api.export_timeline(
+        args.out, job_id=args.job or None, limit=args.limit or None,
+        start_s=args.start or None, end_s=args.end or None)
     print(f"wrote {n} events to {args.out} "
           "(open in chrome://tracing or ui.perfetto.dev)")
 
@@ -460,14 +497,25 @@ def main(argv=None):
     sp = sub.add_parser("stop", help="stop the head node")
     sp.set_defaults(fn=cmd_stop)
 
-    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
-        sp = sub.add_parser(name)
-        sp.add_argument("--address")
-        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("status")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("summary",
+                        help="cluster rollup, or `summary tasks` for "
+                             "per-task-name states + latency split")
+    sp.add_argument("kind", nargs="?", choices=["tasks"])
+    sp.add_argument("--job", help="filter task summary by job id (hex)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
-                                     "workers"])
+                                     "workers", "tasks"])
+    sp.add_argument("--job", help="tasks: filter by job id (hex)")
+    sp.add_argument("--state", help="tasks: filter by lifecycle state")
+    sp.add_argument("--task-name", help="tasks: filter by task name")
+    sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
 
@@ -499,8 +547,13 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline",
-                        help="export executed-task Chrome trace")
+                        help="export task-lifecycle Chrome trace")
     sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--job", help="filter by job id (hex)")
+    sp.add_argument("--limit", type=int, default=0)
+    sp.add_argument("--start", type=float,
+                    help="window start (unix seconds)")
+    sp.add_argument("--end", type=float, help="window end (unix seconds)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
 

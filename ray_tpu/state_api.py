@@ -125,17 +125,73 @@ def summary() -> dict:
     }
 
 
-def task_events() -> list[dict]:
-    """Raw task-event records from the GCS ring (ref: state API tasks)."""
+def _event_filters(job_id=None, start_s=None, end_s=None, limit=None):
+    filters: dict = {}
+    if job_id is not None:
+        filters["job_id"] = job_id
+    if start_s is not None:
+        filters["start_us"] = int(start_s * 1e6)
+    if end_s is not None:
+        filters["end_us"] = int(end_s * 1e6)
+    if limit is not None:
+        filters["limit"] = limit
+    return filters
+
+
+def task_events(*, job_id: Optional[str] = None,
+                start_s: Optional[float] = None,
+                end_s: Optional[float] = None,
+                limit: Optional[int] = None) -> list[dict]:
+    """Coalesced task lifecycle records from the GCS task manager (ref:
+    gcs_task_manager.h). Filters (job / time window / limit) run
+    SERVER-side — the driver never materializes the full store."""
     cw = _cw()
-    return cw.io.run(cw.gcs.conn.call("get_task_events"))
+    return cw.io.run(cw.gcs.call(
+        "get_task_events", _event_filters(job_id, start_s, end_s, limit)))
 
 
-def export_timeline(path: str) -> int:
-    """Write a Chrome trace of executed tasks (ref: `ray timeline`)."""
+def export_timeline(path: str, *, job_id: Optional[str] = None,
+                    start_s: Optional[float] = None,
+                    end_s: Optional[float] = None,
+                    limit: Optional[int] = None) -> int:
+    """Write a Chrome trace of task lifecycles (ref: `ray timeline`):
+    each task renders as an outer slice with nested per-phase slices
+    (scheduling / dispatch / startup / execution)."""
     from ray_tpu._internal.tracing import export_chrome_trace
 
-    return export_chrome_trace(task_events(), path)
+    return export_chrome_trace(
+        task_events(job_id=job_id, start_s=start_s, end_s=end_s,
+                    limit=limit), path)
+
+
+def list_tasks(*, job_id: Optional[str] = None, state: Optional[str] = None,
+               name: Optional[str] = None, actor_id: Optional[str] = None,
+               limit: int = 100, detail: bool = False) -> list[dict]:
+    """`ray list tasks` analog: filtered task lifecycle records, newest
+    first, queried server-side against the GCS task manager. Each record
+    carries the per-state timestamp map, attempt number, and (for FAILED
+    tasks) the truncated error payload."""
+    cw = _cw()
+    filters = {"limit": limit}
+    if job_id is not None:
+        filters["job_id"] = job_id
+    if state is not None:
+        filters["state"] = state
+    if name is not None:
+        filters["name"] = name
+    if actor_id is not None:
+        filters["actor_id"] = actor_id
+    out = cw.io.run(cw.gcs.call("list_tasks", filters))
+    return out if detail else out["tasks"]
+
+
+def summarize_tasks(*, job_id: Optional[str] = None) -> dict:
+    """`ray summary tasks` analog: per-task-name state counts plus the
+    scheduling-delay vs execution-time latency split, with dropped-event
+    accounting (store eviction per job + worker ring overflow)."""
+    cw = _cw()
+    filters = {"job_id": job_id} if job_id is not None else {}
+    return cw.io.run(cw.gcs.call("summarize_tasks", filters))
 
 
 def list_objects() -> list[dict]:

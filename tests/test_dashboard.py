@@ -147,14 +147,75 @@ def test_index_page_serves_spa(dash_cluster):
     assert html.lstrip().startswith("<!DOCTYPE html>")
     for endpoint in ("/api/nodes", "/api/actors", "/api/jobs",
                      "/api/serve", "/api/cluster_status",
+                     "/api/tasks", "/api/tasks/summary",
                      "/api/metrics/names", "/api/metrics/query",
                      "/api/timeline", "/metrics"):
         assert endpoint in html, endpoint
     # the SPA's interactive pieces: tab views, sparkline canvas charts,
-    # incremental log tailing
+    # incremental log tailing, task failure drill-down
     for marker in ("view-metrics", "view-serve", "view-timeline",
+                   "view-tasks", "task-summary", "task-err",
                    "sparkline", "offset="):
         assert marker in html, marker
+
+
+def test_tasks_endpoint_and_summary(dash_cluster):
+    """/api/tasks serves filtered task lifecycle records (job + state)
+    and /api/tasks/summary the per-name state counts + latency split —
+    the dashboard feed for the Tasks tab."""
+    @rt.remote
+    def dash_ok(x):
+        return x
+
+    @rt.remote(max_retries=0)
+    def dash_fail():
+        raise RuntimeError("dashboard drill-down error")
+
+    assert rt.get([dash_ok.remote(i) for i in range(3)],
+                  timeout=60) == [0, 1, 2]
+    with pytest.raises(Exception):
+        rt.get(dash_fail.remote(), timeout=60)
+
+    port = dash_cluster.dashboard_port
+    deadline = time.monotonic() + 30
+    out = {}
+    while time.monotonic() < deadline:
+        out = json.loads(_get(port, "/api/tasks?limit=50"))
+        states = {t["name"]: t["state"] for t in out["tasks"]}
+        if states.get("dash_fail") == "FAILED" and \
+                states.get("dash_ok") == "FINISHED":
+            break
+        time.sleep(0.3)
+    by_name = {t["name"]: t for t in out["tasks"]}
+    assert by_name["dash_ok"]["state"] == "FINISHED"
+    failed = by_name["dash_fail"]
+    assert failed["state"] == "FAILED"
+    # failure drill-down payload: type + message + truncated traceback
+    assert failed["error"]["type"] == "RuntimeError"
+    assert "dashboard drill-down error" in failed["error"]["message"]
+
+    # server-side state filter
+    out = json.loads(_get(port, "/api/tasks?state=FAILED"))
+    assert {t["name"] for t in out["tasks"]} == {"dash_fail"}
+    # server-side job filter: the real job id matches, a bogus one is empty
+    job = failed["job_id"]
+    out = json.loads(_get(port, f"/api/tasks?job={job}&state=FAILED"))
+    assert out["total"] == 1
+    assert json.loads(_get(port, "/api/tasks?job=nope"))["total"] == 0
+
+    summary = json.loads(_get(port, "/api/tasks/summary"))
+    e = summary["by_name"]["dash_ok"]
+    assert e["count"] == 3 and e["states"] == {"FINISHED": 3}
+    assert e["sched_delay_mean_s"] is not None
+    assert e["exec_time_mean_s"] is not None
+    assert summary["by_name"]["dash_fail"]["failed"] == 1
+    assert json.loads(
+        _get(port, "/api/tasks/summary?job=nope"))["by_name"] == {}
+
+    # timeline renders the lifecycle store with nested phase slices
+    evs = json.loads(_get(port, f"/api/timeline?job={job}"))["traceEvents"]
+    assert any(e["name"] == "dash_ok" for e in evs)
+    assert any("[execution]" in e["name"] for e in evs)
 
 
 def _query(port, name, **params):

@@ -55,6 +55,40 @@ def test_microbenchmark_floors(ray_cluster):
     assert ratio >= 3.0, f"channel DAG only {ratio:.1f}x per-call path"
 
 
+def test_task_event_recording_overhead():
+    """Instrumentation-overhead gate: lifecycle event recording rides
+    the submit/execute hot path (~4 transitions per task: PENDING_ARGS,
+    SCHEDULED, DISPATCHED at the driver; RUNNING/terminal at the
+    worker), so its per-record cost must stay in the microsecond range
+    and the disabled path must be a near-free attribute check."""
+    import time
+
+    from ray_tpu._internal.tracing import TaskEventBuffer
+
+    def per_record_cost(enabled: bool) -> float:
+        buf = TaskEventBuffer("w" * 40, "n" * 40, enabled=enabled)
+        n = 20_000
+        best = float("inf")
+        for _ in range(3):  # best-of-3 to shed CI scheduling noise
+            t0 = time.perf_counter()
+            for i in range(n):
+                buf.record_transition(
+                    task_id="x" * 40, name="bench", kind="task",
+                    state="RUNNING", job_id="y" * 8, attempt=0)
+            best = min(best, (time.perf_counter() - t0) / n)
+            buf.drain()
+        return best
+
+    on, off = per_record_cost(True), per_record_cost(False)
+    # generous floors for 1-core shared CI boxes (measured ~1-3us / ~0.1us)
+    assert off < 10e-6, f"disabled recording costs {off * 1e6:.1f}us"
+    assert on < 50e-6, f"enabled recording costs {on * 1e6:.1f}us"
+    # a full submit's worth of lifecycle events must stay well under the
+    # ~1ms per-task budget implied by the tasks_per_second floor above
+    assert 4 * (on - off) < 200e-6, (
+        f"lifecycle events add {4 * (on - off) * 1e6:.0f}us per submit")
+
+
 def test_lease_reuse_faster_than_fresh_lease(ray_cluster):
     """Back-to-back same-shape tasks must reuse the cached lease (ref:
     normal_task_submitter.cc:291): serial round-trips with reuse should

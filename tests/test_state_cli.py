@@ -75,9 +75,49 @@ def test_cli_start_status_stop(tmp_path):
         r = cli("list", "nodes", "--address", address)
         assert r.returncode == 0, r.stderr
         assert json.loads(r.stdout)[0]["alive"] is True
+
+        # task state API plumbing (empty cluster: no tasks ran yet)
+        r = cli("list", "tasks", "--address", address)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["tasks"] == [] and out["total"] == 0
+
+        r = cli("summary", "tasks", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "0 tasks stored" in r.stdout
     finally:
         r = cli("stop")
         assert r.returncode == 0, r.stderr
+
+
+def test_cli_task_summary_rendering_live(local_cluster, capsys):
+    """`rayt summary tasks` rendering against a live cluster: per-name
+    state counts plus the sched-vs-exec latency split columns."""
+    import time
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+    from ray_tpu.scripts.cli import _print_task_summary
+
+    @rt.remote
+    def cli_traced(x):
+        return x
+
+    assert rt.get([cli_traced.remote(i) for i in range(2)]) == [0, 1]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        s = state_api.summarize_tasks()
+        e = s["by_name"].get("cli_traced")
+        if e and e["states"].get("FINISHED") == 2 \
+                and e["exec_time_mean_s"] is not None:
+            break
+        time.sleep(0.3)
+    _print_task_summary(s)
+    out = capsys.readouterr().out
+    assert "2 tasks stored" in out.splitlines()[0]
+    assert "sched_mean" in out and "exec_mean" in out
+    assert any("cli_traced" in ln and "FINISHED=2" in ln
+               for ln in out.splitlines()), out
 
 
 def test_cli_microbenchmark():

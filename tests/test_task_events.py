@@ -1,0 +1,505 @@
+"""Task lifecycle event store + task state API (ref analogs:
+src/ray/gcs/gcs_server/gcs_task_manager.h, task_event_buffer.cc,
+python/ray/tests/test_task_events.py `ray list tasks` / `ray summary
+tasks`)."""
+
+import time
+
+import pytest
+
+from ray_tpu._internal.tracing import (TASK_STATES, TaskEventBuffer,
+                                       to_chrome_trace, truncate_error)
+from ray_tpu.core.gcs_task_manager import GcsTaskManager
+
+
+def _transition(task_id, state, *, name="f", job="j1", kind="task",
+                ts_us=0, attempt=0, actor_id="", error=None,
+                node="n1", worker="w1"):
+    ev = {"type": "transition", "task_id": task_id, "name": name,
+          "kind": kind, "state": state, "job_id": job,
+          "actor_id": actor_id, "attempt": attempt, "node": node,
+          "worker": worker, "ts_us": ts_us}
+    if error:
+        ev["error"] = error
+    return ev
+
+
+# --------------------------------------------------- local event buffer
+def test_buffer_ring_evicts_oldest():
+    """Overflow keeps the NEWEST events (ring semantics): a busy
+    worker's timeline shows the flood's tail, not a freeze at its
+    start — with the dropped count still exact."""
+    from ray_tpu._internal import tracing
+
+    buf = TaskEventBuffer("w" * 40, "n" * 40, enabled=True)
+    n = tracing._LOCAL_CAP + 500
+    for i in range(n):
+        buf.record_transition(task_id=f"t{i}", name="f", kind="task",
+                              state="RUNNING")
+    out = buf.drain()
+    meta = [e for e in out if e["kind"] == "meta"]
+    events = [e for e in out if e["kind"] != "meta"]
+    assert len(events) == tracing._LOCAL_CAP
+    # oldest evicted, newest kept
+    assert events[0]["task_id"] == "t500"
+    assert events[-1]["task_id"] == f"t{n - 1}"
+    assert len(meta) == 1 and meta[0]["dropped"] == 500
+    # drain resets both the ring and the dropped counter
+    assert buf.drain() == []
+
+
+def test_buffer_disabled_records_nothing():
+    buf = TaskEventBuffer("w" * 40, "n" * 40, enabled=False)
+    buf.record_transition(task_id="t", name="f", kind="task",
+                          state="RUNNING")
+    assert buf.drain() == []
+
+
+# ------------------------------------------------------ GCS task manager
+def test_coalesce_transitions_into_one_record():
+    tm = GcsTaskManager()
+    ts = {s: i * 1000 for i, s in enumerate(TASK_STATES[:5])}
+    # deliver out of order (worker flush can beat the driver flush)
+    tm.ingest([_transition("t1", "RUNNING", ts_us=ts["RUNNING"],
+                           node="exec-node", worker="exec-worker")])
+    tm.ingest([_transition("t1", "PENDING_ARGS", ts_us=ts["PENDING_ARGS"]),
+               _transition("t1", "SCHEDULED", ts_us=ts["SCHEDULED"]),
+               _transition("t1", "DISPATCHED", ts_us=ts["DISPATCHED"]),
+               _transition("t1", "FINISHED", ts_us=ts["FINISHED"],
+                           node="exec-node", worker="exec-worker")])
+    out = tm.list()
+    assert out["total"] == 1
+    rec = out["tasks"][0]
+    assert rec["state"] == "FINISHED"
+    assert rec["states"] == ts
+    # execution location comes from the RUNNING report, not the driver
+    assert rec["node"] == "exec-node" and rec["worker"] == "exec-worker"
+
+
+def test_filtered_queries_by_job_state_name_actor_limit():
+    tm = GcsTaskManager()
+    for i in range(10):
+        job = "jA" if i % 2 == 0 else "jB"
+        name = "f" if i < 5 else "g"
+        tm.ingest([_transition(f"t{i}", "RUNNING", job=job, name=name,
+                               ts_us=i),
+                   _transition(f"t{i}", "FAILED" if i == 3 else "FINISHED",
+                               job=job, name=name, ts_us=i + 100)])
+    tm.ingest([_transition("a1", "RUNNING", job="jA", name="m",
+                           kind="actor_task", actor_id="ac1", ts_us=1)])
+    assert tm.list(job_id="jA")["total"] == 6
+    assert tm.list(job_id="jB")["total"] == 5
+    assert tm.list(state="FAILED")["total"] == 1
+    assert tm.list(state="FAILED")["tasks"][0]["task_id"] == "t3"
+    assert tm.list(name="g")["total"] == 5
+    assert tm.list(actor_id="ac1")["total"] == 1
+    out = tm.list(limit=3)
+    assert len(out["tasks"]) == 3 and out["total"] == 11
+    assert out["truncated"] == 8
+    # newest first
+    assert out["tasks"][0]["task_id"] == "a1"
+    # time-window filter (records overlapping the window)
+    assert tm.list(start_us=105, end_us=106)["total"] >= 1
+    assert tm.list(start_us=10_000)["total"] == 0
+
+
+def test_retry_supersedes_previous_attempts_verdict():
+    """A task that failed on attempt 0 but succeeded on its retry must
+    read FINISHED with no stale error — the record tracks the LATEST
+    attempt's verdict — and a late-arriving flush of the superseded
+    attempt's FAILED must not resurrect it."""
+    tm = GcsTaskManager()
+    tm.ingest([
+        _transition("t1", "RUNNING", ts_us=10, attempt=0),
+        _transition("t1", "FAILED", ts_us=20, attempt=0,
+                    error=truncate_error("ValueError", "flaky", "tb")),
+        _transition("t1", "SCHEDULED", ts_us=30, attempt=1),
+        _transition("t1", "RUNNING", ts_us=40, attempt=1),
+        _transition("t1", "FINISHED", ts_us=50, attempt=1),
+    ])
+    rec = tm.list()["tasks"][0]
+    assert rec["state"] == "FINISHED" and rec["attempt"] == 1
+    assert rec["error"] is None and "FAILED" not in rec["states"]
+    assert tm.summarize()["by_name"]["f"]["failed"] == 0
+    # out-of-order: the old attempt's verdict lands AFTER the retry began
+    tm.ingest([_transition("t1", "FAILED", ts_us=20, attempt=0,
+                           error=truncate_error("ValueError", "x", ""))])
+    rec = tm.list()["tasks"][0]
+    assert rec["state"] == "FINISHED" and rec["error"] is None
+    # a FAILED retry still reads FAILED (rank within the same attempt)
+    tm.ingest([_transition("t2", "RUNNING", ts_us=0, attempt=1),
+               _transition("t2", "FINISHED", ts_us=5, attempt=1),
+               _transition("t2", "FAILED", ts_us=6, attempt=1)])
+    assert tm.list(state="FAILED")["tasks"][0]["task_id"] == "t2"
+
+
+def test_cancelled_is_distinct_from_failed():
+    """rt.cancel() records CANCELLED — it outranks a racing FINISHED
+    (cancel wins per core semantics) and never counts as a failure."""
+    tm = GcsTaskManager()
+    tm.ingest([_transition("t1", "RUNNING", ts_us=0),
+               _transition("t1", "FINISHED", ts_us=5),
+               _transition("t1", "CANCELLED", ts_us=6)])
+    rec = tm.list()["tasks"][0]
+    assert rec["state"] == "CANCELLED"
+    assert tm.list(state="FAILED")["total"] == 0
+    assert tm.summarize()["by_name"]["f"]["failed"] == 0
+    assert tm.summarize()["by_name"]["f"]["states"] == {"CANCELLED": 1}
+
+
+def test_stale_attempt_running_does_not_repin_location():
+    """A late flush of a superseded attempt's RUNNING report must not
+    overwrite the exec location pinned by the current attempt."""
+    tm = GcsTaskManager()
+    tm.ingest([_transition("t1", "RUNNING", ts_us=10, attempt=1,
+                           node="node-B", worker="worker-B"),
+               _transition("t1", "RUNNING", ts_us=5, attempt=0,
+                           node="node-A", worker="worker-A")])
+    rec = tm.list()["tasks"][0]
+    assert rec["node"] == "node-B" and rec["worker"] == "worker-B"
+
+
+def test_driver_failed_does_not_override_exec_location():
+    """The driver's FAILED verdict (its own node/worker ids) must not
+    clobber the execution location recorded by the RUNNING report."""
+    tm = GcsTaskManager()
+    tm.ingest([
+        _transition("t1", "PENDING_ARGS", ts_us=0,
+                    node="drv-node", worker="drv-worker"),
+        _transition("t1", "RUNNING", ts_us=10,
+                    node="exec-node", worker="exec-worker"),
+        _transition("t1", "FAILED", ts_us=20,
+                    node="drv-node", worker="drv-worker",
+                    error=truncate_error("ValueError", "boom", "")),
+    ])
+    rec = tm.list()["tasks"][0]
+    assert rec["node"] == "exec-node" and rec["worker"] == "exec-worker"
+
+
+def test_transition_count_exact_under_duplicates_and_eviction():
+    """num_transitions counts unique stored states, so duplicate reports
+    don't inflate it and full eviction returns it to zero (it backs the
+    dashboard's cheap /api/timeline?count poll)."""
+    tm = GcsTaskManager(max_tasks=5)
+    for i in range(20):
+        tm.ingest([_transition(f"t{i}", "RUNNING", ts_us=i),
+                   _transition(f"t{i}", "RUNNING", ts_us=i),  # duplicate
+                   _transition(f"t{i}", "FINISHED", ts_us=i + 1)])
+    assert tm.num_tasks() == 5
+    assert tm.num_transitions() == sum(
+        len(r["states"]) for r in tm.list(limit=0)["tasks"])
+
+
+def test_per_job_eviction_under_memory_cap():
+    """The store stays bounded under a task flood, evicting oldest from
+    the biggest job, and the dropped accounting reaches summarize()."""
+    tm = GcsTaskManager(max_tasks=100)
+    # a small job first, then a 100x flood from another job
+    for i in range(20):
+        tm.ingest([_transition(f"small{i}", "FINISHED", job="small",
+                               ts_us=i)])
+    for i in range(10_000):
+        tm.ingest([_transition(f"flood{i}", "FINISHED", job="flood",
+                               ts_us=i)])
+    assert tm.num_tasks() == 100
+    # per-job fairness: the flood job pays for its own flood — the small
+    # job's history survives
+    assert tm.list(job_id="small")["total"] == 20
+    dropped = tm.dropped_counts()
+    assert dropped["flood"] == 9_920 and "small" not in dropped
+    s = tm.summarize()
+    assert s["total_tasks"] == 100
+    assert s["dropped"]["flood"] == 9_920
+    # oldest flood records evicted, newest kept
+    flood = tm.list(job_id="flood", limit=0)["tasks"]
+    assert {t["task_id"] for t in flood} == {
+        f"flood{i}" for i in range(9_920, 10_000)}
+
+
+@pytest.mark.slow
+def test_store_bounded_under_100k_task_flood():
+    """Acceptance: GCS memory for task events is provably bounded under
+    a 100k-task flood."""
+    tm = GcsTaskManager(max_tasks=1000)
+    for i in range(100_000):
+        tm.ingest([_transition(f"t{i}", "RUNNING", job="flood", ts_us=i),
+                   _transition(f"t{i}", "FINISHED", job="flood",
+                               ts_us=i + 1)])
+    assert tm.num_tasks() == 1000
+    assert tm.dropped_counts()["flood"] == 99_000
+    assert tm.summarize()["dropped"]["flood"] == 99_000
+
+
+def test_worker_buffer_drop_accounting_propagates():
+    tm = GcsTaskManager()
+    tm.ingest([{"name": "<dropped 7 events>", "task_id": "", "kind": "meta",
+                "worker": "w", "node": "n", "actor_id": "", "ok": True,
+                "dropped": 7, "ts_us": 0, "dur_us": 0}])
+    assert tm.summarize()["worker_buffer_dropped"] == 7
+
+
+def test_list_negative_limit_means_unlimited():
+    tm = GcsTaskManager()
+    for i in range(5):
+        tm.ingest([_transition(f"t{i}", "FINISHED", ts_us=i)])
+    out = tm.list(limit=-1)
+    assert len(out["tasks"]) == 5 and out["truncated"] == 0
+
+
+def test_summarize_latency_split():
+    tm = GcsTaskManager()
+    for i in range(4):
+        base = i * 1_000_000
+        tm.ingest([
+            _transition(f"t{i}", "PENDING_ARGS", ts_us=base),
+            _transition(f"t{i}", "SCHEDULED", ts_us=base + 100_000),
+            _transition(f"t{i}", "RUNNING", ts_us=base + 200_000),
+            _transition(f"t{i}", "FINISHED", ts_us=base + 700_000),
+        ])
+    e = tm.summarize()["by_name"]["f"]
+    assert e["count"] == 4 and e["states"] == {"FINISHED": 4}
+    assert abs(e["sched_delay_mean_s"] - 0.2) < 1e-6
+    assert abs(e["exec_time_mean_s"] - 0.5) < 1e-6
+    assert abs(e["exec_time_total_s"] - 2.0) < 1e-6
+
+
+# ------------------------------------------------------- chrome timeline
+def test_chrome_trace_renders_nested_phase_slices():
+    tm = GcsTaskManager()
+    tm.ingest([
+        _transition("t1", "PENDING_ARGS", ts_us=0),
+        _transition("t1", "SCHEDULED", ts_us=10),
+        _transition("t1", "DISPATCHED", ts_us=20),
+        _transition("t1", "RUNNING", ts_us=30),
+        _transition("t1", "FINISHED", ts_us=100),
+    ])
+    trace = to_chrome_trace(tm.records())
+    evs = trace["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert names == {"f", "f [scheduling]", "f [dispatch]",
+                     "f [startup]", "f [execution]"}
+    outer = next(e for e in evs if e["name"] == "f")
+    assert outer["ph"] == "X" and outer["ts"] == 0 and outer["dur"] == 100
+    execution = next(e for e in evs if e["name"] == "f [execution]")
+    assert execution["ts"] == 30 and execution["dur"] == 70
+    # inner slices nest inside the outer (same pid/tid, contained span)
+    for e in evs:
+        assert e["pid"] == outer["pid"] and e["tid"] == outer["tid"]
+        assert e["ts"] >= outer["ts"]
+        assert e["ts"] + e["dur"] <= outer["ts"] + outer["dur"]
+
+
+def test_chrome_trace_failure_args():
+    tm = GcsTaskManager()
+    tm.ingest([
+        _transition("t1", "RUNNING", ts_us=0),
+        _transition("t1", "FAILED", ts_us=50,
+                    error=truncate_error("ValueError", "boom", "tb")),
+    ])
+    evs = to_chrome_trace(tm.records())["traceEvents"]
+    outer = next(e for e in evs if e["name"] == "f")
+    assert outer["args"]["ok"] is False
+    assert "ValueError: boom" in outer["args"]["error"]
+
+
+def test_truncate_error_bounds_payload():
+    err = truncate_error("E" * 500, "m" * 10_000, "t" * 100_000)
+    assert len(err["type"]) == 200
+    assert len(err["message"]) == 500
+    assert len(err["traceback"]) == 2000
+    assert err["traceback"] == "t" * 2000  # tail kept, not head
+
+
+# ------------------------------------------------------- live cluster
+def _wait_tasks(predicate, timeout=30.0, **filters):
+    from ray_tpu import state_api
+
+    deadline = time.monotonic() + timeout
+    tasks = []
+    while time.monotonic() < deadline:
+        tasks = state_api.list_tasks(**filters)
+        if predicate(tasks):
+            return tasks
+        time.sleep(0.3)
+    raise AssertionError(f"tasks never satisfied predicate; last={tasks}")
+
+
+def test_failed_task_carries_error_via_list_tasks(local_cluster):
+    """Satellite regression: a deliberately failing remote task shows
+    state=FAILED and its error text (type + truncated traceback) via
+    list_tasks."""
+    import ray_tpu as rt
+
+    @rt.remote(max_retries=0)
+    def kaboom():
+        raise ValueError("deliberate kaboom for the state API")
+
+    with pytest.raises(Exception):
+        rt.get(kaboom.remote())
+
+    tasks = _wait_tasks(
+        lambda ts: any(t["state"] == "FAILED" for t in ts),
+        name="kaboom")
+    rec = next(t for t in tasks if t["state"] == "FAILED")
+    assert rec["error"]["type"] == "ValueError"
+    assert "deliberate kaboom" in rec["error"]["message"]
+    assert "deliberate kaboom" in rec["error"]["traceback"]
+    # the FAILED transition is timestamped like any other
+    assert "FAILED" in rec["states"]
+
+
+def test_retried_task_reads_finished_live(local_cluster, tmp_path):
+    """retry_exceptions retry that succeeds: the record shows the LAST
+    attempt's verdict (FINISHED, attempt 1, no stale error)."""
+    import ray_tpu as rt
+
+    marker = tmp_path / "attempted-once"
+
+    @rt.remote(max_retries=2, retry_exceptions=True)
+    def flaky(path):
+        import os
+        if not os.path.exists(path):
+            open(path, "w").close()
+            raise ValueError("first attempt fails")
+        return "ok"
+
+    assert rt.get(flaky.remote(str(marker))) == "ok"
+    tasks = _wait_tasks(
+        lambda ts: any(t["state"] == "FINISHED" and t["attempt"] >= 1
+                       for t in ts),
+        name="flaky")
+    rec = next(t for t in tasks if t["state"] == "FINISHED")
+    assert rec["attempt"] >= 1 and rec["error"] is None
+    assert "FAILED" not in rec["states"]
+
+
+def test_lifecycle_states_and_summary_live(local_cluster):
+    """Acceptance: a live cluster reports full per-task lifecycles and
+    summarize_tasks() gives per-name state counts + the scheduling vs
+    execution latency split."""
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    @rt.remote
+    def traced(x):
+        time.sleep(0.05)
+        return x
+
+    assert rt.get([traced.remote(i) for i in range(4)]) == list(range(4))
+
+    tasks = _wait_tasks(
+        lambda ts: len(ts) == 4 and all(t["state"] == "FINISHED"
+                                        for t in ts),
+        name="traced")
+    for t in tasks:
+        # the full driver-side + worker-side transition chain coalesced
+        assert {"PENDING_ARGS", "SCHEDULED", "DISPATCHED", "RUNNING",
+                "FINISHED"} <= set(t["states"])
+        st = t["states"]
+        assert (st["PENDING_ARGS"] <= st["SCHEDULED"]
+                <= st["DISPATCHED"] <= st["RUNNING"] <= st["FINISHED"])
+        assert t["job_id"]  # per-job index key present
+
+    s = state_api.summarize_tasks()
+    e = s["by_name"]["traced"]
+    assert e["states"] == {"FINISHED": 4}
+    assert e["sched_delay_mean_s"] is not None
+    assert e["exec_time_mean_s"] >= 0.05  # the sleep dominates execution
+    # job filter narrows to this driver's job
+    job = tasks[0]["job_id"]
+    assert state_api.summarize_tasks(job_id=job)["by_name"]["traced"][
+        "count"] == 4
+    assert state_api.summarize_tasks(job_id="no-such-job")["by_name"] == {}
+
+
+def test_actor_lifecycle_events_live(local_cluster):
+    """Actor creation (GCS+node-manager emitted) and actor method calls
+    both appear with full lifecycles."""
+    import ray_tpu as rt
+
+    @rt.remote(num_cpus=0)
+    class Traced:
+        def m(self):
+            return "m"
+
+    a = Traced.remote()
+    assert rt.get(a.m.remote(), timeout=60) == "m"
+
+    creations = _wait_tasks(
+        lambda ts: any(t["state"] == "FINISHED" for t in ts),
+        name="Traced")
+    rec = next(t for t in creations if t["kind"] == "actor_creation")
+    # PENDING_ARGS from the GCS, SCHEDULED at placement, DISPATCHED from
+    # the node manager, RUNNING/FINISHED from the worker
+    assert {"PENDING_ARGS", "SCHEDULED", "DISPATCHED", "RUNNING",
+            "FINISHED"} <= set(rec["states"])
+    methods = _wait_tasks(
+        lambda ts: any(t["state"] == "FINISHED" for t in ts), name="m")
+    rec = next(t for t in methods if t["kind"] == "actor_task")
+    assert rec["actor_id"]
+    assert {"PENDING_ARGS", "SCHEDULED", "DISPATCHED", "RUNNING",
+            "FINISHED"} <= set(rec["states"])
+
+
+def test_cancelled_task_reads_cancelled_live(local_cluster):
+    """A queued task cancelled via rt.cancel() reads CANCELLED (not
+    FAILED) through the state API."""
+    import ray_tpu as rt
+
+    @rt.remote
+    def blocker():
+        time.sleep(15)
+        return "done"
+
+    @rt.remote
+    def queued():
+        return "ran"
+
+    blockers = [blocker.remote() for _ in range(4)]  # fill all 4 CPUs
+    victim = queued.remote()
+    time.sleep(0.3)
+    assert rt.cancel(victim) is True
+    with pytest.raises(Exception):
+        rt.get(victim, timeout=10)
+    tasks = _wait_tasks(
+        lambda ts: any(t["state"] == "CANCELLED" for t in ts),
+        name="queued")
+    rec = next(t for t in tasks if t["state"] == "CANCELLED")
+    assert rec["error"]["type"] == "TaskCancelledError"
+    from ray_tpu import state_api
+    assert all(t["name"] != "queued"
+               for t in state_api.list_tasks(state="FAILED"))
+    for b in blockers:
+        rt.cancel(b, force=True)
+
+
+def test_timeline_export_filters_live(local_cluster, tmp_path):
+    """export_timeline passes job/limit filters through to the GCS
+    instead of materializing the whole store in the driver."""
+    import json
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+
+    @rt.remote
+    def tiny():
+        return 1
+
+    assert rt.get([tiny.remote() for _ in range(3)]) == [1, 1, 1]
+    tasks = _wait_tasks(
+        lambda ts: len(ts) >= 3 and all(t["state"] == "FINISHED"
+                                        for t in ts), name="tiny")
+    job = tasks[0]["job_id"]
+    out = str(tmp_path / "tl.json")
+    n = state_api.export_timeline(out, job_id=job)
+    assert n >= 3
+    with open(out) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "tiny" for e in trace["traceEvents"])
+    # nested phase slices made it into the export
+    assert any("[execution]" in e["name"] for e in trace["traceEvents"])
+    # a bogus job filter yields an empty trace — filtering is server-side
+    assert state_api.export_timeline(str(tmp_path / "tl2.json"),
+                                     job_id="nope") == 0
+    # raw filtered record query honors limit server-side
+    assert len(state_api.task_events(job_id=job, limit=2)) == 2
