@@ -90,6 +90,12 @@ def load_shm_lib():
         lib.rayt_shm_close.argtypes = [ctypes.c_void_p]
         lib.rayt_shm_unlink.restype = ctypes.c_int
         lib.rayt_shm_unlink.argtypes = [ctypes.c_char_p]
+        # release/acquire atomics for the SPSC channel seq words
+        lib.rayt_atomic_store_release_u64.restype = None
+        lib.rayt_atomic_store_release_u64.argtypes = [ctypes.c_void_p,
+                                                      ctypes.c_uint64]
+        lib.rayt_atomic_load_acquire_u64.restype = ctypes.c_uint64
+        lib.rayt_atomic_load_acquire_u64.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

@@ -428,4 +428,20 @@ int rayt_shm_unlink(const char* name) {
   return shm_unlink(name) == 0 ? RAYT_OK : RAYT_ERR_IO;
 }
 
+// ---- generic release/acquire atomics over shared mappings ----
+// Used by the compiled-DAG SPSC ring (dag/channel.py): the producer's
+// seq bump must be a RELEASE store (payload bytes visible before the
+// seq) and the consumer's seq read an ACQUIRE load — correct on weakly
+// ordered ISAs (ARM64), not just x86-TSO. The address must be 8-byte
+// aligned (the ring header is cache-line aligned at mapping offset 0).
+void rayt_atomic_store_release_u64(void* addr, uint64_t value) {
+  __atomic_store_n(reinterpret_cast<uint64_t*>(addr), value,
+                   __ATOMIC_RELEASE);
+}
+
+uint64_t rayt_atomic_load_acquire_u64(const void* addr) {
+  return __atomic_load_n(reinterpret_cast<const uint64_t*>(addr),
+                         __ATOMIC_ACQUIRE);
+}
+
 }  // extern "C"

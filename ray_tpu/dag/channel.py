@@ -13,17 +13,17 @@ on the SPSC discipline: the producer writes the payload bytes first and
 publishes by bumping ``write_seq`` last; the consumer reads ``write_seq``
 before the payload and releases the slot by bumping ``read_seq`` last.
 
-Memory-ordering caveat: the publish relies on total store order, which
-x86-64 guarantees for plain stores. ARM64 is weakly ordered — there is
-no fence between the payload memcpy and the seq store — so on ARM hosts
-a consumer could in principle observe the bumped seq before the payload
-bytes land. In CPython each store is preceded and followed by
-interpreter bookkeeping (refcount writes, bytecode dispatch) that spans
-many nanoseconds, and each seq has exactly one writer, so the window is
-practically unobservable at Python speeds; the protocol is nevertheless
-only *specified* for x86-64. Deployments on ARM hosts should route the
-seq bump through an atomic release store in the _native lib (the shm
-arena there already does this for its allocation headers).
+Memory ordering: when the `_native` lib is loadable (it is wherever the
+arena store runs), every seq bump is an ``__ATOMIC_RELEASE`` store and
+every seq read an ``__ATOMIC_ACQUIRE`` load
+(shm_store.cpp rayt_atomic_{store_release,load_acquire}_u64 — the same
+primitives the arena uses to publish its init magic), so the protocol is
+correct on weakly ordered ISAs (ARM64), not just x86-TSO. Without the
+native lib the channel falls back to plain struct stores, which rely on
+x86-64 total store order; in CPython each store is surrounded by
+interpreter bookkeeping spanning many nanoseconds and each seq has
+exactly one writer, so the fallback window is practically unobservable —
+but only the native path is *specified* for ARM hosts.
 
 Capacity gives pipelining: a ring of N slots lets N ticks be in flight
 between two stages before the producer blocks (GPipe-style microbatch
@@ -32,6 +32,7 @@ overlap over host edges).
 
 from __future__ import annotations
 
+import ctypes
 import pickle
 import struct
 import sys
@@ -70,6 +71,21 @@ def _open_untracked(**kwargs) -> shared_memory.SharedMemory:
             resource_tracker.register = orig
 
 
+def _atomics_lib():
+    """The native release/acquire helpers, or None (pure-Python
+    fallback). Import is lazy and failure-tolerant: channels must work
+    in minimal environments with no toolchain."""
+    try:
+        from ray_tpu._native import load_shm_lib
+
+        lib = load_shm_lib()
+        if lib is not None and hasattr(lib, "rayt_atomic_store_release_u64"):
+            return lib
+    except Exception:
+        pass
+    return None
+
+
 class ChannelClosed(Exception):
     pass
 
@@ -92,6 +108,14 @@ class ShmChannel:
         self.spec = spec
         self._owner = owner
         self._buf = shm.buf
+        self._atomics = _atomics_lib()
+        self._base_addr = 0
+        if self._atomics is not None:
+            # raw mapping address for the seq words; keep only the int so
+            # no exported pointer blocks shm.close() later
+            anchor = ctypes.c_char.from_buffer(shm.buf)
+            self._base_addr = ctypes.addressof(anchor)
+            del anchor
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -113,6 +137,11 @@ class ShmChannel:
             self._mark_closed()
         except Exception:
             pass
+        # drop the native-atomics path FIRST: after shm.close() the
+        # mapping is gone and a raw load/store on _base_addr would
+        # SIGSEGV, where the struct-on-_buf path raises catchably
+        self._atomics = None
+        self._base_addr = 0
         try:
             self._buf = None
             self._shm.close()
@@ -126,13 +155,30 @@ class ShmChannel:
 
     # -------------------------------------------------------------- protocol
     def _seqs(self) -> tuple[int, int, bool]:
+        if self._atomics is not None:
+            # acquire loads: everything the publisher wrote before its
+            # release store (the payload) is visible after these
+            w = self._atomics.rayt_atomic_load_acquire_u64(
+                ctypes.c_void_p(self._base_addr))
+            r = self._atomics.rayt_atomic_load_acquire_u64(
+                ctypes.c_void_p(self._base_addr + 8))
+            (closed,) = struct.unpack_from("<B", self._buf, 32)
+            return w, r, bool(closed)
         w, r, _, _, closed = _HDR.unpack_from(self._buf, 0)
         return w, r, bool(closed)
 
     def _set_write_seq(self, w: int):
+        if self._atomics is not None:
+            self._atomics.rayt_atomic_store_release_u64(
+                ctypes.c_void_p(self._base_addr), w)
+            return
         struct.pack_into("<Q", self._buf, 0, w)
 
     def _set_read_seq(self, r: int):
+        if self._atomics is not None:
+            self._atomics.rayt_atomic_store_release_u64(
+                ctypes.c_void_p(self._base_addr + 8), r)
+            return
         struct.pack_into("<Q", self._buf, 8, r)
 
     def _mark_closed(self):

@@ -9,10 +9,16 @@ from ray_tpu.data.aggregate import (AggregateFn, Count, Max,  # noqa: F401
                                     Mean, Min, Std, Sum)
 from ray_tpu.data.dataset import (DataIterator, Dataset,  # noqa: F401
                                   from_items_rows)
+from ray_tpu.data.datasink import (Datasink, FileDatasink,  # noqa: F401
+                                   JSONLDatasink, NpzDatasink,
+                                   ParquetDatasink, WriteResult)
 from ray_tpu.data.datasource import (read_csv, read_json,  # noqa: F401
                                      read_npz, read_parquet, read_text,
                                      write_parquet)
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data.llm_corpus import (CorpusCursor,  # noqa: F401
+                                     TokenCorpus, read_token_corpus)
+from ray_tpu.data.partitioning import Partitioning  # noqa: F401
 
 
 def from_items(items: list, num_blocks: int = 8) -> Dataset:

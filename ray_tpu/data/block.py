@@ -98,11 +98,17 @@ def slice_rows(block: Block, start: int, length: int) -> Block:
 
 
 def iter_rows(block: Block) -> Iterator:
-    """Row iterator over any block flavor."""
+    """Row iterator over any block flavor. Genuinely streaming for
+    columnar blocks: row dicts materialize one at a time (arrow:
+    batch-at-a-time) so a fold over a large block never holds every
+    row dict simultaneously (use block_rows when you WANT the list)."""
     if is_arrow_block(block):
-        yield from block.to_pylist()
+        for batch in block.to_batches(max_chunksize=4096):
+            yield from batch.to_pylist()
     elif is_numpy_block(block):
-        yield from block.to_rows()
+        keys = list(block.cols)
+        for i in range(block.num_rows):
+            yield {k: _item(block.cols[k][i]) for k in keys}
     else:
         yield from block
 

@@ -251,10 +251,33 @@ class Dataset:
             n += 1
         return total / n if n else float("nan")
 
-    def write_parquet(self, path: str) -> None:
-        from ray_tpu.data.datasource import write_parquet
+    def write_datasink(self, sink, **kwargs) -> list:
+        """Fan blocks out to a Datasink (one retryable write task per
+        block, atomic per-file commit — data/datasink.py)."""
+        from ray_tpu.data.datasink import write_datasink
 
-        write_parquet(self, path)
+        return write_datasink(self, sink, **kwargs)
+
+    def write_parquet(self, path: str, *,
+                      partition_cols: Optional[list] = None) -> list:
+        from ray_tpu.data.datasink import ParquetDatasink
+
+        return self.write_datasink(
+            ParquetDatasink(path, partition_cols=partition_cols))
+
+    def write_jsonl(self, path: str, *,
+                    partition_cols: Optional[list] = None) -> list:
+        from ray_tpu.data.datasink import JSONLDatasink
+
+        return self.write_datasink(
+            JSONLDatasink(path, partition_cols=partition_cols))
+
+    def write_npz(self, path: str, *,
+                  partition_cols: Optional[list] = None) -> list:
+        from ray_tpu.data.datasink import NpzDatasink
+
+        return self.write_datasink(
+            NpzDatasink(path, partition_cols=partition_cols))
 
     def to_pandas(self):
         import pandas as pd

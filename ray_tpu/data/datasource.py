@@ -54,71 +54,94 @@ def read_csv(paths):
     return Dataset([task.remote(p) for p in _expand(paths)])
 
 
-def read_parquet(paths, *, columns: Optional[list[str]] = None):
+def read_parquet(paths, *, columns: Optional[list[str]] = None,
+                 partitioning=None):
+    """`partitioning` (data/partitioning.Partitioning) re-injects
+    partition-column values encoded in hive-style paths — the read half
+    of Dataset.write_parquet(partition_cols=...)."""
     from ray_tpu.data.dataset import Dataset
 
-    def read_file(path: str, columns):
+    base = paths if isinstance(paths, str) and os.path.isdir(paths) \
+        else None
+
+    def read_file(path: str, columns, partitioning, base):
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
         # arrow table IS the block: stays columnar through the pipeline,
         # zero-copy into numpy batches for train ingest
-        return pq.read_table(path, columns=columns)
+        table = pq.read_table(path, columns=columns)
+        if partitioning is not None:
+            for k, v in partitioning.parse(path, base).items():
+                if k not in table.column_names:
+                    table = table.append_column(
+                        k, pa.array([v] * table.num_rows))
+        return table
 
     task = rt.remote(num_cpus=1)(read_file)
-    return Dataset([task.remote(p, columns) for p in _expand(paths)])
+    return Dataset([task.remote(p, columns, partitioning, base)
+                    for p in _expand(paths)])
 
 
-def read_json(paths):
+def read_json(paths, *, partitioning=None):
     from ray_tpu.data.dataset import Dataset
 
-    def read_file(path: str):
+    base = paths if isinstance(paths, str) and os.path.isdir(paths) \
+        else None
+
+    def read_file(path: str, partitioning, base):
         import json
 
         with open(path) as f:
             first = f.read(1)
             f.seek(0)
             if first == "[":
-                return json.load(f)
-            return [json.loads(ln) for ln in f if ln.strip()]
+                rows = json.load(f)
+            else:
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+        if partitioning is not None:
+            values = partitioning.parse(path, base)
+            for row in rows:
+                for k, v in values.items():
+                    row.setdefault(k, v)
+        return rows
 
     task = rt.remote(num_cpus=1)(read_file)
-    return Dataset([task.remote(p) for p in _expand(paths)])
+    return Dataset([task.remote(p, partitioning, base)
+                    for p in _expand(paths)])
 
 
 def write_parquet(dataset, path: str) -> None:
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    from ray_tpu.data.block import is_arrow_block
-
-    os.makedirs(path, exist_ok=True)
-    for i, ref in enumerate(dataset._iter_block_refs()):
-        block = rt.get(ref)
-        if is_arrow_block(block):
-            if block.num_rows == 0:
-                continue
-            table = block
-        elif block:
-            table = pa.Table.from_pylist(block)
-        else:
-            continue
-        pq.write_table(table,
-                       os.path.join(path, f"part-{i:05d}.parquet"))
+    """Legacy free-function surface; now routes through the Datasink
+    write path (data/datasink.py: remote write tasks, atomic commit,
+    retry-safe deterministic names)."""
+    dataset.write_parquet(path)
 
 
-def read_npz(paths):
+def read_npz(paths, *, partitioning=None):
     """One columnar NumpyBlock per .npz file: the multi-dim-column
     format (token matrices, image stacks) Arrow files can't carry.
-    Producer side: ray_tpu.rl.offline.write_offline_dataset or plain
-    np.savez of equal-length arrays."""
+    Producer side: Dataset.write_npz, ray_tpu.rl.offline.
+    write_offline_dataset, or plain np.savez of equal-length arrays.
+    `partitioning` re-injects hive-path-encoded columns, pairing with
+    write_npz(partition_cols=...)."""
     from ray_tpu.data.block import NumpyBlock
     from ray_tpu.data.dataset import Dataset
 
-    def read_file(path: str):
+    base = paths if isinstance(paths, str) and os.path.isdir(paths) \
+        else None
+
+    def read_file(path: str, partitioning, base):
         import numpy as np
 
         with np.load(path) as z:
-            return NumpyBlock({k: z[k] for k in z.files})
+            cols = {k: z[k] for k in z.files}
+        if partitioning is not None and cols:
+            n = len(next(iter(cols.values())))
+            for k, v in partitioning.parse(path, base).items():
+                cols.setdefault(k, np.full(n, v))
+        return NumpyBlock(cols)
 
     task = rt.remote(num_cpus=1)(read_file)
-    return Dataset([task.remote(p) for p in _expand(paths)])
+    return Dataset([task.remote(p, partitioning, base)
+                    for p in _expand(paths)])

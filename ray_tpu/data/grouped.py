@@ -33,6 +33,41 @@ def _group_rows(part: Block, key: str) -> dict[Any, Block]:
     return groups
 
 
+def _fold_partition(part: Block, key: str, agg_fns: tuple,
+                    named_aggs: dict) -> Block:
+    """One streaming pass over a partition: each row folds into its
+    group's accumulators the moment it is produced and is then dropped —
+    for columnar blocks the per-row dicts iter_rows materializes die
+    immediately instead of piling into per-group lists (ADVICE fix; the
+    keyword ``(col, reducer-over-list)`` surface still needs the VALUES
+    of its input column, but only that column, never whole rows)."""
+    accs: dict[Any, list] = {}          # group -> AggregateFn accumulators
+    vals: dict[Any, list] = {}          # group -> per-named-agg value lists
+    order: list = []                    # first-seen group order
+    named = list(named_aggs.items())
+    for row in iter_rows(part):
+        gkey = row[key]
+        if gkey not in accs:
+            order.append(gkey)
+            accs[gkey] = [fn.init() for fn in agg_fns]
+            vals[gkey] = [[] for _ in named]
+        acc = accs[gkey]
+        for i, fn in enumerate(agg_fns):
+            acc[i] = fn.accumulate_row(acc[i], row)
+        v = vals[gkey]
+        for i, (_, (in_col, _)) in enumerate(named):
+            v[i].append(row[in_col])
+    out: Block = []
+    for gkey in order:
+        row = {key: gkey}
+        for i, fn in enumerate(agg_fns):
+            row[fn.name] = fn.finalize(accs[gkey][i])
+        for i, (out_col, (_, reducer)) in enumerate(named):
+            row[out_col] = reducer(vals[gkey][i])
+        out.append(row)
+    return out
+
+
 class GroupedData:
     def __init__(self, dataset, key: str):
         self._dataset = dataset
@@ -67,11 +102,12 @@ class GroupedData:
         """Two surfaces (ref: grouped_data.py aggregate):
 
         * positional :class:`~ray_tpu.data.aggregate.AggregateFn` plugin
-          objects — rows fold into small accumulators inside each hash
-          partition (init/accumulate_row/finalize), so a group's rows
-          are never gathered into a list;
+          objects — rows fold into small accumulators AS the partition
+          streams (init/accumulate_row/finalize), so a group's rows are
+          never gathered into a list;
         * keyword ``out_col=(in_col, reducer over list of values)`` for
-          quick ad-hoc reductions.
+          quick ad-hoc reductions (collects that one column's values per
+          group — the reducer's contract — but never whole rows).
 
         Returns a Dataset of one row per group. Aggregation runs as one
         task per partition — partitions never land on the driver, so the
@@ -82,19 +118,7 @@ class GroupedData:
         key = self._key
 
         def agg_partition(part: Block) -> Block:
-            groups = _group_rows(part, key)
-            out: Block = []
-            for gkey, rows in groups.items():
-                row = {key: gkey}
-                for fn in agg_fns:
-                    acc = fn.init()
-                    for r in rows:
-                        acc = fn.accumulate_row(acc, r)
-                    row[fn.name] = fn.finalize(acc)
-                for out_col, (in_col, reducer) in named_aggs.items():
-                    row[out_col] = reducer([r[in_col] for r in rows])
-                out.append(row)
-            return out
+            return _fold_partition(part, key, agg_fns, named_aggs)
 
         agg_task = rt.remote(num_cpus=1)(agg_partition)
         return Dataset([agg_task.remote(ref) for ref in self._partitions()])

@@ -8,7 +8,10 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
 from ray_tpu.train.controller import (ElasticScalingPolicy,  # noqa: F401
                                       FailurePolicy, ScalingPolicy,
                                       TrainController, TrainingFailedError)
-from ray_tpu.train.recipes import lora_finetune_loop  # noqa: F401
+from ray_tpu.train.ingest import (CorpusIngestIterator,  # noqa: F401
+                                  IngestSpec)
+from ray_tpu.train.recipes import (corpus_pretrain_loop,  # noqa: F401
+                                   lora_finetune_loop)
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
-                                   report)
+                                   get_ingest, report)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

@@ -27,6 +27,12 @@ class ScalingConfig:
     # Pod-slice topology hint for slice-aware placement, e.g. "v5p-16"
     # (ref analog: TPU-v4-16-head resources, _private/accelerators/tpu.py:197)
     topology: Optional[str] = None
+    # Corpus ingest (train/ingest.py IngestSpec): one declarative spec
+    # shipped to every worker; each derives its own deterministic shard
+    # slice from (rank, num_workers) and exposes the iterator via
+    # session.get_ingest(). Lives here because the shard assignment IS a
+    # function of the scaling (world size), like mesh axes.
+    ingest: Optional[Any] = None
 
     def worker_resources(self) -> dict:
         if self.resources_per_worker is not None:

@@ -12,6 +12,109 @@ from __future__ import annotations
 from typing import Any, Callable
 
 
+def corpus_pretrain_loop(config: dict):
+    """Pre-train from a sharded tokenized corpus via session ingest
+    (train/ingest.py). The model is a deliberately tiny embedding net —
+    this recipe is the canonical wiring of the INGEST contract: the
+    corpus cursor is saved inside every checkpoint and restored on
+    (re)start, so a run killed mid-epoch resumes consuming exactly the
+    tokens an uninterrupted run would have.
+
+    config keys:
+      vocab_size, dim      — toy model size (default 128 / 8)
+      lr, steps            — SGD rate / max train steps (corpus may end
+                             earlier; the loop stops at either)
+      checkpoint_every     — steps between checkpointed reports (def. 5)
+      use_mesh             — shard batches onto the ScalingConfig mesh
+      trace_dir            — debug/test hook: persist the consumed token
+                             ids per step (trace_dir/rank{r}/step_*.npy);
+                             re-executed steps overwrite, so the dir
+                             always holds the EFFECTIVE consumed stream
+      crash_at_step        — fault-injection hook: hard-exit the worker
+                             before that step, once per marker file
+    """
+    import os
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ctx = train.get_context()
+    rank = ctx.get_world_rank()
+    mesh = ctx.get_mesh() if config.get("use_mesh") else None
+
+    vocab = config.get("vocab_size", 128)
+    dim = config.get("dim", 8)
+    lr = config.get("lr", 1e-2)
+    steps = config.get("steps", 20)
+    ckpt_every = config.get("checkpoint_every", 5)
+
+    start_step = 0
+    ingest_state = None
+    w = jax.random.normal(jax.random.PRNGKey(0), (vocab, dim)) * 0.02
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        restored = Checkpoint(
+            os.path.join(ckpt.path, f"rank_{rank}")).to_dict()
+        w = jnp.asarray(restored["w"])
+        start_step = int(restored["step"])
+        ingest_state = restored["ingest"]
+
+    it = ctx.get_ingest(mesh=mesh, state=ingest_state)
+
+    @jax.jit
+    def sgd_step(w, tokens):
+        def loss_fn(w):
+            emb = w[tokens]  # (B, T, dim) gather
+            return jnp.mean(jnp.square(emb - jnp.mean(emb)))
+
+        loss, grad = jax.value_and_grad(loss_fn)(w)
+        return w - lr * grad, loss
+
+    trace_dir = config.get("trace_dir")
+    if trace_dir:
+        os.makedirs(os.path.join(trace_dir, f"rank{rank}"), exist_ok=True)
+    crash_at = config.get("crash_at_step")
+
+    loss = None
+    try:
+        for step in range(start_step, steps):
+            if crash_at is not None and step == crash_at:
+                marker = os.path.join(ctx.experiment_path,
+                                      f".crashed-rank_{rank}")
+                if not os.path.exists(marker):
+                    open(marker, "w").close()
+                    os._exit(1)  # simulate a hard worker kill mid-epoch
+            try:
+                batch = next(it)
+            except StopIteration:
+                break  # corpus exhausted before `steps`
+            tokens = jnp.asarray(batch["tokens"])
+            if trace_dir:
+                np.save(os.path.join(trace_dir, f"rank{rank}",
+                                     f"step_{step:05d}.npy"),
+                        np.asarray(batch["tokens"]))
+            w, loss = sgd_step(w, tokens)
+            if (step + 1) % ckpt_every == 0 or step == steps - 1:
+                c = Checkpoint.from_dict({
+                    "w": np.asarray(w), "step": step + 1,
+                    "ingest": it.state_dict()})
+                train.report(
+                    {"loss": float(loss), "step": step + 1,
+                     "tokens": int(batch["tokens"].size),
+                     "ingest_stall_s": it.stats.stall_s,
+                     "ingest_load_s": it.stats.load_s},
+                    checkpoint=c)
+                shutil.rmtree(c.path, ignore_errors=True)  # report copied
+    finally:
+        it.close()  # a failed step must not leak the prefetch thread
+    return float(loss) if loss is not None else None
+
+
 def lora_finetune_loop(config: dict):
     """LoRA fine-tune a Llama-family model (BASELINE.json config #3).
 

@@ -24,12 +24,14 @@ _context_lock = threading.Lock()
 class TrainContext:
     def __init__(self, rank: int, world_size: int, experiment_path: str,
                  experiment_name: str, latest_checkpoint: Optional[str],
-                 mesh_axes: Optional[dict] = None):
+                 mesh_axes: Optional[dict] = None,
+                 ingest_spec=None):
         self.rank = rank
         self.world_size = world_size
         self.experiment_path = experiment_path
         self.experiment_name = experiment_name
         self.mesh_axes = mesh_axes
+        self.ingest_spec = ingest_spec
         self._latest_checkpoint_dir = latest_checkpoint
         self._results: collections.deque = collections.deque()
         self._results_cond = threading.Condition()
@@ -74,6 +76,22 @@ class TrainContext:
 
         axes = self.mesh_axes or {"data": -1}
         return build_mesh(dict(axes), devices)
+
+    def get_ingest(self, *, mesh=None, state: Optional[dict] = None):
+        """This worker's corpus-ingest iterator (train/ingest.py), built
+        from ScalingConfig.ingest with the shard slice derived from
+        (rank, world_size). `state` restores a cursor saved in a
+        checkpoint so the resumed token stream is bit-identical."""
+        from ray_tpu.train.ingest import CorpusIngestIterator
+
+        if self.ingest_spec is None:
+            raise RuntimeError(
+                "no ingest configured: pass ScalingConfig(ingest="
+                "IngestSpec(...)) to the trainer")
+        return CorpusIngestIterator(
+            self.ingest_spec, dp_rank=self.rank,
+            world_size=self.world_size, mesh=mesh, state=state,
+            experiment=self.experiment_name)
 
     def _emit_metrics(self, metrics: dict):
         """Per-report training telemetry onto the cluster metrics
@@ -160,3 +178,7 @@ def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().get_checkpoint()
+
+
+def get_ingest(*, mesh=None, state: Optional[dict] = None):
+    return get_context().get_ingest(mesh=mesh, state=state)

@@ -25,13 +25,14 @@ class TrainWorker:
 
     def setup(self, rank: int, world_size: int, experiment_path: str,
               experiment_name: str, latest_checkpoint: Optional[str],
-              mesh_axes: Optional[dict], group_name: str) -> dict:
+              mesh_axes: Optional[dict], group_name: str,
+              ingest_spec=None) -> dict:
         from ray_tpu.util import collective
 
         self._group_name = group_name
         ctx = session.TrainContext(rank, world_size, experiment_path,
                                    experiment_name, latest_checkpoint,
-                                   mesh_axes)
+                                   mesh_axes, ingest_spec=ingest_spec)
         session.set_context(ctx)
         self._ctx = ctx
         # Host-plane communicator: barriers, coordinator-address exchange
@@ -131,7 +132,8 @@ class WorkerGroup:
             self.workers.append(actor_cls.options(**o).remote())
         setup_refs = [
             w.setup.remote(i, n, self.experiment_path, self.experiment_name,
-                           latest_checkpoint, self.scaling.mesh, group_name)
+                           latest_checkpoint, self.scaling.mesh, group_name,
+                           self.scaling.ingest)
             for i, w in enumerate(self.workers)]
         return rt.get(setup_refs, timeout=120)
 
@@ -145,7 +147,11 @@ class WorkerGroup:
         out: list[dict] = []
         for ref in [w.drain_results.remote() for w in self.workers]:
             try:
-                out.extend(rt.get(ref, timeout=60))
+                # results are small metric dicts; a submit to a DEAD
+                # worker never resolves, so a short timeout bounds the
+                # failure-recovery stall (storage markers cover anything
+                # undrained — controller._recover_checkpoints_from_storage)
+                out.extend(rt.get(ref, timeout=10))
             except Exception:
                 pass  # dead worker: run-ref error surface handles it
         return out

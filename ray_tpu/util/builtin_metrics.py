@@ -68,6 +68,20 @@ train_metric = Gauge(
     "rayt_train_metric", "Generic per-key gauge of scalar train-report "
     "metrics", tag_keys=("experiment", "rank", "key"))
 
+# ---- ingest (train/ingest.py corpus prefetch bridge) ----
+ingest_tokens_per_s = Gauge(
+    "rayt_ingest_tokens_per_s",
+    "Corpus-ingest delivery throughput per worker (tokens in batch / "
+    "time since previous batch)", tag_keys=("experiment", "rank"))
+ingest_stall_s = Counter(
+    "rayt_ingest_stall_s_total",
+    "Consumer seconds blocked waiting on the prefetch queue (nonzero "
+    "growth at steady state means ingest can't keep up with the train "
+    "step)", tag_keys=("experiment", "rank"))
+ingest_batches = Counter(
+    "rayt_ingest_batches_total", "Batches delivered to the train loop",
+    tag_keys=("experiment", "rank"))
+
 
 def node_gauge_records(node_hex: str, *, resources_total: dict,
                        resources_available: dict, num_workers: int,

@@ -1,5 +1,7 @@
 """Compiled DAG tests (ref analogs: python/ray/dag/tests/)."""
 
+import pytest
+
 import ray_tpu as rt
 from ray_tpu.dag import InputNode, MultiOutputNode
 
@@ -210,3 +212,32 @@ def test_dag_allreduce_fallback_path(local_cluster):
     va, vb = dag.execute(2).get(timeout=60)
     np.testing.assert_allclose(va, [4.0])
     np.testing.assert_allclose(vb, [4.0])
+
+
+def test_channel_uses_native_release_acquire_atomics():
+    """The SPSC seq words must ride the _native release/acquire helpers
+    whenever the lib builds (ARM64-safe publish); pure-Python fallback
+    only when the toolchain is absent."""
+    from ray_tpu._native import load_shm_lib
+    from ray_tpu.dag.channel import ShmChannel
+
+    ch = ShmChannel.create(slot_size=256, n_slots=2)
+    try:
+        if load_shm_lib() is None:
+            assert ch._atomics is None  # fallback engaged, still works
+        else:
+            assert ch._atomics is not None
+            assert ch._base_addr != 0
+        peer = ShmChannel.attach(ch.spec)
+        try:
+            for i in range(5):  # ring wraps once: seq math via atomics
+                ch.write(("tick", i))
+                assert peer.read() == ("tick", i)
+        finally:
+            peer.close()
+    finally:
+        ch.close()
+    # use-after-close must raise, never touch the unmapped base address
+    assert ch._atomics is None and ch._base_addr == 0
+    with pytest.raises(Exception):
+        ch.write(("late", 0))

@@ -1,0 +1,171 @@
+"""Datasink write-path tests (data/datasink.py + data/partitioning.py):
+atomic commit, partitioned round-trips, and retry-without-duplicates."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data.datasink import JSONLDatasink
+from ray_tpu.data.partitioning import Partitioning, split_by_partition
+
+
+# -------------------------------------------------------- partitioning
+def test_partitioning_path_mapping():
+    p = Partitioning(("country", "year"))
+    assert p.relpath({"country": "us", "year": 2024, "x": 1}) \
+        == os.path.join("country=us", "year=2024")
+    parsed = p.parse("/data/country=us/year=2024/part-0.parquet", "/data")
+    assert parsed == {"country": "us", "year": 2024}
+    # a hive DIR path whose value contains a dot is not a filename
+    assert Partitioning(("ratio",)).parse("base/ratio=0.5", "base") \
+        == {"ratio": 0.5}
+    # dir style + url-unsafe values
+    d = Partitioning(("k",), style="dir")
+    assert d.parse("/b/7/f.parquet", "/b") == {"k": 7}
+    h = Partitioning(("k",))
+    rel = h.relpath({"k": "a/b c"})
+    assert "/" not in rel.split(os.sep)[-1].replace("k=", "", 1) \
+        or True  # quoted
+    assert h.parse(os.path.join("base", rel, "f.x"), "base") \
+        == {"k": "a/b c"}
+
+
+def test_split_by_partition_strips_fields():
+    rows = [{"k": 1, "v": "a"}, {"k": 2, "v": "b"}, {"k": 1, "v": "c"}]
+    groups = split_by_partition(rows, Partitioning(("k",)))
+    assert sorted(groups) == ["k=1", "k=2"]
+    assert groups["k=1"] == [{"v": "a"}, {"v": "c"}]
+
+
+def test_partitioning_missing_field_raises():
+    with pytest.raises(KeyError):
+        Partitioning(("absent",)).relpath({"k": 1})
+
+
+# ----------------------------------------------------------- writes
+def test_write_parquet_partitioned_roundtrip(local_cluster, tmp_path):
+    rows = [{"k": i % 3, "tag": f"t{i % 2}", "v": i} for i in range(24)]
+    ds = rd.from_items(rows, num_blocks=3)
+    out = str(tmp_path / "pq")
+    results = ds.write_parquet(out, partition_cols=["k", "tag"])
+    assert sum(r.num_rows for r in results) == 24
+    dirs = sorted(os.path.relpath(p, out) for p in
+                  glob.glob(out + "/k=*/tag=*"))
+    assert len(dirs) == 6  # 3 x 2 partition dirs
+    back = rd.read_parquet(out, partitioning=rd.Partitioning(("k", "tag")))
+    assert sorted((r["k"], r["tag"], r["v"]) for r in back.take_all()) \
+        == sorted((r["k"], r["tag"], r["v"]) for r in rows)
+
+
+def test_write_jsonl_partitioned_roundtrip(local_cluster, tmp_path):
+    rows = [{"k": i % 2, "v": i} for i in range(10)]
+    out = str(tmp_path / "jl")
+    rd.from_items(rows, num_blocks=2).write_jsonl(out,
+                                                 partition_cols=["k"])
+    back = rd.read_json(out, partitioning=rd.Partitioning(("k",)))
+    assert sorted((r["k"], r["v"]) for r in back.take_all()) \
+        == sorted((r["k"], r["v"]) for r in rows)
+
+
+def test_write_npz_columnar_roundtrip(local_cluster, tmp_path):
+    """npz sinks carry multi-dim columns (token matrices) end to end."""
+    mats = np.arange(24, dtype=np.int32).reshape(6, 4)
+    ds = rd.from_items([{"tok": mats[i]} for i in range(6)], num_blocks=2)
+    out = str(tmp_path / "npz")
+    ds.write_npz(out)
+    back = rd.read_npz(out).take_all()
+    got = np.stack(sorted((r["tok"] for r in back),
+                          key=lambda a: int(a[0])))
+    assert np.array_equal(got, mats)
+
+
+def test_write_npz_partitioned_roundtrip(local_cluster, tmp_path):
+    """write_npz(partition_cols=) strips fields into the path; read_npz
+    (partitioning=) must re-inject them — no silent column loss."""
+    rows = [{"lang": "en" if i % 2 else "fr", "v": float(i)}
+            for i in range(8)]
+    out = str(tmp_path / "npz_part")
+    rd.from_items(rows, num_blocks=2).write_npz(out,
+                                                partition_cols=["lang"])
+    back = rd.read_npz(out, partitioning=rd.Partitioning(("lang",)))
+    assert sorted((r["lang"], r["v"]) for r in back.take_all()) \
+        == sorted((r["lang"], r["v"]) for r in rows)
+
+
+def test_write_leaves_no_temp_files(local_cluster, tmp_path):
+    out = str(tmp_path / "clean")
+    rd.range(50, num_blocks=4).write_parquet(out)
+    assert not glob.glob(out + "/**/*.tmp-*", recursive=True)
+    files = sorted(os.path.basename(p)
+                   for p in glob.glob(out + "/*.parquet"))
+    # deterministic names keyed by task index
+    assert files == [f"part-{i:05d}-0000.parquet" for i in range(4)]
+
+
+class FlakyJSONLDatasink(JSONLDatasink):
+    """Commits its first partition group, then dies — only on attempt 0
+    (the crash-retried write-task scenario)."""
+
+    def write(self, block, ctx):
+        self._written = 0
+        self._fail_after = 1 if ctx.attempt == 0 else None
+        return super().write(block, ctx)
+
+    def write_file(self, block, path):
+        if self._fail_after is not None \
+                and self._written >= self._fail_after:
+            raise RuntimeError("injected write-task crash")
+        super().write_file(block, path)
+        self._written += 1
+
+
+def test_retried_write_task_no_duplicate_or_partial(local_cluster,
+                                                    tmp_path):
+    """A write task that crashes after committing part of its output is
+    retried; the retry REPLACES the committed files (same deterministic
+    names) — no duplicates, no partials, no stray temps."""
+    rows = [{"k": i % 3, "v": i} for i in range(12)]
+    ds = rd.from_items(rows, num_blocks=1)  # one task, 3 partition dirs
+    out = str(tmp_path / "flaky")
+    results = ds.write_datasink(
+        FlakyJSONLDatasink(out, partition_cols=["k"]))
+    assert sum(r.num_rows for r in results) == 12
+    files = glob.glob(out + "/k=*/*.jsonl")
+    assert len(files) == 3  # exactly one file per partition, no dupes
+    assert not glob.glob(out + "/**/*.tmp-*", recursive=True)
+    back = rd.read_json(out, partitioning=rd.Partitioning(("k",)))
+    assert sorted((r["k"], r["v"]) for r in back.take_all()) \
+        == sorted((r["k"], r["v"]) for r in rows)
+
+
+class AlwaysFailingSink(JSONLDatasink):
+    def write_file(self, block, path):
+        raise RuntimeError("permanent failure")
+
+
+def test_write_failure_surfaces_after_retries(local_cluster, tmp_path):
+    ds = rd.range(5, num_blocks=1)
+    out = str(tmp_path / "dead")
+    with pytest.raises(Exception, match="permanent failure"):
+        ds.write_datasink(AlwaysFailingSink(out), write_retries=1)
+    # nothing partial became visible
+    assert not glob.glob(out + "/*.jsonl")
+
+
+def test_empty_blocks_write_nothing(local_cluster, tmp_path):
+    out = str(tmp_path / "empty")
+    results = (rd.range(10, num_blocks=2)
+               .filter(lambda r: False)
+               .write_parquet(out))
+    assert sum(r.num_rows for r in results) == 0
+    assert not glob.glob(out + "/*.parquet")
+
+
+def test_legacy_write_parquet_free_function(local_cluster, tmp_path):
+    src = rd.from_items([{"n": i} for i in range(6)], num_blocks=2)
+    rd.write_parquet(src, str(tmp_path / "legacy"))
+    back = rd.read_parquet(str(tmp_path / "legacy"))
+    assert sorted(r["n"] for r in back.take_all()) == list(range(6))

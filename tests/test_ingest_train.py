@@ -1,0 +1,160 @@
+"""Data->Train ingest bridge tests (train/ingest.py + recipes
+corpus_pretrain_loop): the end-to-end acceptance path — a JaxTrainer run
+killed mid-epoch resumes from checkpoint onto a bit-identical token
+stream — plus the ingest perf gate (prefetch must overlap the train
+step; per-block overhead bounded)."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import IngestSpec, JaxTrainer
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.ingest import CorpusIngestIterator
+from ray_tpu.train.recipes import corpus_pretrain_loop
+
+
+def _make_corpus(root, *, shards=8, docs=30, seed=1):
+    corpus = os.path.join(root, "corpus")
+    os.makedirs(corpus, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for s in range(shards):
+        with open(os.path.join(corpus, f"s{s:03d}.jsonl"), "w") as f:
+            for _ in range(docs):
+                toks = rng.integers(1, 100, rng.integers(5, 60)).tolist()
+                f.write(json.dumps({"tokens": toks}) + "\n")
+    return corpus
+
+
+def _fit(corpus, root, name, *, crash_at=None, num_workers=1, steps=16):
+    spec = IngestSpec(paths=corpus, seq_len=32, batch_blocks=4,
+                      eos_id=0, epochs=4)
+    trace = os.path.join(root, f"trace_{name}")
+    cfg = {"steps": steps, "checkpoint_every": 3, "trace_dir": trace,
+           "vocab_size": 101}
+    if crash_at is not None:
+        cfg["crash_at_step"] = crash_at
+    trainer = JaxTrainer(
+        corpus_pretrain_loop, train_loop_config=cfg,
+        scaling_config=ScalingConfig(num_workers=num_workers,
+                                     ingest=spec),
+        run_config=RunConfig(
+            name=f"ingest-{name}", storage_path=os.path.join(root, "res"),
+            failure_config=FailureConfig(max_failures=1)))
+    return trace, trainer.fit()
+
+
+def _steps_of(trace, rank=0):
+    return sorted(glob.glob(os.path.join(trace, f"rank{rank}",
+                                         "step_*.npy")))
+
+
+def test_e2e_kill_midepoch_resume_bit_identical(local_cluster, tmp_path):
+    """ISSUE acceptance: train from a sharded corpus, hard-kill the
+    worker mid-epoch, resume from checkpoint — the EFFECTIVE consumed
+    token stream (each step's batch, final attempt wins) equals the
+    uninterrupted run's, bit for bit."""
+    root = str(tmp_path)
+    corpus = _make_corpus(root)
+    t_ok, res_ok = _fit(corpus, root, "ok")
+    t_cr, res_cr = _fit(corpus, root, "cr", crash_at=8)
+    ok_steps, cr_steps = _steps_of(t_ok), _steps_of(t_cr)
+    assert len(ok_steps) == 16 and len(cr_steps) == 16
+    for a, b in zip(ok_steps, cr_steps):
+        assert os.path.basename(a) == os.path.basename(b)
+        assert np.array_equal(np.load(a), np.load(b)), \
+            f"token stream diverged at {os.path.basename(a)}"
+    # both runs finished training on the same metrics surface
+    assert res_ok.metrics["step"] == res_cr.metrics["step"] == 16
+    assert res_cr.checkpoint is not None
+
+
+def test_two_worker_ingest_shards_disjoint(local_cluster, tmp_path):
+    """num_workers=2: each worker's session-ingest stream equals the
+    directly-constructed (dp_rank, world_size) iterator — shard slices
+    are deterministic and disjoint."""
+    root = str(tmp_path)
+    corpus = _make_corpus(root, shards=6)
+    t, _ = _fit(corpus, root, "dp2", num_workers=2, steps=6)
+    spec = IngestSpec(paths=corpus, seq_len=32, batch_blocks=4,
+                      eos_id=0, epochs=4)
+    for rank in (0, 1):
+        want = CorpusIngestIterator(spec, dp_rank=rank, world_size=2)
+        steps = _steps_of(t, rank)
+        assert len(steps) == 6
+        for p in steps:
+            assert np.array_equal(np.load(p), next(want)["tokens"])
+        want.close()
+    # disjoint: no batch of rank0 appears in rank1's stream
+    r0 = {np.load(p).tobytes() for p in _steps_of(t, 0)}
+    r1 = {np.load(p).tobytes() for p in _steps_of(t, 1)}
+    assert not (r0 & r1)
+
+
+def test_ingest_propagates_session_metrics(local_cluster, tmp_path):
+    """tokens/s + stall metrics ride the PR-1 pipeline: the recipe
+    reports ingest stats through session.report."""
+    root = str(tmp_path)
+    corpus = _make_corpus(root, shards=4)
+    _, res = _fit(corpus, root, "metrics", steps=6)
+    assert res.metrics["tokens"] == 4 * 32
+    assert "ingest_stall_s" in res.metrics
+    assert "ingest_load_s" in res.metrics
+
+
+# ------------------------------------------------------------ perf gate
+def test_ingest_perf_gate(tmp_path):
+    """Acceptance perf gate: (1) prefetch OVERLAPS the train step — with
+    a consumer slower than the producer, total consumer stall stays
+    below total block-load time (the serial-ingest worst case); (2)
+    per-block ingest overhead stays bounded (ms-scale on a 1-core CI
+    box, far under any real train step)."""
+    corpus = _make_corpus(str(tmp_path), shards=30, docs=40, seed=3)
+    spec = IngestSpec(paths=corpus, seq_len=64, batch_blocks=8,
+                      eos_id=0, epochs=1, prefetch_batches=4)
+    it = CorpusIngestIterator(spec)
+    batches = 0
+    for _ in it:
+        batches += 1
+        time.sleep(0.004)  # simulated train step: slower than the load
+    assert batches >= 20, "gate corpus too small to measure"
+    s = it.stats
+    assert s.load_s > 0
+    # (1) overlap: consumer never waits as long as loading takes end to
+    # end — prefetch hid the shard loads behind the train step
+    assert s.stall_s < s.load_s, \
+        f"stall {s.stall_s * 1e3:.1f}ms >= load {s.load_s * 1e3:.1f}ms " \
+        f"— prefetch not overlapping"
+    # (2) per-block production overhead (parse+pack+stack), generous 20ms
+    per_block = s.load_s / s.blocks
+    assert per_block < 0.020, \
+        f"per-block ingest cost {per_block * 1e3:.2f}ms exceeds gate"
+
+
+def test_ingest_without_spec_raises(local_cluster, tmp_path):
+    from ray_tpu.train.session import TrainContext
+
+    ctx = TrainContext(0, 1, str(tmp_path), "x", None)
+    with pytest.raises(RuntimeError, match="no ingest configured"):
+        ctx.get_ingest()
+
+
+def test_ingest_close_unblocks_producer(tmp_path):
+    """close() mid-stream tears the prefetch thread down without
+    deadlock (producer may be parked on a full queue)."""
+    corpus = _make_corpus(str(tmp_path), shards=10, docs=40)
+    spec = IngestSpec(paths=corpus, seq_len=16, batch_blocks=2,
+                      prefetch_batches=1)
+    it = CorpusIngestIterator(spec)
+    next(it)
+    it.close()
+    t0 = time.monotonic()
+    it._thread.join(timeout=5)
+    assert not it._thread.is_alive()
+    assert time.monotonic() - t0 < 5
+    with pytest.raises(StopIteration):
+        next(it)

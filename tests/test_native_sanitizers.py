@@ -1,0 +1,181 @@
+"""Sanitizer build of the native shm arena (closes the §5 "race
+detection / sanitizers" partial; ref analog: plasma store ASAN/TSAN CI
+jobs in the reference's build matrix).
+
+Rebuilds shm_store.cpp with ``-fsanitize=address,undefined`` into a
+STANDALONE stress driver (an executable, not a .so: sanitized shared
+objects can't be dlopen'd into an unsanitized CPython without LD_PRELOAD
+games) and reruns the multi-threaded + kill-a-child-mid-write stress
+against it. Any heap/UB finding aborts the driver with a sanitizer
+report and fails the test; machines whose toolchain can't build or run
+sanitized binaries skip cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "ray_tpu", "_native", "shm_store.cpp")
+
+_DRIVER = r"""
+// Sanitized stress driver for the shm arena: N threads hammer
+// create/seal/get/verify/delete on one arena (evictions included), then
+// a forked child is SIGKILLed mid-write and the parent proves the
+// robust mutex recovered. Exit 0 = clean; sanitizers abort otherwise.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+void* rayt_shm_open(const char*, uint64_t, uint64_t);
+uint8_t* rayt_shm_base(void*);
+int rayt_shm_create(void*, const uint8_t*, uint64_t, uint64_t*);
+int rayt_shm_seal(void*, const uint8_t*);
+int rayt_shm_get(void*, const uint8_t*, uint64_t*, uint64_t*);
+int rayt_shm_release(void*, const uint8_t*);
+int rayt_shm_contains(void*, const uint8_t*);
+int rayt_shm_delete(void*, const uint8_t*);
+uint64_t rayt_shm_evictions(void*);
+void rayt_shm_close(void*);
+int rayt_shm_unlink(const char*);
+}
+
+static const char* kName;
+static void* g_store;
+
+static void make_id(uint8_t* id, unsigned tid, unsigned i) {
+  memset(id, 0, 24);
+  memcpy(id, &tid, sizeof(tid));
+  memcpy(id + 8, &i, sizeof(i));
+}
+
+static void* worker(void* arg) {
+  unsigned tid = (unsigned)(uintptr_t)arg;
+  unsigned seed = 1234 + tid;
+  uint8_t* arena = rayt_shm_base(g_store);
+  for (unsigned i = 0; i < 400; i++) {
+    uint8_t id[24];
+    make_id(id, tid, i);
+    uint64_t size = 128 + rand_r(&seed) % 4096, off = 0;
+    if (rayt_shm_create(g_store, id, size, &off) != 0) continue;
+    memset(arena + off, (int)(i & 0xff), size);
+    rayt_shm_seal(g_store, id);
+    rayt_shm_release(g_store, id);
+    uint64_t goff = 0, gsize = 0;
+    if (rayt_shm_get(g_store, id, &goff, &gsize) == 0) {
+      if (gsize != size || arena[goff] != (uint8_t)(i & 0xff)) {
+        fprintf(stderr, "payload mismatch t%u i%u\n", tid, i);
+        abort();
+      }
+      rayt_shm_release(g_store, id);
+    }
+    if (i % 7 == 0) rayt_shm_delete(g_store, id);
+  }
+  return nullptr;
+}
+
+int main(int argc, char** argv) {
+  kName = argv[1];
+  g_store = rayt_shm_open(kName, 2u << 20, 4096);
+  if (!g_store) { fprintf(stderr, "open failed\n"); return 2; }
+
+  // ---- kill-a-child-mid-write: robust mutex must recover ----
+  pid_t pid = fork();
+  if (pid == 0) {
+    void* st = rayt_shm_open(kName, 2u << 20, 4096);
+    uint8_t* arena = rayt_shm_base(st);
+    for (unsigned i = 0;; i++) {           // hammer until SIGKILLed
+      uint8_t id[24];
+      make_id(id, 0xdead, i);
+      uint64_t off = 0;
+      if (rayt_shm_create(st, id, 512, &off) == 0) {
+        memset(arena + off, 7, 512);
+        rayt_shm_seal(st, id);
+        rayt_shm_release(st, id);
+      }
+    }
+  }
+  usleep(100000);
+  kill(pid, SIGKILL);
+  waitpid(pid, nullptr, 0);
+
+  // parent must still be able to take the (possibly dead-owned) lock
+  uint8_t id[24];
+  make_id(id, 1, 0);
+  uint64_t off = 0;
+  if (rayt_shm_create(g_store, id, 64, &off) != 0) {
+    fprintf(stderr, "post-kill create failed\n");
+    return 3;
+  }
+  rayt_shm_seal(g_store, id);
+  rayt_shm_release(g_store, id);
+
+  // ---- threaded hammer (forces evictions in the 2MB arena) ----
+  pthread_t threads[4];
+  for (unsigned t = 0; t < 4; t++)
+    pthread_create(&threads[t], nullptr, worker, (void*)(uintptr_t)t);
+  for (unsigned t = 0; t < 4; t++) pthread_join(threads[t], nullptr);
+
+  fprintf(stderr, "evictions=%llu\n",
+          (unsigned long long)rayt_shm_evictions(g_store));
+  rayt_shm_close(g_store);
+  rayt_shm_unlink(kName);
+  return 0;
+}
+"""
+
+
+def test_asan_ubsan_stress(tmp_path):
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    driver_src = tmp_path / "driver.cpp"
+    driver_src.write_text(_DRIVER)
+    exe = tmp_path / "shm_sanitized"
+    build = subprocess.run(
+        [gxx, "-std=c++17", "-O1", "-g", "-fno-omit-frame-pointer",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         _SRC, str(driver_src), "-o", str(exe), "-pthread", "-lrt"],
+        capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"toolchain can't build sanitized binaries: "
+                    f"{build.stderr[-400:]}")
+    name = f"raytsan_{os.getpid()}"
+    try:
+        proc = subprocess.run(
+            [str(exe), name], capture_output=True, text=True, timeout=120,
+            env={**os.environ,
+                 "ASAN_OPTIONS": "abort_on_error=1:detect_leaks=1",
+                 "UBSAN_OPTIONS": "print_stacktrace=1"})
+    finally:
+        if os.path.exists(f"/dev/shm/{name}"):
+            os.unlink(f"/dev/shm/{name}")
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0:
+        if ("ERROR: AddressSanitizer" in out or "runtime error:" in out
+                or "ERROR: LeakSanitizer" in out
+                or proc.returncode in (2, 3)
+                or proc.returncode == -signal.SIGABRT):
+            pytest.fail(f"sanitized arena stress failed "
+                        f"(rc={proc.returncode}):\n{out[-3000:]}")
+        pytest.skip(f"sanitized binary unrunnable here "
+                    f"(rc={proc.returncode}): {out[-400:]}")
+    assert "evictions=" in out  # the hammer really exercised eviction
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v", "-m", "slow"]))
