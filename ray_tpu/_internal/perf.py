@@ -85,6 +85,19 @@ def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
     r["rate_per_s"] = round(r["rate_per_s"] * big.nbytes / (1 << 30), 3)
     results.append(r)
 
+    # repeated get of ONE sealed object: isolates the read path (the
+    # zero-copy contract — shm-backed views, no deserialize-time copy)
+    # from put/seal cost, which put_get above mixes in
+    big_ref = rt.put(big)
+
+    def get_big():
+        rt.get(big_ref)
+
+    r = _timeit("get_gigabytes_per_second", get_big, 1, max(duration, 1.0))
+    r["rate_per_s"] = round(r["rate_per_s"] * big.nbytes / (1 << 30), 3)
+    results.append(r)
+    del big_ref
+
     # compiled-DAG per-tick cost: per-call executor vs pre-allocated shm
     # channel loops (ref: compiled_dag_node.py fast path; VERDICT r3 #3)
     @rt.remote

@@ -22,6 +22,7 @@ import asyncio
 import itertools
 import random
 import struct
+import sys
 import threading
 import traceback
 from typing import Any, Awaitable, Callable
@@ -32,7 +33,8 @@ from ray_tpu._internal.config import get_config
 from ray_tpu._internal.logging_utils import setup_logger
 
 logger = setup_logger("rpc")
-from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
+from ray_tpu._internal.serialization import (chunks_to_bytes, deserialize,
+                                             serialize, serialized_size)
 
 REQUEST, RESPONSE, ERROR, NOTIFY = 0, 1, 2, 3
 _LEN = struct.Struct("<I")
@@ -79,8 +81,12 @@ class _Chaos:
 
 async def _read_frame(reader: asyncio.StreamReader):
     """Returns (msgid, kind, method, value, is_raw). A 5-element header
-    marks a RAW frame: `value` is the following rawlen bytes verbatim
-    (no pickle), the bulk-transfer fast path."""
+    marks an out-of-band payload of `rawlen` bytes: when the tag (4th
+    element) is None the bytes are the value verbatim (RAW bulk-transfer
+    fast path, is_raw=True); when the tag is truthy the bytes are a
+    serialized payload the sender handed to the transport as the raw
+    serialize() chunk list — semantically identical to a 4-element
+    pickled frame, so is_raw=False and callers deserialize."""
     header = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
@@ -88,29 +94,86 @@ async def _read_frame(reader: asyncio.StreamReader):
     data = await reader.readexactly(length)
     frame = msgpack.unpackb(data, raw=False, use_list=True)
     if len(frame) == 5:
-        msgid, kind, method, _, rawlen = frame
+        msgid, kind, method, tag, rawlen = frame
         if rawlen > MAX_FRAME:
             raise RpcError(f"raw frame too large: {rawlen}")
         raw = await reader.readexactly(rawlen)
-        return msgid, kind, method, raw, True
+        return msgid, kind, method, raw, tag is None
     msgid, kind, method, payload = frame
     return msgid, kind, method, payload, False
 
 
 # bytes values at least this large skip pickle+msgpack re-framing and go
 # on the wire verbatim (object-transfer chunks are the main rider); the
-# receiver hands the bytes straight to the caller. Cuts per-chunk host
-# copies roughly in half, which is what bounds loopback/DCN throughput.
+# receiver hands the bytes straight to the caller. Serialized payloads
+# whose total size crosses the same threshold ride out-of-band too, as
+# the raw serialize() chunk list: the pickle header and each pickle-5
+# buffer reach the transport as separate buffers, cutting the copy count
+# to the transport's single writelines join (a sendmsg-capable transport
+# would make it true writev) — vs. the joined blob being copied AGAIN
+# into the msgpack body on the old path.
 RAW_THRESHOLD = 256 * 1024
+
+# tag marking an out-of-band SERIALIZED payload (vs None = verbatim raw)
+_SG_TAG = 1
+
+# Pre-3.12 selector transports JOIN writelines buffers (a userspace copy),
+# so once writelines returns, the caller's memoryviews are no longer
+# referenced and a RawView's mapping pin can drop after drain(). 3.12+
+# writelines is sendmsg-based zero-copy: the transport may queue the view
+# itself, so releasing the pin after drain() could let eviction overwrite
+# bytes still in flight — materialize RawView payloads to bytes there
+# (one copy, exactly what the pre-3.12 join costs anyway).
+_WRITELINES_JOINS = sys.version_info < (3, 12)
+
+
+class RawView:
+    """A raw response payload that aliases long-lived memory (e.g. a shm
+    mapping) plus a completion callback. The rpc layer sends ``data``
+    verbatim on the RAW path regardless of size and invokes ``on_sent``
+    once the buffer has been handed to the transport — the push side of
+    object transfer uses this to keep the source mapping pinned until
+    the write drains, then drop its get-ref (no ``bytes()`` copy)."""
+
+    __slots__ = ("data", "on_sent")
+
+    def __init__(self, data, on_sent=None):
+        self.data = data
+        self.on_sent = on_sent
+
+    def done(self):
+        cb, self.on_sent = self.on_sent, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
 
 def _frames(msgid: int, kind: int, method: str, value) -> list:
-    """Encode one message as a list of wire buffers (header [+ raw])."""
-    if isinstance(value, (bytes, bytearray, memoryview))             and len(value) >= RAW_THRESHOLD:
+    """Encode one message as a list of wire buffers (header [+ payload
+    chunks]), handed to ``writer.writelines`` verbatim — at most one
+    copy (the transport's join) between the value's buffers and the
+    socket."""
+    if isinstance(value, RawView):
+        data = value.data
+        if not _WRITELINES_JOINS and not isinstance(data, bytes):
+            data = bytes(data)  # see _WRITELINES_JOINS
+        head = msgpack.packb([msgid, kind, method, None, len(data)],
+                             use_bin_type=True)
+        return [_LEN.pack(len(head)) + head, data]
+    if isinstance(value, (bytes, bytearray, memoryview)) \
+            and len(value) >= RAW_THRESHOLD:
         head = msgpack.packb([msgid, kind, method, None, len(value)],
                              use_bin_type=True)
         return [_LEN.pack(len(head)) + head, value]
-    body = msgpack.packb([msgid, kind, method, serialize_to_bytes(value)],
+    chunks = serialize(value)
+    total = serialized_size(chunks)
+    if total >= RAW_THRESHOLD:
+        head = msgpack.packb([msgid, kind, method, _SG_TAG, total],
+                             use_bin_type=True)
+        return [_LEN.pack(len(head)) + head, *chunks]
+    body = msgpack.packb([msgid, kind, method, chunks_to_bytes(chunks)],
                          use_bin_type=True)
     return [_LEN.pack(len(body)) + body]
 
@@ -223,27 +286,36 @@ class Connection:
     async def _handle_request(self, msgid: int, method: str,
                               payload, is_raw: bool = False):
         handlers = self.server_handlers or {}
+        result = None
         try:
-            handler = handlers.get(method)
-            if handler is None:
-                raise RpcError(f"no handler for method {method!r}")
-            arg = payload if is_raw else deserialize(payload)
-            result = handler(self, arg)
-            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
-                result = await result
-            if self._chaos.should_drop():
-                return  # drop the reply: client sees a timeout
-            out = _frames(msgid, RESPONSE, method, result)
-        except Exception as e:
-            out = _frames(
-                msgid, ERROR, method,
-                (f"{type(e).__name__}: {e}", traceback.format_exc()),
-            )
-        try:
-            self.writer.writelines(out)
-            await self.writer.drain()
-        except (ConnectionError, OSError):
-            pass
+            try:
+                handler = handlers.get(method)
+                if handler is None:
+                    raise RpcError(f"no handler for method {method!r}")
+                arg = payload if is_raw else deserialize(payload)
+                result = handler(self, arg)
+                if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                    result = await result
+                if self._chaos.should_drop():
+                    return  # drop the reply: client sees a timeout
+                out = _frames(msgid, RESPONSE, method, result)
+            except Exception as e:
+                out = _frames(
+                    msgid, ERROR, method,
+                    (f"{type(e).__name__}: {e}", traceback.format_exc()),
+                )
+            try:
+                self.writer.writelines(out)
+                await self.writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            # after writelines the transport owns the bytes (pre-3.12 it
+            # joins; on 3.12+ _frames materialized the view — see
+            # _WRITELINES_JOINS); release the handler's mapping pin on
+            # every exit path, including chaos drops and encode errors
+            if isinstance(result, RawView):
+                result.done()
 
     async def call(self, method: str, arg: Any = None, timeout: float | None = None) -> Any:
         if self.closed:

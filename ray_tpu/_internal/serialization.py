@@ -62,16 +62,30 @@ def serialized_size(chunks: list[bytes | memoryview]) -> int:
     return sum(len(c) if isinstance(c, bytes) else c.nbytes for c in chunks)
 
 
+def chunks_to_bytes(chunks: list[bytes | memoryview]) -> bytes:
+    """Join a serialize() chunk list into one contiguous blob with exactly
+    one copy (``bytes.join`` consumes memoryviews directly — no per-chunk
+    ``bytes()`` materialization)."""
+    if len(chunks) == 1 and isinstance(chunks[0], bytes):
+        return chunks[0]
+    return b"".join(chunks)
+
+
 def serialize_to_bytes(obj: Any) -> bytes:
-    return b"".join(bytes(c) for c in serialize(obj))
+    return chunks_to_bytes(serialize(obj))
 
 
-def deserialize(data: bytes | memoryview) -> Any:
+def deserialize(data: bytes | memoryview, *, buffer_wrapper=None) -> Any:
     """Deserialize from a contiguous buffer, zero-copy for buffers.
 
     When ``data`` is a memoryview over shared memory, the out-of-band
     buffers alias that memory: the resulting numpy arrays are views, not
     copies (callers must keep the mapping alive; ObjectRef holders do).
+
+    ``buffer_wrapper``, when given, is applied to each out-of-band buffer
+    view before it is handed to pickle — the zero-copy get path uses it
+    to interpose weakref-able pin holders so the shm segment stays mapped
+    exactly as long as any reconstructed array aliases it.
     """
     mv = memoryview(data)
     n_buffers, plen = _HEADER.unpack_from(mv, 0)
@@ -82,7 +96,8 @@ def deserialize(data: bytes | memoryview) -> Any:
     for _ in range(n_buffers):
         (blen,) = _BUFLEN.unpack_from(mv, off)
         off += _BUFLEN.size
-        buffers.append(mv[off:off + blen])
+        view = mv[off:off + blen]
+        buffers.append(view if buffer_wrapper is None else buffer_wrapper(view))
         off += _pad(blen)
     return pickle.loads(payload, buffers=buffers)
 

@@ -135,7 +135,10 @@ class NativeArenaStore:
         self._arena_off = lib.rayt_shm_arena_offset(self._handle)
         self._held: dict[Any, int] = {}   # oid -> get-refcount
         self._pending: dict[Any, int] = {}  # unsealed oid -> abs offset
-        self._lock = threading.Lock()
+        # RLock: release() can re-enter on the SAME thread via a GC
+        # firing ObjectRef.__del__ -> pin drain -> release while this
+        # thread is already inside a locked section
+        self._lock = threading.RLock()
         # fallback-to-disk allocation (ref: plasma_allocator.cc fallback
         # mmaps): objects that don't fit the arena land in per-node files,
         # named by object id so every worker process sees them
@@ -287,6 +290,14 @@ class NativeArenaStore:
             self._held[object_id] = self._held.get(object_id, 0) + 1
         return self._payload(off.value, sz.value)
 
+    def get_view(self, object_id, size: int) -> memoryview:
+        """Zero-copy view of the sealed payload. Takes a get-ref (the
+        pin: LRU eviction cannot reclaim the block) that the caller must
+        balance with release() once no deserialized view aliases it. The
+        fallback-file branch returns an owned copy — release() is then a
+        harmless no-op (no ref was taken)."""
+        return self._get_view(object_id, size)
+
     def get(self, object_id, size: int):
         from ray_tpu._internal.serialization import deserialize
 
@@ -299,33 +310,39 @@ class NativeArenaStore:
         finally:
             self.release(object_id)
 
-    def read_range(self, object_id, size: int, offset: int,
-                   length: int) -> bytes:
-        """One transfer chunk: bytes [offset, offset+length) of the
-        sealed payload (ref: object_buffer_pool chunked reads)."""
+    def read_range_view(self, object_id, size: int, offset: int,
+                        length: int):
+        """One transfer chunk: (view, release_cb) for the push side of
+        chunked transfer (ref: object_buffer_pool chunked reads) — the
+        chunk aliases the arena mapping with a get-ref held, zero copy.
+        The caller MUST invoke release_cb (when not None) after the bytes
+        have been handed to the transport, or the block stays pinned."""
         if not self._lib.rayt_shm_contains(self._handle,
                                            object_id.binary()) \
                 and self._fb_exists(object_id):
-            # fallback file: seek+read the chunk — materializing the
-            # whole (by definition large) file per chunk would be O(n^2)
             with open(self._fb_path(object_id), "rb") as f:
                 f.seek(offset)
-                return f.read(length)
+                return f.read(length), None
         view = self._get_view(object_id, size)
-        try:
-            return bytes(view[offset:offset + length])
-        finally:
-            self.release(object_id)
+        return (view[offset:offset + length],
+                lambda: self.release(object_id))
 
     def release(self, object_id):
         with self._lock:
+            # NULL-handle guard: a zero-copy get-pin can drain AFTER
+            # store close (an ObjectRef GC'd past rt.shutdown()); the C
+            # side has no guard and would segfault on a NULL arena
+            if self._handle is None:
+                return
             n = self._held.get(object_id, 0)
             if n <= 0:
                 return
             self._held[object_id] = n - 1
             if self._held[object_id] == 0:
                 del self._held[object_id]
-        self._lib.rayt_shm_release(self._handle, object_id.binary())
+            # C call inside the lock: close() also nulls the handle
+            # under it, so the handle can't be torn down mid-call
+            self._lib.rayt_shm_release(self._handle, object_id.binary())
 
     def release_create_ref(self, object_id):
         """Drop the ref held by create_from_bytes(hold=True)."""
@@ -369,15 +386,16 @@ class NativeArenaStore:
                 if self._handle else 0)
 
     def close(self):
-        if self._handle:
-            try:
-                self._mv.release()
-                self._map.close()
-            except (BufferError, ValueError):
-                pass  # zero-copy views alive; mapping stays until exit
-            else:
-                self._lib.rayt_shm_close(self._handle)
-                self._handle = None
+        with self._lock:
+            if self._handle:
+                try:
+                    self._mv.release()
+                    self._map.close()
+                except (BufferError, ValueError):
+                    pass  # zero-copy views alive; mapping stays until exit
+                else:
+                    self._lib.rayt_shm_close(self._handle)
+                    self._handle = None
 
     def destroy_self(self):
         """Unlink the arena segment (node-manager only, at shutdown)."""

@@ -13,12 +13,14 @@ threads; async actors get their own asyncio loop.
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import socket
 import sys
 import threading
 import time
 import traceback
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any
@@ -32,7 +34,9 @@ from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import (Connection, ConnectionLost, RemoteError,
                                    RpcError, RpcServer, EventLoopThread,
                                    connect)
-from ray_tpu._internal.serialization import deserialize, serialize_to_bytes
+from ray_tpu._internal.serialization import (chunks_to_bytes, deserialize,
+                                             serialize, serialize_to_bytes,
+                                             serialized_size)
 from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
                                  GetTimeoutError,
                                  NodeAffinitySchedulingStrategy,
@@ -105,6 +109,74 @@ class _ExecutionContext(threading.local):
     job_id: JobID | None = None     # owning job of the executing task
 
 
+class _ShmGetPin:
+    """Pin bookkeeping for ONE zero-copy get: the store's get-ref is held
+    while ``count`` > 0. Slots: one per live out-of-band buffer wrapper
+    (the numpy views handed to pickle — reconstructed arrays keep them
+    alive as their buffer base) plus, optionally, one for the local
+    ObjectRef(s), dropped when the last counted ref dies.
+
+    Reentrancy design (a GC can fire ObjectRef.__del__ at ANY allocation,
+    including inside store internals): wrapper finalizers and the
+    ref-drop path only ever append to the owner's event deque
+    (reentrancy-safe, lock-free); every count mutation after seal() and
+    every ``store.release`` happens inside CoreWorker._drain_pin_events,
+    whose locks are all acquired non-blocking. Wrappers are held by
+    STRONG refs until seal() arms their finalizers, so no event for this
+    pin can exist before its count is final.
+    Ref analog: plasma's client-side object refcount, which keeps a
+    Get() buffer mapped until the last PlasmaBuffer is destroyed."""
+
+    __slots__ = ("oid", "_events", "_count", "_wrappers")
+
+    def __init__(self, oid: ObjectID, events: collections.deque):
+        self.oid = oid
+        self._events = events
+        self._count = 1          # guard until seal()/abort()
+        self._wrappers: list = []
+
+    @property
+    def n_wrappers(self) -> int:
+        return len(self._wrappers)
+
+    def wrap(self, view: memoryview):
+        """buffer_wrapper for deserialize(): interpose a weakref-able
+        holder between pickle and the raw shm view."""
+        import numpy as np
+
+        w = np.frombuffer(view, dtype=np.uint8)
+        self._wrappers.append(w)  # strong ref: finalizer armed at seal()
+        return w
+
+    def seal(self, ref_held: bool) -> bool:
+        """Fix the slot count and arm the wrapper finalizers. True =>
+        nothing pins the mapping (no views, no counted ref): the caller
+        must queue this pin on the event deque, whose drain drops the
+        remaining guard slot and releases the store's get-ref."""
+        wrappers, self._wrappers = self._wrappers, []
+        self._count = len(wrappers) + (1 if ref_held else 0)
+        if self._count == 0:
+            self._count = 1  # consumed by the caller's queued event
+            return True
+        for w in wrappers:
+            weakref.finalize(w, self._events.append, self)
+        return False
+
+    def abort(self):
+        """Deserialize failed: drop the wrapper refs and queue one
+        release for the store's get-ref."""
+        self._wrappers = []
+        self._count = 1
+        self._events.append(self)
+
+    def dec(self) -> bool:
+        """One slot died. Called ONLY under the owner's drain lock (the
+        single consumer), so no pin-level lock is needed. True => last
+        slot: the drain releases the store's get-ref."""
+        self._count -= 1
+        return self._count == 0
+
+
 class CoreWorker:
     def __init__(self, mode: str, job_id: JobID, gcs_address: Address,
                  node_address: Address, node_id: NodeID):
@@ -129,9 +201,16 @@ class CoreWorker:
         self._return_to_task: dict[ObjectID, TaskID] = {}
         # streaming-generator tasks we own (ref: generator_waiter.cc)
         self._streams: dict[TaskID, Any] = {}
+        # zero-copy get pins: oid -> pins holding a live ref-holder slot;
+        # _pin_events queues slot-death notifications (finalizer-safe)
+        self._shm_pins: dict[ObjectID, list[_ShmGetPin]] = {}
+        self._pin_lock = threading.Lock()
+        self._pin_events: collections.deque = collections.deque()
+        self._pin_drain_lock = threading.Lock()
         self.reference_counter = ReferenceCounter(
             is_owner=self._owns, free_fn=self._free_object,
-            notify_owner_fn=self._notify_owner_refcount)
+            notify_owner_fn=self._notify_owner_refcount,
+            release_local_fn=self._release_shm_pins)
         self.root_task_id = TaskID.for_normal_task(job_id)
         self._exec_ctx = _ExecutionContext()
         self._put_index = 0
@@ -350,7 +429,56 @@ class CoreWorker:
         except Exception:
             pass
 
+    # ------------------------------------------------- zero-copy get pins
+    def _release_shm_pins(self, oid: ObjectID):
+        """The last counted local ref to oid died: queue a sentinel that
+        drops the registered pin's ref-holder slot (live buffer views
+        keep their own slots, so the mapping stays pinned until they die
+        too). This runs from ObjectRef.__del__ — i.e. potentially inside
+        a GC triggered ANYWHERE, including while this very thread holds
+        the pin or store locks — so it must only append + try-drain."""
+        self._pin_events.append(oid)
+        self._drain_pin_events()
+
+    def _drain_pin_events(self):
+        """Process queued pin-slot deaths and release store get-refs.
+        Single-consumer, and every lock here is acquired NON-blocking: a
+        reentrant call (a GC collecting an ObjectRef while this thread
+        is inside the pin registration block or store internals) bails
+        out or requeues, leaving its events for the active drainer / the
+        periodic flush loop. Events are either _ShmGetPin (one slot
+        died) or an ObjectID sentinel (ref-holder slot drop)."""
+        if not self._pin_drain_lock.acquire(blocking=False):
+            return
+        try:
+            requeue = []
+            while True:
+                try:
+                    ev = self._pin_events.popleft()
+                except IndexError:
+                    break
+                if isinstance(ev, _ShmGetPin):
+                    pins = (ev,)
+                elif self._pin_lock.acquire(blocking=False):
+                    try:
+                        pins = tuple(self._shm_pins.pop(ev, ()))
+                    finally:
+                        self._pin_lock.release()
+                else:
+                    requeue.append(ev)  # registration in progress: later
+                    continue
+                for pin in pins:
+                    if pin.dec():
+                        try:
+                            self.shm.release(pin.oid)
+                        except Exception:
+                            pass
+            self._pin_events.extend(requeue)
+        finally:
+            self._pin_drain_lock.release()
+
     def _free_object(self, oid: ObjectID):
+        self._release_shm_pins(oid)
         self.memory_store.delete(oid)
         meta = self.object_meta.pop(oid, None)
         # Lineage retention (ref: task_manager.h:212 lineage pinning): the
@@ -413,39 +541,42 @@ class CoreWorker:
         self.reference_counter.remove_borrower(oid, key)
 
     # ------------------------------------------------- shm create helpers
-    def _shm_create_blocking(self, oid: ObjectID, blob: bytes):
-        """Create+seal holding the create-ref (so LRU can't evict before
-        the node manager pins); on arena-OOM ask the node manager to
-        spill and retry (ref: plasma create-request queue)."""
+    def _shm_create_blocking(self, oid: ObjectID, chunks: list, size: int):
+        """Create+seal a serialize() chunk list holding the create-ref
+        (so LRU can't evict before the node manager pins) — each chunk is
+        written straight into the segment, the payload is never joined
+        host-side; on arena-OOM ask the node manager to spill and retry
+        (ref: plasma create-request queue)."""
         for _ in range(100):
             try:
-                self.shm.create_from_bytes(oid, blob, hold=True)
+                self.shm.create_from_chunks(oid, chunks, size, hold=True)
                 return
             except MemoryError:
                 try:
                     freed = self.io.run(self.node_conn.call(
-                        "spill_now", len(blob)), timeout=60)
+                        "spill_now", size), timeout=60)
                 except Exception:
                     freed = 0
                 if not freed:
                     time.sleep(0.1)
         raise MemoryError(
-            f"object store full: could not place {len(blob)} bytes")
+            f"object store full: could not place {size} bytes")
 
-    async def _shm_create_async(self, oid: ObjectID, blob: bytes):
+    async def _shm_create_async(self, oid: ObjectID, chunks: list,
+                                size: int):
         for _ in range(100):
             try:
-                self.shm.create_from_bytes(oid, blob, hold=True)
+                self.shm.create_from_chunks(oid, chunks, size, hold=True)
                 return
             except MemoryError:
                 try:
-                    freed = await self.node_conn.call("spill_now", len(blob))
+                    freed = await self.node_conn.call("spill_now", size)
                 except Exception:
                     freed = 0
                 if not freed:
                     await asyncio.sleep(0.1)
         raise MemoryError(
-            f"object store full: could not place {len(blob)} bytes")
+            f"object store full: could not place {size} bytes")
 
     def _release_create_ref(self, oid: ObjectID):
         release = getattr(self.shm, "release_create_ref", None)
@@ -488,20 +619,24 @@ class CoreWorker:
     def _store_owned_value(self, oid: ObjectID, value: Any,
                            is_exception: bool = False):
         cfg = get_config()
-        blob = None
+        chunks = None
+        size = -1
         try:
-            blob = serialize_to_bytes(value)
+            # serialize to a chunk list: big payloads go straight from
+            # the value's buffers into the shm segment, never joined
+            chunks = serialize(value)
+            size = serialized_size(chunks)
         except Exception as e:
             value = TaskError(e, "serialization", traceback.format_exc())
             is_exception = True
-        if blob is not None and len(blob) > cfg.max_direct_call_object_size \
+        if chunks is not None and size > cfg.max_direct_call_object_size \
                 and not is_exception:
-            self._shm_create_blocking(oid, blob)
-            meta = ObjectMeta(oid, size=len(blob), in_shm=True,
+            self._shm_create_blocking(oid, chunks, size)
+            meta = ObjectMeta(oid, size=size, in_shm=True,
                               node_ids=[self.node_id])
             self.object_meta[oid] = meta
 
-            async def _announce(oid=oid, size=len(blob)):
+            async def _announce(oid=oid, size=size):
                 try:
                     await self.node_conn.call(
                         "object_created", (oid, size, self.worker_info))
@@ -511,8 +646,7 @@ class CoreWorker:
             self._spawn_from_thread(_announce())
         else:
             self.memory_store.put(oid, value, is_exception)
-            self.object_meta[oid] = ObjectMeta(
-                oid, size=len(blob) if blob else -1, inline=True)
+            self.object_meta[oid] = ObjectMeta(oid, size=size, inline=True)
         self._signal_object_ready(oid)
 
     def _signal_object_ready(self, oid: ObjectID):
@@ -532,17 +666,81 @@ class CoreWorker:
 
         values = self.io.run(_get_all())
         out = []
-        for v, kind in values:
+        for ref, (v, kind) in zip(refs, values):
+            if kind == "shm":
+                # deserialize OFF the IO loop, zero-copy over the mapping
+                v, kind = self._load_shm_value(ref, v[0], v[1], deadline)
             if kind == "exc":
-                if isinstance(v, TaskError):
-                    raise v
                 raise v
-            if kind == "blob":
-                v = deserialize(v)
-                if isinstance(v, BaseException):
-                    raise v
+            if kind == "des" and isinstance(v, BaseException):
+                raise v
             out.append(v)
         return out
+
+    def _load_shm_value(self, ref: ObjectRef, oid: ObjectID, size: int,
+                        deadline: float | None):
+        """Map + deserialize a local sealed shm object with NO copy: the
+        returned value's arrays alias the shared-memory mapping (read-
+        only). Pin contract: the mapping is held open while any counted
+        local ObjectRef to oid exists OR any aliasing view is alive;
+        the pin drops when both are gone. If the local copy vanished
+        between resolve and map (freed / spilled / evicted), re-resolve
+        through _async_get — that path restores or re-pulls it."""
+        for _ in range(4):
+            try:
+                view = self.shm.get_view(oid, size)
+            except (KeyError, FileNotFoundError, TypeError, ValueError):
+                # gone (freed/spilled/evicted) or a concurrent release
+                # closed the mapping under us: re-resolve — that path
+                # restores, re-pulls, or reopens the segment
+                v, kind = self.io.run(self._async_get(ref, deadline))
+                if kind == "shm":
+                    oid, size = v
+                    continue
+                return v, kind
+            pin = _ShmGetPin(oid, self._pin_events)
+            try:
+                value = deserialize(memoryview(view).toreadonly(),
+                                    buffer_wrapper=pin.wrap)
+            except BaseException:
+                pin.abort()
+                self._drain_pin_events()
+                raise
+            ref_held = (pin.n_wrappers > 0
+                        and self.reference_counter.has_record(oid))
+            # registration + seal under ONE lock hold: a ref-drop
+            # sentinel (which needs this lock, non-blocking, to pop the
+            # list) can never observe the pin before its count is final
+            with self._pin_lock:
+                pins = self._shm_pins.setdefault(oid, []) \
+                    if ref_held else None
+                if pins:
+                    # one ref-holder slot per oid suffices to pin the
+                    # segment for the ref's lifetime — repeated gets of
+                    # a live ref must not grow the pin list (this pin
+                    # then lives only as long as its views do)
+                    ref_held = False
+                release_now = pin.seal(ref_held=ref_held)
+                if ref_held:
+                    pins.append(pin)
+            if ref_held and not self.reference_counter.has_record(oid):
+                # the ref died inside the registration window and its
+                # sentinel may have fired before our append: reclaim the
+                # orphan slot unless a later sentinel already popped it
+                with self._pin_lock:
+                    lst = self._shm_pins.get(oid)
+                    if lst and pin in lst:
+                        lst.remove(pin)
+                        if not lst:
+                            del self._shm_pins[oid]
+                        self._pin_events.append(pin)  # drop its ref slot
+            if release_now:
+                # nothing aliases the mapping and no counted ref exists:
+                # the queued event drops the guard slot + store get-ref
+                self._pin_events.append(pin)
+            self._drain_pin_events()
+            return value, "des"
+        raise ObjectLostError(f"{oid}: local shm copy keeps vanishing")
 
     async def _async_get(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
@@ -576,12 +774,12 @@ class CoreWorker:
             # reconstruct via lineage (ref: object_recovery_manager.h:38)
             if meta is not None and meta.in_shm:
                 if self.shm.contains_locally(oid):
-                    return (self.shm.read_bytes(oid, meta.size), "blob")
+                    return ((oid, meta.size), "shm")
                 if await self._pull_object(oid, meta.size, meta.node_ids,
                                            ref.owner or self.worker_info):
                     if self.node_id not in meta.node_ids:
                         meta.node_ids.append(self.node_id)
-                    return (self.shm.read_bytes(oid, meta.size), "blob")
+                    return ((oid, meta.size), "shm")
                 if self._owns(oid) and self._maybe_recover_object(oid):
                     continue
                 raise ObjectLostError(
@@ -589,7 +787,7 @@ class CoreWorker:
             if self.shm.contains_locally(oid):
                 info = await self.node_conn.call("object_lookup", oid)
                 if info is not None:
-                    return (self.shm.read_bytes(oid, info["size"]), "blob")
+                    return ((oid, info["size"]), "shm")
             if self._owns(oid):
                 tid = self._return_to_task.get(oid)
                 pt = self.pending_tasks.get(tid) if tid is not None else None
@@ -628,7 +826,7 @@ class CoreWorker:
                             raise ObjectLostError(f"could not pull {oid}")
                         await asyncio.sleep(0.1)
                         continue
-                return (self.shm.read_bytes(oid, size), "blob")
+                return ((oid, size), "shm")
             if kind == "device":
                 _, holder = res
                 local = self.device_store.get(oid)
@@ -1631,21 +1829,24 @@ class CoreWorker:
         cfg = get_config()
         oid = ObjectID.for_return(spec.task_id, index)
         try:
-            blob = serialize_to_bytes(item)
+            chunks = serialize(item)
+            size = serialized_size(chunks)
         except Exception as e:
             entry = ("inline", serialize_to_bytes(
                 TaskError(e, spec.name, traceback.format_exc())), True)
         else:
-            if len(blob) > cfg.max_direct_call_object_size:
-                await self._shm_create_async(oid, blob)
+            if size > cfg.max_direct_call_object_size:
+                # yielded blocks ride the same copy-free path as normal
+                # returns: chunks straight into shm, no host-side join
+                await self._shm_create_async(oid, chunks, size)
                 try:
                     await self.node_conn.call(
-                        "object_created", (oid, len(blob), spec.owner))
+                        "object_created", (oid, size, spec.owner))
                 finally:
                     self._release_create_ref(oid)
-                entry = ("shm", len(blob), self.node_id)
+                entry = ("shm", size, self.node_id)
             else:
-                entry = ("inline", blob, False)
+                entry = ("inline", chunks_to_bytes(chunks), False)
         conn = await self._conn_to(spec.owner.address)
         return await conn.call(
             "generator_item", (spec.task_id, index, entry),
@@ -1848,21 +2049,24 @@ class CoreWorker:
                             self.worker_info))
                 continue
             try:
-                blob = serialize_to_bytes(value)
+                chunks = serialize(value)
+                size = serialized_size(chunks)
             except Exception as e:
                 out.append(("inline", serialize_to_bytes(
                     TaskError(e, spec.name, traceback.format_exc())), True))
                 continue
-            if len(blob) > cfg.max_direct_call_object_size:
-                self._shm_create_blocking(oid, blob)
+            if size > cfg.max_direct_call_object_size:
+                # chunk list goes straight into the shm segment — the
+                # return payload is never joined into a host-side blob
+                self._shm_create_blocking(oid, chunks, size)
                 try:
                     self.io.run(self.node_conn.call(
-                        "object_created", (oid, len(blob), spec.owner)))
+                        "object_created", (oid, size, spec.owner)))
                 finally:
                     self._release_create_ref(oid)
-                out.append(("shm", len(blob)))
+                out.append(("shm", size))
             else:
-                out.append(("inline", blob, False))
+                out.append(("inline", chunks_to_bytes(chunks), False))
         return ("ok", out)
 
     async def rpc_create_actor(self, conn, spec: TaskSpec):
@@ -2053,6 +2257,9 @@ class CoreWorker:
         task_event_buffer.cc periodic flush to gcs_task_manager)."""
         while not self._shutdown:
             await asyncio.sleep(1.0)
+            # piggyback: release shm get-pins whose last holder died on a
+            # thread that couldn't drain (reentrant/contended at the time)
+            self._drain_pin_events()
             events = self.task_events.drain()
             if not events:
                 continue

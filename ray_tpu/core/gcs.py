@@ -326,7 +326,10 @@ class GcsServer:
             else:
                 self.metrics_store.ingest(message)
         dead = []
-        for conn in self.subscribers.get(channel, ()):  # push-based pubsub
+        # snapshot: the notify below awaits, and a concurrent subscribe /
+        # connection-close discard mutating the live set mid-iteration
+        # raises "Set changed size during iteration"
+        for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 dead.append(conn)
                 continue

@@ -26,7 +26,7 @@ from typing import Any
 from ray_tpu._internal.config import get_config
 from ray_tpu._internal.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._internal.logging_utils import setup_logger
-from ray_tpu._internal.rpc import Connection, RpcServer, connect
+from ray_tpu._internal.rpc import Connection, RawView, RpcServer, connect
 from ray_tpu.core.common import Address, NodeInfo, TaskSpec, WorkerInfo
 from ray_tpu.core.object_store import make_shm_store
 
@@ -941,12 +941,21 @@ class NodeManager:
             return victim
 
     def _spill_write(self, victim: ObjectID, size: int) -> str:
-        """The IO half of a spill (shm read + file write) — safe to run
-        on an executor thread; state mutation stays on the loop."""
-        data = self.shm.read_bytes(victim, size)
+        """The IO half of a spill (shm map + file write) — safe to run
+        on an executor thread; state mutation stays on the loop. Writes
+        the mapping view directly: no host-side copy of the payload."""
         path = self._spill_path(victim)
-        with open(path + ".tmp", "wb") as f:
-            f.write(data)
+        view, release = self.shm.read_range_view(victim, size, 0, size)
+        try:
+            with open(path + ".tmp", "wb") as f:
+                f.write(view)
+        finally:
+            view = None
+            if release is not None:
+                try:
+                    release()
+                except Exception:
+                    pass
         os.replace(path + ".tmp", path)
         return path
 
@@ -1171,21 +1180,55 @@ class NodeManager:
                 pass
         return True
 
-    def rpc_fetch_object(self, conn, object_id: ObjectID):
-        """Chunked pull entrypoint for node-to-node transfer (ref:
-        push_manager.h:30 / pull_manager.h:52; single-frame for now, the
-        RPC layer already streams large frames). Spilled objects serve
-        straight from disk — no need to round-trip through shm."""
+    @staticmethod
+    async def _read_spill_range(path: str, offset: int, length: int | None):
+        """Read [offset, offset+length) of a spill file (length None =
+        to EOF) on an executor thread. None = the file vanished (a
+        concurrent local restore deleted it)."""
+
+        def read_file():
+            try:
+                with open(path, "rb") as f:
+                    if offset:
+                        f.seek(offset)
+                    return f.read() if length is None else f.read(length)
+            except OSError:
+                return None
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, read_file)
+
+    async def _serve_shm_range(self, object_id: ObjectID, size: int,
+                               offset: int, length: int):
+        """Serve bytes [offset, offset+length) of a sealed in-shm object
+        as a RawView over the source mapping — no ``bytes()`` copy; the
+        rpc layer writes it verbatim and the get-ref pinning the mapping
+        drops once the write is handed to the transport. The store read
+        runs on an executor thread: usually just a mapping slice, but
+        the native store's fallback-file branch (arena-OOM objects) does
+        a real disk read that must not stall this loop. None = gone, or
+        a concurrent free/unlink closed the mapping under the executor
+        read — "not here", the puller tries elsewhere."""
+        try:
+            view, release = await asyncio.get_running_loop().run_in_executor(
+                None, self.shm.read_range_view, object_id,
+                size, offset, length)
+        except (KeyError, FileNotFoundError, TypeError, ValueError):
+            return None
+        return RawView(view, release)
+
+    async def rpc_fetch_object(self, conn, object_id: ObjectID):
+        """Single-frame pull entrypoint for node-to-node transfer (ref:
+        push_manager.h:30 / pull_manager.h:52). Spilled objects serve
+        straight from disk; in-shm objects serve zero-copy via
+        _serve_shm_range."""
         meta = self.object_dir.get(object_id)
         if meta is None:
             return None
         if meta.get("spilled"):
-            try:
-                with open(meta["spilled"], "rb") as f:
-                    return f.read()
-            except OSError:
-                return None
-        return self.shm.read_bytes(object_id, meta["size"])
+            return await self._read_spill_range(meta["spilled"], 0, None)
+        return await self._serve_shm_range(object_id, meta["size"],
+                                           0, meta["size"])
 
     async def rpc_fetch_chunk(self, conn, arg):
         """Serve bytes [offset, offset+length) of a sealed object — the
@@ -1199,34 +1242,16 @@ class NodeManager:
             meta = self.object_dir.get(object_id)
             if meta is None:
                 return None
-            loop = asyncio.get_running_loop()
             if meta.get("spilled"):
-                path = meta["spilled"]
-
-                def read_file_range():
-                    try:
-                        with open(path, "rb") as f:
-                            f.seek(offset)
-                            return f.read(length)
-                    except OSError:
-                        return None
-
-                data = await loop.run_in_executor(None, read_file_range)
+                data = await self._read_spill_range(
+                    meta["spilled"], offset, length)
                 if data is not None:
                     return data
                 # a concurrent local restore deleted the spill file
                 # mid-pull; it re-created the shm copy first, so fall
                 # through and serve the chunk from shm
-            read_range = getattr(self.shm, "read_range", None)
-            try:
-                if read_range is None:
-                    return self.shm.read_bytes(
-                        object_id, meta["size"])[offset:offset + length]
-                return await loop.run_in_executor(
-                    None, read_range, object_id, meta["size"], offset,
-                    length)
-            except (KeyError, FileNotFoundError):
-                return None
+            return await self._serve_shm_range(object_id, meta["size"],
+                                               offset, length)
 
     def _store_pulled(self, object_id: ObjectID, chunks: list, size: int,
                       owner):

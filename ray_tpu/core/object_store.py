@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from multiprocessing import shared_memory, resource_tracker
 from typing import Any
 
@@ -75,7 +76,12 @@ class MemoryStore:
 
 
 def _shm_name(object_id: ObjectID) -> str:
-    return "rayt_" + object_id.hex()[:40]
+    # FULL hex (53 chars incl. prefix, well under shm NAME_MAX): return
+    # ids of one task differ only in the trailing index suffix, so any
+    # truncation collapses every return/stream item of a task onto ONE
+    # segment (duplicate-create dedup then silently serves item 0's
+    # payload for item N)
+    return "rayt_" + object_id.hex()
 
 
 def _unregister_tracker(shm: shared_memory.SharedMemory):
@@ -95,6 +101,15 @@ class ShmObjectStore:
         # objects allocated but still being written (streamed pulls,
         # restores): hidden from contains_locally until seal
         self._unsealed: set[ObjectID] = set()
+        # unlinked/released segments whose mappings are still pinned by
+        # live zero-copy views: kept referenced (not in the cache) so the
+        # mapping survives until the views die, then swept closed
+        self._zombies: list[shared_memory.SharedMemory] = []
+        # guards the _open cache: pin-driven release() now runs from
+        # other threads concurrently with get_view/_mapping. RLock — a
+        # GC firing ObjectRef.__del__ can re-enter the release path on
+        # the same thread mid-critical-section
+        self._map_lock = threading.RLock()
 
     def create_and_seal(self, object_id: ObjectID, value: Any) -> int:
         chunks = serialize(value)
@@ -108,7 +123,8 @@ class ShmObjectStore:
             n = len(c) if isinstance(c, bytes) else c.nbytes
             buf[off:off + n] = bytes(c) if isinstance(c, bytes) else c
             off += n
-        self._open[object_id] = shm
+        with self._map_lock:
+            self._open[object_id] = shm
         return size
 
     def create_from_bytes(self, object_id: ObjectID, data: bytes,
@@ -168,7 +184,8 @@ class ShmObjectStore:
             return False
         _unregister_tracker(shm)
         self._unsealed.add(object_id)
-        self._open[object_id] = shm
+        with self._map_lock:
+            self._open[object_id] = shm
         return True
 
     @staticmethod
@@ -190,7 +207,8 @@ class ShmObjectStore:
             return False
 
     def write_at(self, object_id: ObjectID, offset: int, data):
-        shm = self._open[object_id]
+        with self._map_lock:
+            shm = self._open[object_id]
         n = len(data)
         shm.buf[offset:offset + n] = data
 
@@ -207,7 +225,8 @@ class ShmObjectStore:
             os.remove(self._unsealed_marker(object_id))
         except OSError:
             pass
-        shm = self._open.pop(object_id, None)
+        with self._map_lock:
+            shm = self._open.pop(object_id, None)
         if shm is not None:
             try:
                 shm.close()
@@ -249,72 +268,138 @@ class ShmObjectStore:
                 pass
             return False
         try:
-            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
-            _unregister_tracker(shm)
-            self._open[object_id] = shm
+            # open-and-cache through _mapping so a concurrent get_view
+            # can't double-open the segment and orphan one mapping
+            self._mapping(object_id)
             return True
         except FileNotFoundError:
             return False
 
+    def _mapping(self, object_id: ObjectID) -> shared_memory.SharedMemory:
+        with self._map_lock:
+            shm = self._open.get(object_id)
+            if shm is None:
+                # open inside the lock: two threads double-opening would
+                # orphan the loser's mapping (unclosable once views
+                # export from it)
+                shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+                _unregister_tracker(shm)
+                self._open[object_id] = shm
+            return shm
+
+    def get_view(self, object_id: ObjectID, size: int) -> memoryview:
+        """Zero-copy view of the sealed payload. The mapping is cached
+        (the pin): it stays open until release(), and release() keeps it
+        open for as long as any exported view is alive (BufferError
+        tolerance). Raises FileNotFoundError if the segment is gone."""
+        return self._mapping(object_id).buf[:size]
+
     def get(self, object_id: ObjectID, size: int) -> Any:
         """Zero-copy deserialize; the mapping stays cached so buffer views
         remain valid while this process holds the ref."""
-        shm = self._open.get(object_id)
-        if shm is None:
-            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
-            _unregister_tracker(shm)
-            self._open[object_id] = shm
-        return deserialize(shm.buf[:size])
+        return deserialize(self.get_view(object_id, size))
 
     def read_bytes(self, object_id: ObjectID, size: int) -> bytes:
-        shm = self._open.get(object_id)
-        if shm is None:
-            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
-            _unregister_tracker(shm)
-            self._open[object_id] = shm
-        return bytes(shm.buf[:size])
+        return bytes(self._mapping(object_id).buf[:size])
 
-    def read_range(self, object_id: ObjectID, size: int, offset: int,
-                   length: int) -> bytes:
-        shm = self._open.get(object_id)
-        if shm is None:
-            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
-            _unregister_tracker(shm)
-            self._open[object_id] = shm
-        return bytes(shm.buf[offset:offset + length])
+    def read_range_view(self, object_id: ObjectID, size: int, offset: int,
+                        length: int):
+        """(view, release_cb) for the push side of chunked transfer: the
+        chunk aliases the cached mapping, no copy. release_cb is None —
+        the mapping stays cached (same lifetime as every other read) and
+        unlink's BufferError tolerance covers views still in flight."""
+        return self._mapping(object_id).buf[offset:offset + length], None
+
+    @staticmethod
+    def _silence_del(shm: shared_memory.SharedMemory):
+        """A mapping with live exported views cannot close; neutralize the
+        instance's close so __del__ at interpreter shutdown doesn't spew
+        'Exception ignored ... BufferError' (the map dies with the
+        process either way). Only applied at store close() — while the
+        store lives, zombies keep their real close so the sweep can
+        reclaim them once their views die."""
+        shm.close = lambda: None  # type: ignore[method-assign]
+
+    def _sweep_zombies(self):
+        """Retry closing unlinked-but-pinned mappings: views that were
+        in flight at unlink time (RawView pushes, spill writes) die
+        shortly after, and the mapping must actually be reclaimed then —
+        not accumulate until process exit."""
+        if not self._zombies:
+            return
+        with self._map_lock:  # appends race this sweep from other threads
+            zombies, self._zombies = self._zombies, []
+            alive = []
+            for shm in zombies:
+                try:
+                    shm.close()
+                except BufferError:
+                    alive.append(shm)
+            self._zombies.extend(alive)
 
     def release(self, object_id: ObjectID):
-        shm = self._open.pop(object_id, None)
+        self._sweep_zombies()
+        with self._map_lock:
+            shm = self._open.pop(object_id, None)
         if shm is not None:
             try:
                 shm.close()
             except BufferError:
-                # views still alive; keep mapping until process exit
-                self._open[object_id] = shm
+                # Views alive. close() already dropped shm._buf before
+                # the mmap refused to unmap, so this instance can never
+                # serve another read — park it as a zombie (mapping
+                # survives until the views die); a later get reopens the
+                # still-named segment fresh. Re-caching it would poison
+                # every subsequent access with _buf=None.
+                with self._map_lock:
+                    self._zombies.append(shm)
 
     def unlink(self, object_id: ObjectID):
-        """Destroy the segment (node-manager only, when refcount hits 0)."""
-        try:
+        """Destroy the segment (node-manager only, when refcount hits 0).
+
+        Order matters for the zero-copy contract: the NAME is unlinked
+        first (new opens fail immediately; existing mappings — live
+        views — stay valid until their holders drop, plasma's delete
+        semantics), and only then is the local mapping closed. A
+        BufferError on close (views alive) must never skip the unlink,
+        or the segment would leak on /dev/shm for the node's lifetime."""
+        self._sweep_zombies()
+        with self._map_lock:
             shm = self._open.pop(object_id, None)
-            if shm is None:
-                shm = shared_memory.SharedMemory(name=_shm_name(object_id))
-                _unregister_tracker(shm)
-            shm.close()
-            # shm.unlink() sends an unregister; balance the one we already
-            # sent at open/create time by re-registering first.
+        if shm is None:
             try:
-                resource_tracker.register(shm._name, "shared_memory")  # type: ignore[attr-defined]
-            except Exception:
-                pass
+                shm = shared_memory.SharedMemory(name=_shm_name(object_id))
+            except FileNotFoundError:
+                return
+            _unregister_tracker(shm)
+        # shm.unlink() sends an unregister; balance the one we already
+        # sent at open/create time by re-registering first.
+        try:
+            resource_tracker.register(shm._name, "shared_memory")  # type: ignore[attr-defined]
+        except Exception:
+            pass
+        try:
             shm.unlink()
         except FileNotFoundError:
-            pass
+            _unregister_tracker(shm)
+        try:
+            shm.close()
         except BufferError:
-            pass
+            # live zero-copy views: keep the (now anonymous) mapping
+            # referenced so it survives until the views die; swept (and
+            # actually closed) by the next release/unlink once they do
+            with self._map_lock:
+                self._zombies.append(shm)
 
     def close(self):
-        for oid in list(self._open):
-            self.release(oid)
+        with self._map_lock:
+            oids = list(self._open)
+        for oid in oids:
+            self.release(oid)  # view-pinned mappings become zombies
+        self._sweep_zombies()
+        for shm in self._zombies:
+            self._silence_del(shm)  # still pinned at shutdown: quiet exit
+        self._zombies.clear()
 
 
 def make_shm_store(node_id):

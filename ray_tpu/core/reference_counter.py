@@ -44,15 +44,20 @@ class _Record:
 class ReferenceCounter:
     def __init__(self, is_owner: Callable[[ObjectID], bool],
                  free_fn: Callable[[ObjectID], None],
-                 notify_owner_fn: Callable[[ObjectID, object, str], None]):
+                 notify_owner_fn: Callable[[ObjectID, object, str], None],
+                 release_local_fn: Callable[[ObjectID], None] | None = None):
         """free_fn: called when an owned object's count hits 0.
         notify_owner_fn(oid, owner, kind): send add/remove-borrower to a
-        remote owner (fire-and-forget)."""
+        remote owner (fire-and-forget).
+        release_local_fn(oid): called when the last LOCAL ref to a
+        borrowed object drops — unpins this process's zero-copy shm
+        mappings (owned objects go through free_fn, which unpins too)."""
         self._lock = threading.RLock()
         self._records: dict[ObjectID, _Record] = {}
         self._is_owner = is_owner
         self._free = free_fn
         self._notify_owner = notify_owner_fn
+        self._release_local = release_local_fn
         # Serialization context flag: when >0, refs being pickled are task
         # args (pinned via task_pins, not escaped).
         self._tls = threading.local()
@@ -63,6 +68,12 @@ class ReferenceCounter:
             rec = _Record(owned=self._is_owner(oid))
             self._records[oid] = rec
         return rec
+
+    def has_record(self, oid: ObjectID) -> bool:
+        """True while someone in this process holds a counted ref to oid
+        (the zero-copy get path pins the shm mapping for that long)."""
+        with self._lock:
+            return oid in self._records
 
     # ---- local refs -------------------------------------------------
     def add_local_ref(self, ref: "ObjectRef"):
@@ -87,6 +98,8 @@ class ReferenceCounter:
         if to_free is not None:
             self._free(to_free)
         if notify is not None:
+            if self._release_local is not None:
+                self._release_local(notify[0])
             self._notify_owner(*notify)
 
     # ---- serialization events ---------------------------------------
@@ -117,18 +130,30 @@ class ReferenceCounter:
         with self._lock:
             self._record(oid).borrowers.add(borrower_key)
 
+    def _drop_zero_record(self, oid: ObjectID, rec: _Record):
+        """Remove a record whose count hit zero via a non-local-ref path
+        (task pin / borrower). Must be called under the lock; returns the
+        oid to free (owned) or None. Non-owned records are deleted too —
+        a stale borrowed record would keep has_record() True forever and
+        leak the zero-copy get pin tied to it."""
+        del self._records[oid]
+        return oid if rec.owned else None
+
     def remove_borrower(self, oid: ObjectID, borrower_key: str):
         to_free = None
+        removed = False
         with self._lock:
             rec = self._records.get(oid)
             if rec is None:
                 return
             rec.borrowers.discard(borrower_key)
-            if rec.owned and rec.total() == 0:
-                to_free = oid
-                del self._records[oid]
+            if rec.total() == 0:
+                to_free = self._drop_zero_record(oid, rec)
+                removed = True
         if to_free is not None:
             self._free(to_free)
+        elif removed and self._release_local is not None:
+            self._release_local(oid)
 
     # ---- task-argument pins ------------------------------------------
     def add_task_pin(self, oid: ObjectID):
@@ -137,16 +162,19 @@ class ReferenceCounter:
 
     def remove_task_pin(self, oid: ObjectID):
         to_free = None
+        removed = False
         with self._lock:
             rec = self._records.get(oid)
             if rec is None:
                 return
             rec.task_pins = max(0, rec.task_pins - 1)
-            if rec.owned and rec.total() == 0:
-                to_free = oid
-                del self._records[oid]
+            if rec.total() == 0:
+                to_free = self._drop_zero_record(oid, rec)
+                removed = True
         if to_free is not None:
             self._free(to_free)
+        elif removed and self._release_local is not None:
+            self._release_local(oid)
 
     def stats(self) -> dict:
         with self._lock:

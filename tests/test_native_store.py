@@ -78,6 +78,34 @@ def test_pinned_arena_falls_back_to_disk(store):
     store.release(oid)
 
 
+def test_get_view_pins_against_eviction(store):
+    """The zero-copy get path's pin: a held get_view keeps the block out
+    of LRU reach until release()."""
+    oid = ObjectID.random()
+    n = store.create_from_bytes(oid, bytes(256 * 1024))
+    view = store.get_view(oid, n)
+    for _ in range(16):
+        store.create_from_bytes(ObjectID.random(), bytes(128 * 1024))
+    assert store.contains_locally(oid)  # pinned: survived the pressure
+    del view
+    store.release(oid)
+
+
+def test_read_range_view_zero_copy_and_release(store):
+    """Push-side chunk serving: a memoryview over the arena with a
+    get-ref held, dropped by the returned release callback."""
+    oid = ObjectID.random()
+    payload = bytes(range(256)) * 1024  # 256 KiB
+    store.create_from_bytes(oid, payload)
+    view, release = store.read_range_view(oid, len(payload), 4096, 8192)
+    assert isinstance(view, memoryview)
+    assert bytes(view) == payload[4096:4096 + 8192]
+    assert store._held.get(oid) == 1  # pinned while the write drains
+    del view
+    release()
+    assert not store._held  # released: evictable again
+
+
 def test_cross_process_visibility(local_cluster):
     """Objects put by one worker are readable zero-copy by others through
     the same node arena."""

@@ -82,6 +82,23 @@ def test_broadcast_to_consumers(chunked_cluster):
     assert stats["pulled_objects"] == 1, stats
 
 
+def test_pulled_object_get_is_zero_copy(chunked_cluster):
+    """A chunk-pulled object lands in local shm and get() returns views
+    over that copy: read-only, and repeated gets share memory."""
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    def make():
+        return (np.arange(2 * 1024 * 1024) % 251).astype(np.uint8)
+
+    ref = make.remote()
+    a = rt.get(ref, timeout=120)
+    assert not a.flags.writeable
+    b = rt.get(ref, timeout=60)
+    assert np.shares_memory(a, b)
+    assert np.array_equal(
+        a, (np.arange(2 * 1024 * 1024) % 251).astype(np.uint8))
+
+
 def test_spilled_object_served_chunked(chunked_cluster):
     """An object spilled to disk on the producer node still serves
     chunked pulls (file-range reads)."""

@@ -30,7 +30,12 @@ FLOORS = {
     "actor_calls_async_per_second": 1000.0,
     "async_actor_calls_per_second": 1000.0,
     "put_small_per_second": 5000.0,
-    "put_get_gigabytes_per_second": 0.15,
+    # zero-copy object plane (committed ~8.8 GB/s put+get, ~1000 GB/s
+    # repeated get): floors sit far above the pre-zero-copy 0.45 GB/s
+    # copy-tax plateau, so a reintroduced bytes() copy on the get or
+    # frame path trips the gate even on a noisy shared box
+    "put_get_gigabytes_per_second": 1.0,
+    "get_gigabytes_per_second": 25.0,
     "dag_percall_ticks_per_second": 150.0,
     "dag_channel_ticks_per_second": 1000.0,
 }

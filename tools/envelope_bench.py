@@ -46,7 +46,16 @@ def rss_kb(pid: int = 0) -> int:
     return 0
 
 
+# --only filter (set from the CLI): when non-empty, legs whose dimension
+# matches no substring are skipped and the surviving rows are MERGED into
+# an existing --out document instead of overwriting it (re-measure one
+# leg without redoing a multi-hour scale run)
+_only: list[str] = []
+
+
 def _leg(results, dimension, unit, reference, fn):
+    if _only and not any(s in dimension for s in _only):
+        return
     t0 = time.monotonic()
     try:
         value = fn()
@@ -79,7 +88,13 @@ def main():
                    help="scale = the 10-30x envelope push: >=160 nodes, "
                         ">=640 actors, >=500 PGs on one core")
     p.add_argument("--out", default="ENVELOPE.json")
+    p.add_argument("--only", default="",
+                   help="comma-separated dimension substrings: run only "
+                        "matching legs and merge their rows into an "
+                        "existing --out document")
     args = p.parse_args()
+    if args.only:
+        _only.extend(s for s in args.only.split(",") if s)
     if args.profile == "scale":
         args.nodes = max(args.nodes, 160)
         args.actors = max(args.actors, 640)
@@ -210,6 +225,36 @@ def main():
         _leg(results, "max_numpy_object", "GiB",
              "100+ GiB", big_object)
 
+        def bulk_throughput():
+            # data-plane bandwidth next to the control-plane rates: the
+            # put+get round trip (one memcpy into shm) and the repeated
+            # zero-copy get (views over the mapping, no copy at all)
+            arr = np.zeros(128 << 20, np.uint8)
+            gib = arr.nbytes / (1 << 30)
+            rt.get(rt.put(arr))  # warm
+            t0 = time.monotonic()
+            n = 0
+            while time.monotonic() - t0 < 2.0:
+                rt.get(rt.put(arr))
+                n += 1
+            put_get = n * gib / (time.monotonic() - t0)
+            ref = rt.put(arr)
+            rt.get(ref)
+            t0 = time.monotonic()
+            n = 0
+            while time.monotonic() - t0 < 2.0:
+                rt.get(ref)
+                n += 1
+            get_only = n * gib / (time.monotonic() - t0)
+            del ref
+            return {"object_mib": 128,
+                    "put_get_gib_per_s": round(put_get, 2),
+                    "get_gib_per_s": round(get_only, 2)}
+
+        _leg(results, "bulk_data_plane_throughput", "GiB/s",
+             "plasma zero-copy reads (memcpy-bound put, copy-free get)",
+             bulk_throughput)
+
         def broadcast():
             arr = np.zeros(args.broadcast_mib << 20, np.uint8)
             ref = rt.put(arr)
@@ -247,15 +292,35 @@ def main():
     finally:
         cluster.shutdown()
 
-    doc = {
-        "suite": f"scalability envelope ({args.profile} profile)",
-        "host": {"cpus": os.cpu_count()},
-        "note": ("reference envelope numbers were demonstrated on 2000-node"
-                 " clusters / 64-core machines (release/benchmarks); these"
-                 " legs exercise the same dimensions on a 1-core CI sandbox"
-                 " — every scale is a flag for real-cluster runs"),
-        "results": results,
-    }
+    if _only and not results:
+        # a typo'd substring must not exit 0 claiming a refresh happened
+        sys.exit(f"--only {','.join(_only)!r} matched no dimension: "
+                 f"nothing was measured, {args.out} left untouched")
+    doc = None
+    if _only and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                doc = json.load(f)
+            rows = {r["dimension"]: r for r in doc.get("results", [])}
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # never quietly replace a (possibly multi-hour) envelope doc
+            # with just the re-run legs
+            sys.exit(f"--only merge: cannot parse existing {args.out} "
+                     f"({e!r}); fix or remove it first")
+        for r in results:
+            rows[r["dimension"]] = r
+        doc["results"] = list(rows.values())
+    if doc is None:
+        doc = {
+            "suite": f"scalability envelope ({args.profile} profile)",
+            "host": {"cpus": os.cpu_count()},
+            "note": ("reference envelope numbers were demonstrated on"
+                     " 2000-node clusters / 64-core machines"
+                     " (release/benchmarks); these legs exercise the same"
+                     " dimensions on a 1-core CI sandbox — every scale is"
+                     " a flag for real-cluster runs"),
+            "results": results,
+        }
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)
     print(f"wrote {args.out}")
