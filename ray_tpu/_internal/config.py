@@ -56,6 +56,13 @@ class Config:
     # skip the lease round-trip (ref: normal_task_submitter.cc:291 lease
     # reuse). 0 disables caching.
     lease_reuse_idle_s: float = 1.0
+    # Largest number of leases one batched request_lease asks for: the
+    # driver's per-scheduling-key pool sizes requests to its waiter-queue
+    # depth during bursts instead of one RPC round-trip per task.
+    lease_batch_max: int = 64
+    # Worker-side loaded-code LRU capacity (function table entries kept
+    # per worker process; see core/function_table.py).
+    fn_cache_size: int = 256
     # Max workers booting (spawned, not yet registered) at once per
     # node; further creations queue (boot-storm throttle for fleets).
     max_concurrent_worker_boots: int = 8

@@ -19,6 +19,27 @@ Layout (bytes):
 from __future__ import annotations
 
 import os
+import random
+
+# ID randomness: unique, NOT cryptographic (matches the reference — ids
+# only need collision-resistance). os.urandom is a getrandom(2) syscall
+# per id, which dominated TaskID minting on the submit hot path
+# (~90us/id on the CI host); a process-local PRNG seeded from urandom
+# keeps 64-bit+ uniqueness at ~1us/id. Fork-safety: reseed on first use
+# in a child (getpid check) so forked workers never replay the parent's
+# stream and collide with its ids.
+_rng: random.Random | None = None
+_rng_pid = 0
+
+
+def _rand_bytes(n: int) -> bytes:
+    global _rng, _rng_pid
+    pid = os.getpid()
+    if _rng is None or _rng_pid != pid:
+        _rng = random.Random(os.urandom(16) + pid.to_bytes(4, "little"))
+        _rng_pid = pid
+    return _rng.randbytes(n)
+
 
 JOB_ID_LEN = 4
 ACTOR_ID_LEN = 12
@@ -31,7 +52,7 @@ PLACEMENT_GROUP_ID_LEN = 12
 
 class BaseID:
     LEN = 16
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hex", "_h")
 
     def __init__(self, b: bytes):
         if not isinstance(b, bytes) or len(b) != self.LEN:
@@ -41,7 +62,7 @@ class BaseID:
 
     @classmethod
     def random(cls) -> "BaseID":
-        return cls(os.urandom(cls.LEN))
+        return cls(_rand_bytes(cls.LEN))
 
     @classmethod
     def nil(cls) -> "BaseID":
@@ -54,7 +75,13 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        # cached: ids are hex()'d on every lifecycle event / metrics tag
+        # of every task — a lazy slot beats re-encoding each time
+        try:
+            return self._hex
+        except AttributeError:
+            h = self._hex = self._bytes.hex()
+            return h
 
     @classmethod
     def from_hex(cls, h: str) -> "BaseID":
@@ -64,7 +91,13 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._bytes))
+        # cached: ids key every hot dict (pending tasks, object meta,
+        # reference counts); the tuple build per lookup adds up
+        try:
+            return self._h
+        except AttributeError:
+            h = self._h = hash((type(self).__name__, self._bytes))
+            return h
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._bytes.hex()[:16]})"
@@ -94,7 +127,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(8) + job_id.binary())
+        return cls(_rand_bytes(8) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[8:])
@@ -105,11 +138,11 @@ class TaskID(BaseID):
 
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
-        return cls(os.urandom(8) + b"\x00" * 8 + job_id.binary())
+        return cls(_rand_bytes(8) + b"\x00" * 8 + job_id.binary())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        return cls(os.urandom(8) + actor_id.binary())
+        return cls(_rand_bytes(8) + actor_id.binary())
 
     def actor_id(self) -> ActorID:
         return ActorID(self._bytes[8:])

@@ -39,6 +39,15 @@ def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
 
     results.append(_timeit("tasks_per_second", submit_batch, 100, duration))
 
+    # steady-state burst: one pre-built 500-task wave per iteration —
+    # long enough that lease batching + hot-lease chaining dominate the
+    # measurement instead of the wave's spin-up/drain edges
+    def submit_burst():
+        rt.get([tiny.remote(i) for i in range(500)])
+
+    results.append(_timeit("tasks_per_second_burst", submit_burst, 500,
+                           max(duration, 1.0)))
+
     @rt.remote
     class Counter:
         def __init__(self):

@@ -150,6 +150,11 @@ class RawView:
                 pass
 
 
+# Coalesced small-frame writes flush once the per-tick buffer holds this
+# many bytes (bounds the latency/copy cost of the join for bursty ticks).
+COALESCE_MAX_BYTES = 256 * 1024
+
+
 def _frames(msgid: int, kind: int, method: str, value) -> list:
     """Encode one message as a list of wire buffers (header [+ payload
     chunks]), handed to ``writer.writelines`` verbatim — at most one
@@ -207,6 +212,12 @@ class Connection:
         self._closed = asyncio.Event()
         self._chaos = _Chaos()
         self._read_task: asyncio.Task | None = None
+        # small-frame coalescing: control frames queued in the same
+        # event-loop tick are flushed with ONE writelines (one transport
+        # join + one send syscall) instead of a syscall per message
+        self._wbuf: list = []
+        self._wbuf_bytes = 0
+        self._flush_scheduled = False
         # Set by RpcServer for inbound connections:
         self.server_handlers: dict[str, Callable] | None = None
         self.on_close: list[Callable[["Connection"], None]] = []
@@ -224,14 +235,61 @@ class Connection:
         except Exception:
             return None
 
+    # ------------------------------------------------- coalesced writes
+    def _send_frames(self, frames: list):
+        """Queue one encoded message for the wire. Small control frames
+        (single pre-joined buffer from _frames) coalesce into a per-tick
+        batch; multi-buffer messages (RAW / scatter-gather payloads) keep
+        the immediate writelines path — their buffers may alias shm
+        mappings whose pin lifetime is tied to the write (see RawView),
+        and they are exactly the frames big enough that batching buys
+        nothing."""
+        if len(frames) == 1:
+            buf = frames[0]
+            self._wbuf.append(buf)
+            self._wbuf_bytes += len(buf)
+            if self._wbuf_bytes >= COALESCE_MAX_BYTES:
+                self._flush_wbuf()
+            elif not self._flush_scheduled:
+                self._flush_scheduled = True
+                asyncio.get_running_loop().call_soon(self._flush_wbuf)
+            return
+        # large path: pending small frames first (wire order), then the
+        # scatter-gather chunk list verbatim
+        if self._wbuf:
+            self._flush_wbuf()
+        self.writer.writelines(frames)
+
+    def _flush_wbuf(self):
+        self._flush_scheduled = False
+        if not self._wbuf:
+            return
+        buf, self._wbuf = self._wbuf, []
+        self._wbuf_bytes = 0
+        if self.closed:
+            return  # pending futures already failed by _teardown
+        try:
+            self.writer.writelines(buf)
+        except Exception:
+            pass  # the read loop notices the dead transport
+
+    async def _maybe_drain(self):
+        """Back-pressure check: only await drain() once the transport's
+        buffer is past its high-water mark — the common small-frame case
+        never blocks (the reply/ack the caller awaits paces it)."""
+        try:
+            if self.writer.transport.get_write_buffer_size() > STREAM_LIMIT:
+                await self.writer.drain()
+        except (ConnectionError, OSError, AttributeError):
+            pass
+
     async def _read_loop(self):
         try:
             while True:
                 msgid, kind, method, payload, is_raw = \
                     await _read_frame(self.reader)
                 if kind == REQUEST:
-                    asyncio.ensure_future(self._handle_request(
-                        msgid, method, payload, is_raw))
+                    self._dispatch_request(msgid, method, payload, is_raw)
                 elif kind in (RESPONSE, ERROR):
                     fut = self._pending.pop(msgid, None)
                     if fut is not None and not fut.done():
@@ -268,6 +326,14 @@ class Connection:
     def _teardown(self):
         if self._closed.is_set():
             return
+        # last-gasp flush: messages buffered this tick (e.g. a notify
+        # right before close) still reach the transport, which flushes
+        # queued bytes before the FIN
+        if self._wbuf:
+            try:
+                self._flush_wbuf()
+            except Exception:
+                pass
         self._closed.set()
         for fut in self._pending.values():
             if not fut.done():
@@ -283,32 +349,39 @@ class Connection:
             except Exception:
                 traceback.print_exc()
 
-    async def _handle_request(self, msgid: int, method: str,
-                              payload, is_raw: bool = False):
-        handlers = self.server_handlers or {}
+    def _dispatch_request(self, msgid: int, method: str, payload,
+                          is_raw: bool):
+        """Run a request handler. Sync handlers returning a plain value
+        reply inline — no Task object, no scheduling round-trip; only
+        handlers that return an awaitable pay for a Task."""
+        result = None
+        try:
+            handler = (self.server_handlers or {}).get(method)
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            arg = payload if is_raw else deserialize(payload)
+            result = handler(self, arg)
+        except Exception as e:
+            self._reply(msgid, ERROR, method,
+                        (f"{type(e).__name__}: {e}", traceback.format_exc()))
+            return
+        if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+            asyncio.ensure_future(self._finish_request(msgid, method, result))
+            return
+        self._reply_result(msgid, method, result)
+
+    async def _finish_request(self, msgid: int, method: str, awaitable):
         result = None
         try:
             try:
-                handler = handlers.get(method)
-                if handler is None:
-                    raise RpcError(f"no handler for method {method!r}")
-                arg = payload if is_raw else deserialize(payload)
-                result = handler(self, arg)
-                if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
-                    result = await result
-                if self._chaos.should_drop():
-                    return  # drop the reply: client sees a timeout
-                out = _frames(msgid, RESPONSE, method, result)
+                result = await awaitable
             except Exception as e:
-                out = _frames(
-                    msgid, ERROR, method,
-                    (f"{type(e).__name__}: {e}", traceback.format_exc()),
-                )
-            try:
-                self.writer.writelines(out)
-                await self.writer.drain()
-            except (ConnectionError, OSError):
-                pass
+                self._reply(msgid, ERROR, method,
+                            (f"{type(e).__name__}: {e}",
+                             traceback.format_exc()))
+                return
+            self._reply_result(msgid, method, result)
+            await self._maybe_drain()
         finally:
             # after writelines the transport owns the bytes (pre-3.12 it
             # joins; on 3.12+ _frames materialized the view — see
@@ -317,31 +390,67 @@ class Connection:
             if isinstance(result, RawView):
                 result.done()
 
+    def _reply_result(self, msgid: int, method: str, result):
+        try:
+            try:
+                if self._chaos.should_drop():
+                    return  # drop the reply: client sees a timeout
+                self._reply(msgid, RESPONSE, method, result)
+            except Exception as e:
+                self._reply(msgid, ERROR, method,
+                            (f"{type(e).__name__}: {e}",
+                             traceback.format_exc()))
+        finally:
+            if isinstance(result, RawView):
+                result.done()
+
+    def _reply(self, msgid: int, kind: int, method: str, value):
+        if self.closed:
+            return
+        try:
+            self._send_frames(_frames(msgid, kind, method, value))
+        except (ConnectionError, OSError):
+            pass
+
     async def call(self, method: str, arg: Any = None, timeout: float | None = None) -> Any:
         if self.closed:
             raise ConnectionLost("connection closed")
         if timeout is None:
             timeout = get_config().rpc_request_timeout_s
+        loop = asyncio.get_running_loop()
         msgid = next(self._msgid)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: asyncio.Future = loop.create_future()
         self._pending[msgid] = fut
         if self._chaos.should_drop():
             pass  # drop the request on the floor: client sees a timeout
         else:
-            self.writer.writelines(_frames(msgid, REQUEST, method, arg))
-            await self.writer.drain()
+            self._send_frames(_frames(msgid, REQUEST, method, arg))
+            await self._maybe_drain()
+        # timeout via a plain timer handle on the reply future — cheaper
+        # than asyncio.wait_for's wrapper coroutine + waiter future per
+        # RPC (this is every control-plane round-trip's hot path)
+        timer = loop.call_later(timeout, self._expire_call, msgid, method,
+                                timeout)
         try:
-            return await asyncio.wait_for(fut, timeout)
-        except asyncio.TimeoutError:
+            return await fut
+        except asyncio.CancelledError:
             self._pending.pop(msgid, None)
-            raise RpcError(f"rpc {method!r} timed out after {timeout}s") from None
+            raise
+        finally:
+            timer.cancel()
+
+    def _expire_call(self, msgid: int, method: str, timeout: float):
+        fut = self._pending.pop(msgid, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(RpcError(
+                f"rpc {method!r} timed out after {timeout}s"))
 
     async def notify(self, method: str, arg: Any = None):
         """One-way message (used for pubsub pushes and fire-and-forget)."""
         if self.closed:
             raise ConnectionLost("connection closed")
-        self.writer.writelines(_frames(0, NOTIFY, method, arg))
-        await self.writer.drain()
+        self._send_frames(_frames(0, NOTIFY, method, arg))
+        await self._maybe_drain()
 
     def on_notify(self, method: str, handler: Callable[[Any], None]):
         self._notify_handlers[method] = handler

@@ -33,14 +33,49 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+class _NeedCloudpickle(Exception):
+    """The object graph needs cloudpickle's by-value semantics (a plain
+    pickle save_global would succeed but emit a reference the unpickling
+    worker cannot import)."""
+
+
+def _fast_payload_hazard(payload: bytes) -> bool:
+    """Did the C pickler emit a by-reference global that cloudpickle
+    would have shipped by value? save_global always writes the module
+    name as a verbatim string, so a stream free of ``__main__`` (and of
+    every ship_code_by_value-registered module name) cannot reference
+    them. False positives (a user STRING containing "__main__") merely
+    fall back to cloudpickle — slower, never wrong."""
+    if payload.find(b"__main__") != -1:
+        return True
+    for mod_name in _by_value_registered:
+        if payload.find(mod_name.encode()) != -1:
+            return True
+    return False
+
+
 def serialize(obj: Any) -> list[bytes | memoryview]:
     """Serialize to a list of chunks (zero-copy for out-of-band buffers).
 
     The caller concatenates (for sockets, writev-style) or copies into a
     single shm allocation.
+
+    Fast path: the stdlib C pickler (~5-10x cheaper per control message
+    than CloudPickler, byte-compatible with pickle.loads). Anything it
+    cannot pickle (lambdas, closures, dynamic classes) falls back to
+    cloudpickle, as does any stream that references __main__ or a
+    registered driver-local module (see _fast_payload_hazard).
     """
     buffers: list[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    try:
+        payload = pickle.dumps(obj, protocol=5,
+                               buffer_callback=buffers.append)
+        if _fast_payload_hazard(payload):
+            raise _NeedCloudpickle
+    except Exception:
+        buffers = []  # drop buffers extracted before the abort
+        payload = cloudpickle.dumps(obj, protocol=5,
+                                    buffer_callback=buffers.append)
     chunks: list[bytes | memoryview] = [
         _HEADER.pack(len(buffers), len(payload)),
         payload,

@@ -97,18 +97,25 @@ class TaskEventBuffer:
                           ts: float | None = None):
         """One lifecycle state transition (ref: TaskEventBuffer::
         RecordTaskStatusEvent). Near-free when task events are disabled —
-        the hot submit path pays one attribute check."""
+        the hot submit path pays one attribute check. Enabled, it
+        appends a COMPACT tuple; the wire dict materializes at drain
+        time (the 1s flush), keeping the per-submit cost to a deque
+        append."""
         if not self.enabled:
             return
-        self._append(make_transition(
-            task_id=task_id, name=name, kind=kind, state=state,
-            job_id=job_id, actor_id=actor_id, attempt=attempt,
-            worker=self.worker, node=self.node, error=error, ts=ts))
+        self._append(("t", task_id, name, kind, state, job_id, actor_id,
+                      attempt, error, time.time() if ts is None else ts))
 
     def drain(self) -> list[dict]:
         with self._lock:
-            out = list(self._events)
+            raw = list(self._events)
             self._events.clear()
+            out = [make_transition(
+                task_id=e[1], name=e[2], kind=e[3], state=e[4],
+                job_id=e[5], actor_id=e[6], attempt=e[7],
+                worker=self.worker, node=self.node, error=e[8],
+                ts=e[9]) if isinstance(e, tuple) else e
+                for e in raw]
             if self._dropped:
                 out.append({
                     "name": f"<dropped {self._dropped} events>",

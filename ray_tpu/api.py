@@ -44,11 +44,21 @@ class RemoteFunction:
         return RemoteFunction(self._fn, **merged)
 
     def _task_options(self) -> TaskOptions:
+        # options are immutable per RemoteFunction (options() returns a
+        # new instance), so the TaskOptions builds once, not per submit
+        cached = self.__dict__.get("_opts_cache")
+        if cached is not None:
+            return cached
         o = self._opts
         nr = o.get("num_returns", 1)
         if nr == "streaming":
             nr = -1  # streaming-generator sentinel (ObjectRefGenerator)
         o = dict(o, num_returns=nr)
+        self._opts_cache = out = self._build_task_options(o)
+        return out
+
+    @staticmethod
+    def _build_task_options(o: dict) -> TaskOptions:
         return TaskOptions(
             resources=_make_resources(
                 o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
@@ -102,14 +112,18 @@ class ActorMethod:
             else tensor_transport)
 
     def remote(self, *args, **kwargs):
-        nr = self._num_returns
-        if nr == "streaming":
-            nr = -1
-        opts = TaskOptions(num_returns=nr,
-                           max_retries=(self._handle._max_task_retries
-                                        if self._max_retries < 0
-                                        else self._max_retries),
-                           tensor_transport=self._tensor_transport)
+        opts = self.__dict__.get("_opts_cache")
+        if opts is None:
+            nr = self._num_returns
+            if nr == "streaming":
+                nr = -1
+            opts = self._opts_cache = TaskOptions(
+                num_returns=nr,
+                max_retries=(self._handle._max_task_retries
+                             if self._max_retries < 0
+                             else self._max_retries),
+                tensor_transport=self._tensor_transport)
+        nr = opts.num_returns
         refs = _core_worker().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs, opts)
         if nr == -1:
@@ -135,7 +149,11 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # cache the bound method handle: repeated `h.method.remote()`
+        # calls skip both this lookup and the per-call TaskOptions build
+        m = ActorMethod(self, name)
+        self.__dict__[name] = m
+        return m
 
     def __reduce__(self):
         return (ActorHandle,

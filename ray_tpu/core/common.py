@@ -25,6 +25,11 @@ class Address:
     def key(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def __reduce__(self):
+        # positional tuple: pickled on every TaskSpec/WorkerInfo on the
+        # wire; the default dataclass reduce ships field-name strings
+        return (Address, (self.host, self.port))
+
 
 @dataclasses.dataclass
 class ResourceSpec:
@@ -112,6 +117,24 @@ class TaskSpec:
     # W3C traceparent carrier (ref: _private/tracing _inject_tracing):
     # links the executing worker's OTel span to the submitter's trace.
     trace_ctx: dict | None = None
+    # Function-table id (core/function_table.py): when set, the code
+    # blob travels once per worker connection / via GCS KV instead of
+    # riding every spec; function_blob then only carries the piggybacked
+    # first-push copy (None on all later pushes).
+    function_id: str | None = None
+
+    def __reduce__(self):
+        # a spec crosses the wire on EVERY submit: a positional tuple
+        # (fields in declaration order) pickles ~2x smaller/faster than
+        # the default dataclass __dict__ with its per-field name strings
+        return (TaskSpec, (
+            self.task_id, self.job_id, self.name, self.function_blob,
+            self.args, self.kwargs, self.num_returns, self.resources,
+            self.owner, self.max_retries, self.retry_exceptions,
+            self.attempt, self.actor_id, self.method_name, self.seq_no,
+            self.is_actor_creation, self.actor_options,
+            self.scheduling_strategy, self.runtime_env,
+            self.tensor_transport, self.trace_ctx, self.function_id))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,6 +142,14 @@ class WorkerInfo:
     worker_id: WorkerID
     node_id: NodeID
     address: Address                 # the worker's own RPC server
+    # direct-call endpoint (core/direct.py): 0 = none (driver processes,
+    # pre-upgrade workers). Owners push eligible tasks here, skipping
+    # the asyncio stack on both sides of the round-trip.
+    direct_port: int = 0
+
+    def __reduce__(self):
+        return (WorkerInfo, (self.worker_id, self.node_id, self.address,
+                             self.direct_port))
 
 
 @dataclasses.dataclass

@@ -58,21 +58,33 @@ logger = setup_logger("core_worker")
 
 _TASK_PUSH_TIMEOUT = 7 * 24 * 3600.0
 
+# Hot-path modules resolved ONCE at import: the submit path used to pay a
+# try/except import of builtin_metrics and an otel import per task
+# submission. Telemetry stays optional — a stripped build leaves _bm None
+# and every use is guarded.
+from ray_tpu._internal import otel as _otel
 
-def _dumps_code(fn) -> bytes:
-    from ray_tpu._internal.serialization import dumps_code
-
-    return dumps_code(fn)
+try:
+    from ray_tpu.util import builtin_metrics as _bm
+except Exception:  # pragma: no cover - stripped/minimal builds
+    _bm = None
 
 
 def _trace_carrier():
     """Active OTel span context for TaskSpec.trace_ctx (None when
     tracing is off — the common, zero-overhead case)."""
-    from ray_tpu._internal import otel
-
-    if not otel.tracing_enabled():
+    if not _otel.tracing_enabled():
         return None
-    return otel.current_context_carrier()
+    return _otel.current_context_carrier()
+
+
+def _dumps_code_now(fn) -> bytes:
+    """Uncached code pickle — only for specs that bypass the function
+    table (runtime_env tasks, whose code loads under the materialized
+    env on every execution)."""
+    from ray_tpu._internal.serialization import dumps_code
+
+    return dumps_code(fn)
 
 
 @dataclass
@@ -90,23 +102,173 @@ class _PendingTask:
     done: bool = False
     cancelled: bool = False
     running_on: Any = None     # WorkerInfo while pushed to a worker
-    lease_waiter: Any = None   # (pool, fut) while queued for a lease
+    t_sched: float | None = None  # submit time until the first grant
 
 
 @dataclass
 class _LeasePool:
     """Per-scheduling-key lease pipeline state (ref analog: the
     per-SchedulingKey entry in normal_task_submitter.h:108): tasks
-    waiting for a worker, idle leased workers kept warm, and the number
-    of outstanding lease requests against the cluster."""
+    parked for a worker, idle leased workers kept warm, and the number
+    of leases expected from in-flight (batched) requests against the
+    cluster. ``queue`` holds ready-to-push (spec, pt, strategy) entries;
+    it is a deque because BOTH the IO loop (on lease grant) and direct
+    reader threads (chaining the next task onto a just-freed lease,
+    with no loop round-trip) claim from it — a pop IS the claim, and
+    deque ops are atomic under the GIL. Cancelled entries are skipped
+    at claim time (pt.done is set by the cancel path). ``fetches``
+    counts in-flight RPCs: batched pools keep at most two outstanding
+    (one possibly queued at a saturated node manager, one sized to the
+    tasks that arrived since), so a burst of N submits costs
+    O(N / batch) round-trips, not N."""
     idle: list = field(default_factory=list)       # [(winfo, token, nm_addr)]
-    waiters: list = field(default_factory=list)    # [Future]
-    inflight: int = 0
+    queue: collections.deque = field(default_factory=collections.deque)
+    inflight: int = 0                              # leases in-flight
+    fetches: int = 0                               # RPCs in-flight
+    # guards idle: claimed by submitting user threads AND the loop (the
+    # idle-expiry sweep must not race a concurrent claim)
+    idle_lock: threading.Lock = field(default_factory=threading.Lock)
+    # one armed fetch-check ring per pool: a submit burst parks tasks
+    # without waking the loop per task; the single armed request's
+    # _maybe_fetch_leases sees every entry parked before it ran
+    fetch_armed: bool = False
 
 
 class _ExecutionContext(threading.local):
     task_id: TaskID | None = None
     job_id: JobID | None = None     # owning job of the executing task
+
+
+# sentinel returned by the direct-path actor dispatch: "exec mutex is
+# held, run the body inline on the calling connection thread"
+_INLINE = object()
+
+
+def _push_strategy(spec: TaskSpec):
+    """Scheduling strategy as the lease pools see it (PG strategies were
+    already rewritten into bundle-reserved demand at submit)."""
+    strat = spec.scheduling_strategy
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        return None
+    return strat
+
+
+class _LeaseChain:
+    """Shared in-flight accounting for one leased worker running a
+    pipeline of direct pushes. The lease is disposed of exactly once —
+    by whichever completion/error callback decrements ``inflight`` to
+    zero with nothing left to chain; ``disposed`` is set under the same
+    lock hold so a racing fill (e.g. the dispatching thread between its
+    send and its pipeline top-up) can never push onto a lease already
+    queued for return."""
+
+    __slots__ = ("inflight", "disposed", "lock")
+
+    # tasks kept in flight per lease under burst pressure: the worker's
+    # next request is already in its socket buffer when it finishes the
+    # current one, so neither side blocks (nor pays a wake) between
+    # tasks of a wave
+    DEPTH = 2
+
+    def __init__(self):
+        self.inflight = 0
+        self.disposed = False
+        self.lock = threading.Lock()
+
+    def acquire_one(self) -> bool:
+        """Claim a pipeline slot; False once the chain is disposed (the
+        caller must not push on this lease)."""
+        with self.lock:
+            if self.disposed:
+                return False
+            self.inflight += 1
+            return True
+
+    def release_one(self) -> bool:
+        """Decrement; True (exactly once per chain) when this drop hit
+        zero — the caller owns lease disposal."""
+        with self.lock:
+            self.inflight -= 1
+            if self.inflight == 0 and not self.disposed:
+                self.disposed = True
+                return True
+            return False
+
+    def try_dispose(self) -> bool:
+        """Dispose if idle: True (exactly once per chain) when nothing
+        is in flight and no one disposed yet."""
+        with self.lock:
+            if self.inflight == 0 and not self.disposed:
+                self.disposed = True
+                return True
+            return False
+
+
+# pipeline past one in-flight push only when at least this many tasks
+# are parked: below it, a stolen second task could have run in parallel
+# on a lease grant that is still in flight (see _fill_chain)
+_PIPELINE_MIN_QUEUE = 32
+
+
+class _SeqGate:
+    """Per-caller actor-task ordering gate, usable from BOTH the asyncio
+    handler (loop thread, non-blocking try_enter + rare 1ms poll) and
+    direct-call connection threads (blocking enter). Dispatch runs UNDER
+    the gate lock so the executor queue order equals seq order — with
+    preemptible threads, advancing the gate and submitting must be one
+    atomic step or two racing calls could start out of order.
+
+    Semantics mirror the old asyncio Condition logic: a call may start
+    once ``next >= seq``; only the exact ``next == seq`` call advances
+    the gate (stale seqs from a previous incarnation pass through)."""
+
+    __slots__ = ("next", "cond")
+
+    def __init__(self):
+        self.next = 0
+        self.cond = threading.Condition()
+
+    def try_enter(self, seq: int, dispatch):
+        """Non-blocking: (True, dispatch()) if `seq` may start now.
+        Non-blocking on the GATE LOCK too — a direct-call thread may
+        hold it while waiting for the exec mutex (its dispatch claims
+        the mutex under the lock for start-ordering), and this form
+        runs on the worker's IO loop, which must never park behind a
+        running task body. The caller already polls on False."""
+        if not self.cond.acquire(blocking=False):
+            return False, None
+        try:
+            if self.next < seq:
+                return False, None
+            if self.next == seq:
+                self.next = seq + 1
+                try:
+                    out = dispatch()
+                finally:
+                    # notify even when dispatch raises (teardown races:
+                    # closed actor loop, shut-down executor) — the gate
+                    # HAS advanced, so parked successors must recheck
+                    # or they wait forever on a true predicate
+                    self.cond.notify_all()
+                return True, out
+            return True, dispatch()
+        finally:
+            self.cond.release()
+
+    def enter(self, seq: int, dispatch):
+        """Blocking form for direct-call threads."""
+        with self.cond:
+            while self.next < seq:
+                self.cond.wait()
+            if self.next == seq:
+                self.next = seq + 1
+                try:
+                    out = dispatch()
+                finally:
+                    self.cond.notify_all()  # see try_enter: exceptions
+                    # must not strand successors behind an advanced gate
+                return out
+            return dispatch()
 
 
 class _ShmGetPin:
@@ -220,7 +382,27 @@ class CoreWorker:
         self._node_addrs: dict[NodeID, Address] = {}
         self._dead_nodes: set[NodeID] = set()
         self._lease_cache: dict[tuple, _LeasePool] = {}
+        self.lease_rpcs_sent = 0   # request_lease round-trips (perf hook)
         self._actor_submitters: dict[ActorID, _ActorTaskSubmitter] = {}
+        # function table (core/function_table.py): owner side hashes +
+        # publishes code once per (function, job); worker side caches
+        # loaded code by id with a KV-backed miss path
+        from ray_tpu.core.function_table import FunctionCache, FunctionTable
+
+        self.fn_table = FunctionTable()
+        self.fn_cache = FunctionCache(get_config().fn_cache_size)
+        # sync fast-lane waiters: return-object id -> threading.Event set
+        # by a direct-actor reader thread when the result lands
+        self._sync_waiters: dict[ObjectID, threading.Event] = {}
+        # serializes _complete_task/_fail_task terminal bookkeeping across
+        # the IO loop and direct-actor reader threads
+        self._completion_lock = threading.RLock()
+        # worker-wide execution mutex: serializes sync task/actor bodies
+        # across ALL execution paths (the max_workers=1 executor, and
+        # direct-channel connection threads running bodies inline).
+        # RLock: the inline dispatch pre-acquires it under the seq-gate
+        # lock for start-ordering, then the body re-acquires it.
+        self._exec_mutex = threading.RLock()
         # worker-mode execution state
         self.executor = ThreadPoolExecutor(max_workers=1,
                                            thread_name_prefix="rayt-exec")
@@ -229,7 +411,21 @@ class CoreWorker:
         self.actor_instance = None
         self.actor_id: ActorID | None = None
         self._actor_async_loop: EventLoopThread | None = None
-        self._actor_seq_state: dict[str, dict] = {}
+        self._actor_gates: dict[str, _SeqGate] = {}
+        # direct-call plane (core/direct.py): server on workers, client
+        # cache on owners
+        self._direct_server = None
+        self._direct_clients: dict[tuple, Any] = {}
+        self._direct_lock = threading.Lock()
+        # reader-less direct clients for the sync fast lane: the GETTER
+        # thread pumps replies itself (direct.DirectClient.drive)
+        self._sync_direct_clients: dict[tuple, Any] = {}
+        # ObjectID -> sync-mode client owing its completion; getters use
+        # it to route their wait into drive() instead of an event park
+        self._sync_read_owners: dict[ObjectID, Any] = {}
+        # method name -> is-async (worker side; instance methods are
+        # fixed for the worker's lifetime)
+        self._method_kind: dict[str, bool] = {}
         self._shutdown = False
         # approximate in-flight count backing the queue-depth gauge
         # (racy += is fine for telemetry; never used for control flow)
@@ -241,6 +437,17 @@ class CoreWorker:
         # mask a real hang. _closing gates late spawns during the sweep.
         self._bg_tasks: set[asyncio.Task] = set()
         self._closing = False
+        # batched loop wakeups for _spawn_from_thread (see its docstring)
+        self._spawn_queue: collections.deque = collections.deque()
+        self._spawn_wake_lock = threading.Lock()
+        self._spawn_wake_pending = False
+        # leases finished by direct-channel reader threads, parked here
+        # for loop-side recycling (pool structures are loop-affine);
+        # entries: (demand, winfo, token, nm_addr, strategy, reusable)
+        self._lease_returns: collections.deque = collections.deque()
+        # lease-fetch checks requested by user-thread submits, drained
+        # by the loop; entries: (key, demand, pool, strategy)
+        self._fetch_requests: collections.deque = collections.deque()
         self.gcs: GcsClient | None = None
         self.node_conn: Connection | None = None
         self.worker_info: WorkerInfo | None = None
@@ -250,6 +457,29 @@ class CoreWorker:
 
         self.task_events = TaskEventBuffer(self.worker_id.hex(),
                                            self.node_id.hex())
+        # pre-bound metric handles (tag merge + key sort paid once, not
+        # per task); None when telemetry is unavailable
+        self._m_submitted = self._m_queue_depth = None
+        self._m_finished = self._m_sched_lat = self._m_exec_lat = None
+        if _bm is not None:
+            try:
+                owner = {"owner": self.worker_id.hex()[:12]}
+                self._m_submitted = _bm.tasks_submitted.with_tags()
+                self._m_queue_depth = _bm.task_queue_depth.with_tags(owner)
+                self._m_finished = {
+                    "ok": _bm.tasks_finished.with_tags({"status": "ok"}),
+                    "error": _bm.tasks_finished.with_tags(
+                        {"status": "error"}),
+                }
+                self._m_sched_lat = _bm.task_sched_latency.with_tags()
+                self._m_exec_lat = {
+                    "task": _bm.task_exec_latency.with_tags(
+                        {"kind": "task"}),
+                    "actor": _bm.task_exec_latency.with_tags(
+                        {"kind": "actor"}),
+                }
+            except Exception:
+                pass
 
     def _emit_task_event(self, spec: TaskSpec, state: str, *,
                          error: dict | None = None):
@@ -289,17 +519,84 @@ class CoreWorker:
     def _spawn_from_thread(self, coro) -> None:
         """Thread-safe fire-and-forget onto the IO loop, shutdown-tracked
         (the raw io.spawn future is untracked — fine only when the caller
-        awaits it)."""
+        awaits it). Wakeups are batched: a submit burst from the user
+        thread queues its coroutines and rings the loop's self-pipe ONCE
+        per drain, not once per submission (each call_soon_threadsafe
+        wakeup costs a syscall + a GIL handoff on small hosts)."""
         if self._closing:
             # io.stop() halts the loop without closing it, so a
             # post-shutdown call_soon_threadsafe would "succeed" and the
             # callback never run, leaking a never-awaited coroutine
             coro.close()
             return
+        self._spawn_queue.append(coro)
+        self._ring_loop()
+
+    def _drain_spawn_queue(self):
+        """Runs on the IO loop: start every queued coroutine. The wake
+        flag clears FIRST so a concurrent append re-arms the wakeup (it
+        may also be drained right here — an extra no-op drain is
+        harmless)."""
+        with self._spawn_wake_lock:
+            self._spawn_wake_pending = False
+        self._drain_lease_returns()
+        while True:
+            try:
+                key, demand, pool, strat = self._fetch_requests.popleft()
+            except IndexError:
+                break
+            pool.fetch_armed = False
+            self._maybe_fetch_leases(key, demand, pool, strat)
+        while True:
+            try:
+                coro = self._spawn_queue.popleft()
+            except IndexError:
+                break
+            self._spawn(coro)
+
+    def _ring_loop(self):
+        """Schedule one batched _drain_spawn_queue on the IO loop
+        (thread-safe, at most one wakeup outstanding)."""
+        with self._spawn_wake_lock:
+            if self._spawn_wake_pending:
+                return
+            self._spawn_wake_pending = True
         try:
-            self.io.loop.call_soon_threadsafe(self._spawn, coro)
-        except RuntimeError:  # loop already closed
-            coro.close()
+            self.io.loop.call_soon_threadsafe(self._drain_spawn_queue)
+        except RuntimeError:  # loop already closed (shutdown tail)
+            with self._spawn_wake_lock:
+                self._spawn_wake_pending = False
+            while True:  # close queued coros: avoid never-awaited leaks
+                try:
+                    self._spawn_queue.popleft().close()
+                except IndexError:
+                    break
+
+    def _queue_lease_return(self, demand, winfo, token, nm_addr, strategy,
+                            reusable: bool):
+        """Reader-thread side of lease recycling: park the finished
+        lease and ring the loop once per batch (the next submit's spawn
+        drain also picks these up, so a busy pipeline recycles leases
+        without a dedicated wakeup)."""
+        self._lease_returns.append(
+            (demand, winfo, token, nm_addr, strategy, reusable))
+        self._ring_loop()
+
+    def _drain_lease_returns(self):
+        """Loop side: recycle or release every lease parked by direct
+        reader threads."""
+        while True:
+            try:
+                demand, winfo, token, nm_addr, strategy, reusable = \
+                    self._lease_returns.popleft()
+            except IndexError:
+                return
+            if reusable and not self._shutdown:
+                self._recycle_lease(demand, winfo, token, nm_addr,
+                                    strategy)
+            else:
+                self._spawn(self._release_lease(winfo, token, nm_addr,
+                                                reusable=False))
 
     # ------------------------------------------------------------ bootstrap
     def connect_cluster(self):
@@ -309,8 +606,18 @@ class CoreWorker:
     async def _async_connect(self):
         host = "127.0.0.1"
         port = await self.server.start(host, 0)
+        direct_port = 0
+        if self.mode == "worker":
+            from ray_tpu.core.direct import DirectServer
+
+            self._direct_server = DirectServer({
+                "push_task": self._direct_push_task,
+                "push_actor_task": self._direct_push_actor_task,
+            })
+            direct_port = self._direct_server.port
         self.worker_info = WorkerInfo(self.worker_id, self.node_id,
-                                      Address(host, port))
+                                      Address(host, port),
+                                      direct_port=direct_port)
         self.gcs = await GcsClient.connect(self.gcs_address)
         self.node_conn = await connect(self.node_address.host,
                                        self.node_address.port)
@@ -376,6 +683,15 @@ class CoreWorker:
                                           reusable=False)
             pool.idle.clear()
         self._lease_cache.clear()
+        for cache in (self._direct_clients, self._sync_direct_clients):
+            for dc in cache.values():
+                try:
+                    dc.close()
+                except Exception:
+                    pass
+            cache.clear()
+        if self._direct_server is not None:
+            self._direct_server.close()
         for conn in self._conns.values():
             await conn.close()
         if self.gcs is not None:
@@ -436,9 +752,14 @@ class CoreWorker:
         keep their own slots, so the mapping stays pinned until they die
         too). This runs from ObjectRef.__del__ — i.e. potentially inside
         a GC triggered ANYWHERE, including while this very thread holds
-        the pin or store locks — so it must only append + try-drain."""
-        self._pin_events.append(oid)
-        self._drain_pin_events()
+        the pin or store locks — so it must only append + try-drain.
+        Fast exit when no zero-copy pins exist at all (the common case
+        for inline-result workloads): a registration racing this check
+        reclaims its own orphan slot (see _load_shm_value)."""
+        if self._shm_pins:
+            self._pin_events.append(oid)
+        if self._pin_events:
+            self._drain_pin_events()
 
     def _drain_pin_events(self):
         """Process queued pin-slot deaths and release store get-refs.
@@ -650,15 +971,45 @@ class CoreWorker:
         self._signal_object_ready(oid)
 
     def _signal_object_ready(self, oid: ObjectID):
+        # no registered async waiter (the common case: getters either
+        # haven't arrived or wait on sync events): skip the loop hop.
+        # Safe against the register race — _wait_object_event re-checks
+        # readiness AFTER registering its event.
+        if oid not in self._object_events:
+            return
+
         def _set():
             ev = self._object_events.pop(oid, None)
             if ev is not None:
                 ev.set()
-        self.io.loop.call_soon_threadsafe(_set)
+        # on the IO loop already (task completion path): set inline —
+        # call_soon_threadsafe from the loop thread still writes the
+        # self-pipe, a syscall + handle per object
+        if asyncio._get_running_loop() is self.io.loop:
+            _set()
+        else:
+            self.io.loop.call_soon_threadsafe(_set)
+
+    def _wake_sync_waiter(self, oid: ObjectID):
+        """Release a caller-thread getter parked on a direct fast-lane
+        result (every completion path funnels here, so a task that
+        failed over from the direct channel to the asyncio path still
+        wakes its original getter)."""
+        if self._sync_waiters:
+            ev = self._sync_waiters.pop(oid, None)
+            if ev is not None:
+                ev.set()
 
     # ---------------------------------------------------------------- get
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list:
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Fast path: every ref is already resolved in the local memory
+        # store (completed inline results — the common case right after a
+        # burst completes or a fast-lane actor call returns). No IO-loop
+        # hop, no coroutine machinery.
+        out = self._get_local_fast(refs, deadline)
+        if out is not None:
+            return out
 
         async def _get_all():
             return await asyncio.gather(
@@ -676,6 +1027,88 @@ class CoreWorker:
                 raise v
             out.append(v)
         return out
+
+    def _get_local_fast(self, refs: list[ObjectRef],
+                        deadline: float | None) -> list | None:
+        """Resolve gets without touching the IO loop: memory-store hits
+        return immediately; a ref whose result is about to arrive on a
+        direct fast lane blocks on the reader thread's event (one
+        condvar wake, no loop round-trip). Resolution runs in REVERSE
+        list order: tasks chained onto one lease complete FIFO, so
+        blocking on the last ref first means the earlier ones are
+        memory hits by the time it fires — one wake per wave instead of
+        one per ref. None => take the async path."""
+        out: list = [None] * len(refs)
+        for i in range(len(refs) - 1, -1, -1):
+            ref = refs[i]
+            obj = self.memory_store.get_if_exists(ref.id)
+            if obj is None and ref.id in self._sync_read_owners:
+                # sync-lane result: THIS thread pumps the sockets — the
+                # reply (and any completion queued before it, on any
+                # sync client) dispatches here, no reader-thread wake
+                self._drive_sync_replies(ref.id, deadline)
+                obj = self.memory_store.get_if_exists(ref.id)
+            if obj is None:
+                ev = self._sync_waiters.get(ref.id)
+                if ev is None:
+                    return None
+                budget = (None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
+                if not ev.wait(budget):
+                    raise GetTimeoutError(f"get({ref.id}) timed out")
+                obj = self.memory_store.get_if_exists(ref.id)
+                if obj is None:
+                    return None  # completed into shm/device: slow path
+            out[i] = obj
+        # exceptions raise in list order, independent of resolve order
+        for obj in out:
+            if obj.is_exception:
+                raise obj.value
+        return [obj.value for obj in out]
+
+    def _drive_sync_replies(self, oid: ObjectID,
+                            deadline: float | None) -> bool:
+        """Pump EVERY sync-mode direct client until `oid`'s completion
+        dispatched (True) or the deadline passed / another thread owns
+        all the pumping (False — the caller parks on the oid's event;
+        the other pump or the reaper completes it). Pumping all clients
+        at once matters: a reply on client B can depend on a completion
+        sitting unread on client A (a worker resolving its args asks
+        this owner for an object whose completion we haven't read)."""
+        import select
+
+        while oid in self._sync_read_owners:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                slice_s = min(remaining, 1.0)
+            else:
+                slice_s = 1.0
+            claimed = []
+            for c in list(self._sync_direct_clients.values()):
+                if c.closed or not c._pending:
+                    continue
+                if c.read_lock.acquire(blocking=False):
+                    claimed.append(c)
+            if not claimed:
+                return False  # a concurrent getter pumps everything
+            dispatch: list = []
+            try:
+                try:
+                    ready, _, _ = select.select(
+                        [c.sock for c in claimed], [], [], slice_s)
+                except (OSError, ValueError):
+                    ready = []  # a socket died: read_available handles
+                for c in claimed:
+                    if c.sock in ready:
+                        dispatch.append((c, c.read_available()))
+            finally:
+                for c in claimed:
+                    c.read_lock.release()
+            for c, msgs in dispatch:
+                c.dispatch_all(msgs)
+        return True
 
     def _load_shm_value(self, ref: ObjectRef, oid: ObjectID, size: int,
                         deadline: float | None):
@@ -968,10 +1401,12 @@ class CoreWorker:
                 self.object_meta.get(oid) is not None
                 and not self._is_pending(oid)):
             return True
+        if deadline is None:
+            await ev.wait()  # no wait_for: saves a Task + timer per ref
+            return True
         try:
-            budget = None if deadline is None else max(
-                0.0, deadline - time.monotonic())
-            await asyncio.wait_for(ev.wait(), budget)
+            await asyncio.wait_for(
+                ev.wait(), max(0.0, deadline - time.monotonic()))
             return True
         except asyncio.TimeoutError:
             return False
@@ -1139,32 +1574,49 @@ class CoreWorker:
         if options.num_returns == -1:
             # retrying a partially-consumed stream would replay items
             max_retries = 0
+        runtime_env = self._package_runtime_env(options.runtime_env)
+        # Function table: hash/serialize the code once per (function,
+        # job); the spec carries only the id and the blob rides the first
+        # push per worker connection (_run_normal_task) with GCS KV as
+        # the miss path. runtime_env tasks bypass the table — their code
+        # must be (re)loaded under the materialized env every execution.
+        if runtime_env is None:
+            fid, blob = self.fn_table.register(function, self.job_id)
+            self._publish_code_blob(fid, blob)
+            function_blob = None
+        else:
+            fid, function_blob = None, _dumps_code_now(function)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=options.name or getattr(function, "__name__", "task"),
-            function_blob=_dumps_code(function),
+            function_blob=function_blob, function_id=fid,
             args=spec_args, kwargs=spec_kwargs,
             num_returns=options.num_returns,
             resources=self._demand_for(options),
             owner=self.worker_info, max_retries=max_retries,
             retry_exceptions=options.retry_exceptions,
             scheduling_strategy=options.scheduling_strategy,
-            runtime_env=self._package_runtime_env(options.runtime_env),
+            runtime_env=runtime_env,
             tensor_transport=options.tensor_transport,
             trace_ctx=_trace_carrier())
         refs = self._register_task(spec, pinned + pinned_kw)
         self._emit_task_event(spec, "PENDING_ARGS")
-        try:
-            from ray_tpu.util import builtin_metrics as _bm
-
-            self._inflight_tasks += 1
-            _bm.tasks_submitted.inc()
-            _bm.task_queue_depth.set(
-                float(self._inflight_tasks),
-                tags={"owner": self.worker_id.hex()[:12]})
-        except Exception:
-            pass  # telemetry must never fail a submission
-        self._spawn_from_thread(self._run_normal_task(spec))
+        if self._m_submitted is not None:
+            try:
+                self._inflight_tasks += 1
+                self._m_submitted.inc()
+                self._m_queue_depth.set(float(self._inflight_tasks))
+            except Exception:
+                pass  # telemetry must never fail a submission
+        # dispatch-or-park ON THIS THREAD: an idle cached lease is
+        # claimed and the push goes out with no loop involvement at all;
+        # otherwise the task parks in the pool's claim queue (where a
+        # reader-thread chain or the loop's grant path picks it up) and
+        # the loop is woken at most once per pool to top up lease
+        # fetches — a submit burst costs O(1) wakeups, not O(N)
+        pt = self.pending_tasks[spec.task_id]
+        pt.t_sched = time.perf_counter()
+        self._submit_normal_task(spec, pt, _push_strategy(spec))
         if spec.num_returns == -1:
             from ray_tpu.core.streaming import ObjectRefGenerator
 
@@ -1286,8 +1738,94 @@ class CoreWorker:
         for i in range(spec.num_returns):
             oid = ObjectID.for_return(spec.task_id, i)
             self._return_to_task[oid] = spec.task_id
+            # every return gets a sync-waiter event at registration:
+            # getters park on one condvar wake instead of spinning up
+            # an asyncio task per ref (_get_local_fast), regardless of
+            # which path — direct or asyncio — completes the task
+            self._sync_waiters[oid] = threading.Event()
             refs.append(ObjectRef(oid, self.worker_info))
         return refs
+
+    # ------------------------------------------------------ function table
+    def _publish_code_blob(self, fid: str, blob: bytes,
+                           sync: bool = False):
+        """Publish a function-table blob to GCS KV exactly once per id.
+        Background for task submission (the piggybacked first-push copy
+        covers the window); synchronous for actor creation, whose spec
+        reaches the executing worker via the GCS with no piggyback
+        opportunity."""
+        from ray_tpu.core.function_table import KV_NAMESPACE
+
+        if not self.fn_table.needs_kv_push(fid):
+            return
+        if sync:
+            try:
+                self.io.run(self.gcs.kv_put(fid, blob,
+                                            namespace=KV_NAMESPACE))
+            except Exception:
+                self.fn_table.kv_push_failed(fid)
+                raise
+            return
+
+        async def _put():
+            try:
+                await self.gcs.kv_put(fid, blob, namespace=KV_NAMESPACE)
+            except Exception:
+                self.fn_table.kv_push_failed(fid)
+        self._spawn_from_thread(_put())
+
+    def _attach_code_blob_set(self, spec: TaskSpec, sent: set):
+        """Piggyback the code blob on the FIRST push of this function id
+        over a connection (`sent` is that connection's pushed-id set);
+        every later push on the same connection sends only the id. Must
+        run right before the send — frame encoding happens synchronously
+        inside it, so wire order matches this bookkeeping even across
+        concurrent pushes. (A marked-but-never-delivered blob — send
+        raced a connection loss — self-heals through the worker's GCS KV
+        miss path.)"""
+        if spec.function_id is None:
+            return
+        if spec.function_id in sent:
+            spec.function_blob = None
+        else:
+            sent.add(spec.function_id)
+            spec.function_blob = self.fn_table.blob_for(spec.function_id)
+
+    def _fetch_code_blob(self, fid: str) -> bytes | None:
+        """KV miss path (worker side, executor thread): the owner's
+        background publish usually races only the first milliseconds of
+        a job, but a multi-hundred-KB blob's kv_put on a loaded host
+        can lag — keep retrying for a few seconds before failing the
+        task."""
+        from ray_tpu.core.function_table import KV_NAMESPACE
+
+        for delay in (0.0, 0.05, 0.2, 0.5, 1.0, 1.5, 2.0):
+            if delay:
+                time.sleep(delay)
+            try:
+                blob = self.io.run(self.gcs.kv_get(
+                    fid, namespace=KV_NAMESPACE), timeout=30)
+            except Exception:
+                blob = None
+            if blob is not None:
+                return blob
+        return None
+
+    def _resolve_function(self, spec: TaskSpec):
+        """Loaded code for a spec: piggybacked/staged blob, worker LRU,
+        or the GCS KV fallback (spillback/retry onto a fresh worker,
+        LRU-evicted entries)."""
+        if spec.function_id is None:
+            return cloudpickle.loads(spec.function_blob)
+        if spec.function_blob is not None:
+            self.fn_cache.stage_blob(spec.function_id, spec.function_blob)
+        return self.fn_cache.resolve(spec.function_id, spec.job_id.hex(),
+                                     self._fetch_code_blob)
+
+    def rpc_evict_job_code(self, conn, job_hex: str):
+        """Job-scoped cache eviction: pooled workers outlive jobs."""
+        self.fn_cache.evict_job(job_hex)
+        return True
 
     # --- lease management (ref: normal_task_submitter lease reuse) ---
     def _lease_key(self, demand: dict[str, float], strategy=None) -> tuple:
@@ -1314,78 +1852,166 @@ class CoreWorker:
             self._lease_cache[key] = pool
         return pool
 
-    async def _acquire_lease(self, demand: dict[str, float], strategy=None,
-                             pt: "_PendingTask | None" = None):
-        """Get a leased worker for `demand`: reuse an idle cached lease if
-        one exists, otherwise queue as a waiter and make sure enough lease
-        fetches are in flight (ref: normal_task_submitter.cc:291 — one
-        scheduling-key pipeline, workers handed task-to-task without a
-        raylet round-trip). `pt` registers the waiter for withdrawal on
-        cancel (a cancelled queued task must stop competing for capacity)."""
-        key = self._lease_key(demand, strategy)
+    def _submit_normal_task(self, spec: TaskSpec, pt: "_PendingTask",
+                            strat) -> None:
+        """Dispatch or park one ready normal task (any thread): take an
+        idle cached lease if one exists, otherwise park in the pool's
+        claim queue and make sure enough lease fetches are in flight
+        (ref: normal_task_submitter.cc:291 — one scheduling-key
+        pipeline, workers handed task-to-task without a raylet
+        round-trip)."""
+        if pt.cancelled or pt.done:
+            return
+        key = self._lease_key(spec.resources, strat)
         pool = self._lease_pool_for(key)
         if pool.idle:
-            entry = pool.idle.pop()
-            return entry[0], entry[1], entry[2]
-        fut = asyncio.get_running_loop().create_future()
-        pool.waiters.append(fut)
-        if pt is not None:
-            pt.lease_waiter = (pool, fut)
-        if pool.inflight < len(pool.waiters):
-            pool.inflight += 1
-            self._spawn(
-                self._fetch_lease(key, demand, pool, strategy))
-        try:
-            entry = await fut
-        finally:
-            if pt is not None:
-                pt.lease_waiter = None
-        return entry[0], entry[1], entry[2]
+            with pool.idle_lock:
+                entry = pool.idle.pop() if pool.idle else None
+            if entry is not None:
+                self._dispatch_leased(spec, pt, strat,
+                                      (entry[0], entry[1], entry[2]))
+                return
+        pool.queue.append((spec, pt, strat))
+        if asyncio._get_running_loop() is self.io.loop:
+            self._maybe_fetch_leases(key, spec.resources, pool, strat)
+        elif not pool.fetch_armed:
+            pool.fetch_armed = True
+            self._fetch_requests.append((key, spec.resources, pool,
+                                         strat))
+            self._ring_loop()
+
+    def _dispatch_leased(self, spec: TaskSpec, pt: "_PendingTask", strat,
+                         entry) -> None:
+        """Push one task onto a granted lease. Runs on the IO loop (the
+        grant path) or a submitting user thread (idle-lease claim) — the
+        reader-thread chaining path pushes via _direct_push_normal
+        directly and never enters here."""
+        winfo, token, nm_addr = entry
+        if pt.cancelled or pt.done:
+            # cancelled while parked: returns were already failed by
+            # cancel_task; just hand the lease back (SPREAD releases —
+            # recycling would bypass the node manager's round-robin)
+            self._queue_lease_return(spec.resources, winfo, token,
+                                     nm_addr, strat, strat != "SPREAD")
+            return
+        spec.attempt = spec.max_retries - pt.retries_left
+        self._emit_task_event(spec, "SCHEDULED")
+        if pt.t_sched is not None:  # first grant only, not retries
+            self._observe_sched_latency(time.perf_counter() - pt.t_sched)
+            pt.t_sched = None
+        pt.running_on = winfo
+        self._emit_task_event(spec, "DISPATCHED")
+        chain = _LeaseChain()
+        if self._direct_push_normal(spec, pt, winfo, token, nm_addr,
+                                    strat, chain):
+            # the direct reader thread owns this attempt; keep a second
+            # task in flight on the lease (pipeline fill)
+            if strat != "SPREAD":
+                key = self._lease_key(spec.resources, strat)
+                self._fill_chain(key, chain, spec.resources, winfo,
+                                 token, nm_addr, strat)
+            return
+        coro = self._push_via_loop(spec, pt, strat, winfo, token, nm_addr)
+        if asyncio._get_running_loop() is self.io.loop:
+            self._spawn(coro)
+        else:
+            self._spawn_from_thread(coro)
+
+    def _resubmit(self, spec: TaskSpec, pt: "_PendingTask", strat) -> None:
+        """Retry re-entry (loop side): a crashed/errored attempt goes
+        back through dispatch-or-park."""
+        self._submit_normal_task(spec, pt, strat)
+
+    def _maybe_fetch_leases(self, key: tuple, demand: dict[str, float],
+                            pool: "_LeasePool", strategy=None):
+        """Keep enough lease capacity in flight for the parked tasks.
+
+        Batched pools send ONE request sized to the current deficit
+        (capped at lease_batch_max) instead of a round-trip per task,
+        and keep at most two RPCs outstanding: one may be queued at a
+        saturated node manager while the second covers tasks that
+        arrived since. SPREAD pools stay unbatched — the node manager
+        round-robins per request, so per-task requests ARE the placement
+        policy."""
+        deficit = len(pool.queue) - pool.inflight
+        if deficit <= 0:
+            return
+        batch_max = 1 if strategy == "SPREAD" \
+            else max(1, get_config().lease_batch_max)
+        if batch_max <= 1:
+            for _ in range(deficit):
+                pool.inflight += 1
+                pool.fetches += 1
+                self._spawn(self._fetch_lease(key, demand, pool,
+                                              strategy, 1))
+            return
+        if pool.fetches >= 2:
+            return
+        n = min(deficit, batch_max)
+        pool.inflight += n
+        pool.fetches += 1
+        self._spawn(self._fetch_lease(key, demand, pool, strategy, n))
 
     async def _fetch_lease(self, key: tuple, demand: dict[str, float],
-                           pool: "_LeasePool", strategy=None):
-        """One in-flight lease request against the cluster; the grant goes
-        to whichever waiter is first in line."""
+                           pool: "_LeasePool", strategy=None,
+                           count: int = 1):
+        """One in-flight lease request (possibly batched) against the
+        cluster; grants go to the waiters first in line, surplus batched
+        grants park as warm idle leases (the existing reuse machinery
+        recycles or expires them)."""
         try:
-            entry = await self._request_cluster_lease(demand, strategy)
+            entries = await self._request_cluster_lease(demand, strategy,
+                                                        count)
         except BaseException as e:
             # BaseException: a shutdown-sweep CancelledError must run the
             # same bookkeeping, else pool.inflight stays inflated and a
             # waiter future hangs forever (its task destroyed pending).
-            pool.inflight -= 1
-            # fetches and waiters are ~1:1 (one spawned per new waiter),
-            # so a failed fetch fails exactly ONE waiter — the same blast
-            # radius as the old request-per-task design. Other waiters
-            # keep their own in-flight fetches.
-            while pool.waiters:
-                fut = pool.waiters.pop(0)
-                if not fut.done():
-                    if isinstance(e, asyncio.CancelledError):
-                        fut.set_exception(
-                            WorkerCrashedError("shutting down"))
-                        # the waiter task is likely cancelled too; mark
-                        # the exception retrieved so GC doesn't warn
-                        fut.exception()
-                    else:
-                        fut.set_exception(e)
+            pool.inflight -= count
+            pool.fetches -= 1
+            # a failed fetch fails exactly ONE parked task — same blast
+            # radius as the request-per-task design; remaining tasks
+            # re-arm their own fetch below.
+            while pool.queue:
+                try:
+                    fspec, fpt, _ = pool.queue.popleft()
+                except IndexError:
                     break
+                if fpt.cancelled or fpt.done:
+                    continue
+                if isinstance(e, asyncio.CancelledError):
+                    self._fail_task(fspec,
+                                    WorkerCrashedError("shutting down"))
+                else:
+                    self._fail_task(fspec, TaskError(e, fspec.name, ""))
+                break
             if isinstance(e, asyncio.CancelledError):
                 raise
+            self._maybe_fetch_leases(key, demand, pool, strategy)
             return
-        pool.inflight -= 1
-        self._offer_lease(key, pool, entry, recycled=False)
+        pool.inflight -= count
+        pool.fetches -= 1
+        for entry in entries:
+            # count>1 surplus parks warm (burst tail reuses it); a single
+            # unwanted grant is returned so it can't starve other clients
+            # queued at the node manager
+            self._offer_lease(key, pool, entry, recycled=(count > 1))
+        self._maybe_fetch_leases(key, demand, pool, strategy)
 
     def _offer_lease(self, key: tuple, pool: "_LeasePool", entry,
                      recycled: bool):
-        """Hand a granted/finished lease to the next waiter; otherwise keep
-        a recycled lease warm for lease_reuse_idle_s, and return a fetched
-        lease nobody wants (holding it would starve other clients queued
-        at the node manager)."""
-        while pool.waiters:
-            fut = pool.waiters.pop(0)
-            if not fut.done():
-                fut.set_result(entry)
-                return
+        """Hand a granted/finished lease to the next parked task;
+        otherwise keep a recycled lease warm for lease_reuse_idle_s, and
+        return a fetched lease nobody wants (holding it would starve
+        other clients queued at the node manager)."""
+        while pool.queue:
+            try:
+                spec, pt, strat = pool.queue.popleft()
+            except IndexError:
+                break
+            if pt.cancelled or pt.done:
+                continue
+            self._dispatch_leased(spec, pt, strat, entry)
+            return
         idle_s = get_config().lease_reuse_idle_s
         if not recycled or idle_s <= 0 or self._shutdown:
             self._spawn(self._release_lease(
@@ -1395,20 +2021,26 @@ class CoreWorker:
         # an expire timer from an EARLIER idle period must not evict the
         # lease's newer idle incarnation (tuple equality would)
         idle_entry = (entry[0], entry[1], entry[2], object())
-        pool.idle.append(idle_entry)
+        with pool.idle_lock:
+            pool.idle.append(idle_entry)
 
         async def _expire():
             await asyncio.sleep(idle_s)
-            for i, cand in enumerate(pool.idle):
-                if cand[3] is idle_entry[3]:
-                    del pool.idle[i]
-                    await self._release_lease(
-                        entry[0], entry[1], entry[2], reusable=False)
-                    return
+            with pool.idle_lock:  # vs concurrent user-thread claims
+                expired = False
+                for i, cand in enumerate(pool.idle):
+                    if cand[3] is idle_entry[3]:
+                        del pool.idle[i]
+                        expired = True
+                        break
+            if expired:
+                await self._release_lease(
+                    entry[0], entry[1], entry[2], reusable=False)
         self._spawn(_expire())
 
     async def _request_cluster_lease(self, demand: dict[str, float],
-                                     strategy=None):
+                                     strategy=None, count: int = 1):
+        """-> list of (winfo, token, nm_addr) grants (1..count)."""
         nm_addr = Address(self.node_address.host, self.node_address.port)
         allow_spill = True
         infeasible_deadline: float | None = None
@@ -1419,8 +2051,10 @@ class CoreWorker:
                 conn = (self.node_conn
                         if nm_addr.key() == self.node_address.key()
                         else await self._conn_to(nm_addr))
+                self.lease_rpcs_sent += 1
                 res = await conn.call("request_lease",
-                                      (demand, allow_spill, strategy),
+                                      (demand, allow_spill, strategy,
+                                       count),
                                       timeout=_TASK_PUSH_TIMEOUT)
             except (ConnectionLost, RpcError, OSError):
                 if nm_addr.key() == self.node_address.key():
@@ -1434,7 +2068,7 @@ class CoreWorker:
                 await asyncio.sleep(0.3)
                 continue
             if res[0] == "granted":
-                return res[1], res[2], nm_addr
+                return [(w, t, nm_addr) for w, t in res[1]]
             if res[0] == "spillback":
                 nm_addr = res[1]
                 allow_spill = False
@@ -1480,115 +2114,304 @@ class CoreWorker:
                           (winfo, token, nm_addr), recycled=True)
 
     async def _run_normal_task(self, spec: TaskSpec):
-        pt = self.pending_tasks[spec.task_id]
-        # PG strategies were already rewritten into bundle-reserved demand
-        strat = spec.scheduling_strategy
-        if isinstance(strat, PlacementGroupSchedulingStrategy):
-            strat = None
-        t_sched = time.perf_counter()
-        while True:
-            try:
-                winfo, token, nm_addr = await self._acquire_lease(
-                    spec.resources, strat, pt)
-                spec.attempt = spec.max_retries - pt.retries_left
-                self._emit_task_event(spec, "SCHEDULED")
-                if t_sched is not None:  # first grant only, not retries
-                    self._observe_sched_latency(
-                        time.perf_counter() - t_sched)
-                    t_sched = None
-            except asyncio.CancelledError:
-                if pt.cancelled or pt.done:
-                    return  # waiter withdrawn by cancel(); returns failed
-                raise      # shutdown sweep — propagate
-            except Exception as e:
-                self._fail_task(spec, TaskError(e, spec.name, ""))
-                return
-            if pt.cancelled or pt.done:
-                # cancelled while queued: returns were already failed by
-                # cancel_task; just hand the lease back
-                self._recycle_lease(spec.resources, winfo, token, nm_addr,
-                                    strat)
-                return
-            try:
-                pt.running_on = winfo
-                self._emit_task_event(spec, "DISPATCHED")
-                conn = await self._conn_to(winfo.address)
-                reply = await conn.call("push_task", spec,
-                                        timeout=_TASK_PUSH_TIMEOUT)
-            except (ConnectionLost, RpcError, OSError) as e:
-                pt.running_on = None
-                await self._release_lease(winfo, token, nm_addr, reusable=False)
-                if pt.cancelled:
-                    # force-cancel kills the worker mid-task; that death is
-                    # the cancellation succeeding, not a crash
-                    self._fail_task(spec, TaskCancelledError(
-                        f"task {spec.name} cancelled while running"))
-                    return
-                if pt.retries_left > 0:
-                    pt.retries_left -= 1
-                    logger.warning("task %s worker crash, retrying (%s)",
-                                   spec.name, e)
-                    await asyncio.sleep(0.05)
-                    continue
-                self._fail_task(spec, WorkerCrashedError(
-                    f"worker died running {spec.name}: {e}"))
-                return
+        """Loop-side re-entry for retries and lineage reconstruction:
+        route the task (back) through dispatch-or-park."""
+        pt = self.pending_tasks.get(spec.task_id)
+        if pt is None:
+            return
+        self._submit_normal_task(spec, pt, _push_strategy(spec))
+
+    async def _push_via_loop(self, spec: TaskSpec, pt: "_PendingTask",
+                             strat, winfo, token, nm_addr):
+        """Asyncio-path push of one leased attempt (workers without a
+        direct channel, oversized specs, chaos testing). Carries the
+        full reply/error/retry handling the direct path marshals back
+        here for."""
+        try:
+            conn = await self._conn_to(winfo.address)
+            self._attach_code_blob_set(
+                spec, conn.__dict__.setdefault("_fn_pushed", set()))
+            reply = await conn.call("push_task", spec,
+                                    timeout=_TASK_PUSH_TIMEOUT)
+        except (ConnectionLost, RpcError, OSError) as e:
             pt.running_on = None
+            await self._release_lease(winfo, token, nm_addr, reusable=False)
             if pt.cancelled:
-                # cancel() already returned True — it wins even when the
-                # worker raced to a result. Never recycle this lease: on
-                # force-cancel the worker is milliseconds from os._exit.
-                self._spawn(self._release_lease(
-                    winfo, token, nm_addr, reusable=False))
+                # force-cancel kills the worker mid-task; that death is
+                # the cancellation succeeding, not a crash
                 self._fail_task(spec, TaskCancelledError(
                     f"task {spec.name} cancelled while running"))
                 return
-            if strat == "SPREAD":
-                # no sticky reuse for SPREAD: recycling would funnel the
-                # whole wave onto the first-granted node; releasing makes
-                # every task take the round-robin path at the node manager
-                # (fire-and-forget: no reply-latency cost per task)
-                self._spawn(self._release_lease(
-                    winfo, token, nm_addr, reusable=False))
-            else:
-                self._recycle_lease(spec.resources, winfo, token, nm_addr,
-                                    strat)
-            if reply[0] == "task_error":
-                _, err_blob, tb = reply
-                if spec.retry_exceptions and pt.retries_left > 0:
-                    pt.retries_left -= 1
-                    continue
-                try:
-                    cause = deserialize(err_blob)
-                except Exception as e:
-                    cause = RuntimeError(f"undeserializable task error: {e}")
-                self._fail_task(spec, TaskError(cause, spec.name, tb))
+            if pt.retries_left > 0:
+                pt.retries_left -= 1
+                logger.warning("task %s worker crash, retrying (%s)",
+                               spec.name, e)
+                await asyncio.sleep(0.05)
+                self._resubmit(spec, pt, strat)
                 return
-            self._complete_task(spec, reply[1], winfo)
+            self._fail_task(spec, WorkerCrashedError(
+                f"worker died running {spec.name}: {e}"))
             return
+        pt.running_on = None
+        if pt.cancelled:
+            # cancel() already returned True — it wins even when the
+            # worker raced to a result. Never recycle this lease: on
+            # force-cancel the worker is milliseconds from os._exit.
+            self._spawn(self._release_lease(
+                winfo, token, nm_addr, reusable=False))
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} cancelled while running"))
+            return
+        if strat == "SPREAD":
+            # no sticky reuse for SPREAD: recycling would funnel the
+            # whole wave onto the first-granted node; releasing makes
+            # every task take the round-robin path at the node manager
+            # (fire-and-forget: no reply-latency cost per task)
+            self._spawn(self._release_lease(
+                winfo, token, nm_addr, reusable=False))
+        else:
+            self._recycle_lease(spec.resources, winfo, token, nm_addr,
+                                strat)
+        if reply[0] == "task_error":
+            _, err_blob, tb = reply
+            if spec.retry_exceptions and pt.retries_left > 0:
+                pt.retries_left -= 1
+                self._resubmit(spec, pt, strat)
+                return
+            try:
+                cause = deserialize(err_blob)
+            except Exception as e:
+                cause = RuntimeError(f"undeserializable task error: {e}")
+            self._fail_task(spec, TaskError(cause, spec.name, tb))
+            return
+        self._complete_task(spec, reply[1], winfo)
 
-    @staticmethod
-    def _observe_sched_latency(dur_s: float):
+    def _observe_sched_latency(self, dur_s: float):
+        if self._m_sched_lat is None:
+            return
         try:
-            from ray_tpu.util import builtin_metrics as _bm
-
-            _bm.task_sched_latency.observe(dur_s)
+            self._m_sched_lat.observe(dur_s)
         except Exception:
             pass
 
-    def _task_finished(self, status: str):
-        try:
-            from ray_tpu.util import builtin_metrics as _bm
+    def _direct_push_normal(self, spec: TaskSpec, pt, winfo: WorkerInfo,
+                            token, nm_addr, strat,
+                            chain: "_LeaseChain | None" = None) -> bool:
+        """Push a leased normal task over the worker's direct channel.
+        True => sent: the direct reader thread owns the rest of this
+        attempt — it completes/fails the task under _completion_lock and
+        chains the next parked same-shape task onto the hot lease (the
+        loop never enters the steady-state submit→complete cycle); cold
+        paths (task_error replies, connection loss) marshal back onto
+        the IO loop where the retry machinery lives. False => caller
+        takes the asyncio path (no direct port, oversized spec, chaos
+        testing). ``chain`` tracks the in-flight pipeline on this lease
+        — whoever drops it to zero disposes of the lease."""
+        dc = self._direct_client_for(winfo.address.host,
+                                     getattr(winfo, "direct_port", 0))
+        if dc is None:
+            return False
+        key = self._lease_key(spec.resources, strat)
+        if chain is None:
+            chain = _LeaseChain()
 
+        def on_reply(reply):
+            pt.running_on = None
+            if reply[0] == "task_error":
+                # cold path: retry/cancel decisions live on the loop
+                if chain.release_one():
+                    self._queue_lease_return(
+                        spec.resources, winfo, token, nm_addr, strat,
+                        strat != "SPREAD" and not pt.cancelled)
+                self._spawn_from_thread(
+                    self._handle_task_error_reply(spec, pt, reply))
+                return
+            with self._completion_lock:
+                cancelled = pt.cancelled and not pt.done
+                if cancelled:
+                    # cancel() already returned True — it wins even when
+                    # the worker raced to a result
+                    self._fail_task_locked(spec, TaskCancelledError(
+                        f"task {spec.name} cancelled while running"))
+                else:
+                    self._complete_task_locked(spec, reply[1], winfo)
+            with chain.lock:
+                chain.inflight -= 1
+            # hot-lease chaining: top the pipeline back up straight from
+            # this reader thread. Skipped for SPREAD (reuse would defeat
+            # round-robin) and cancelled leases (on force-cancel the
+            # worker is milliseconds from os._exit).
+            if not cancelled and strat != "SPREAD" and not self._shutdown:
+                self._fill_chain(key, chain, spec.resources, winfo,
+                                 token, nm_addr, strat)
+            if chain.try_dispose():
+                self._queue_lease_return(
+                    spec.resources, winfo, token, nm_addr, strat,
+                    (not cancelled) and strat != "SPREAD")
+
+        def on_error(exc):
+            self._spawn_from_thread(self._handle_direct_push_loss(
+                spec, pt, winfo, token, nm_addr, exc,
+                release=chain.release_one()))
+
+        if not chain.acquire_one():
+            return False  # chain already disposed: lease is being
+            # returned — the caller re-parks the task
+        # push_lock makes attach-blob + send one atomic step: a racing
+        # pusher on another thread cannot slip a blob-less frame for
+        # this function id onto the wire before the blob-carrying one
+        with dc.push_lock:
+            self._attach_code_blob_set(spec, dc.fn_pushed)
+            sent = dc.try_call("push_task", spec, on_reply, on_error)
+        if sent:
+            return True
+        with chain.lock:
+            chain.inflight -= 1
+        return False
+
+    def _fill_chain(self, key: tuple, chain: "_LeaseChain",
+                    demand: dict[str, float], winfo, token, nm_addr,
+                    strat) -> None:
+        """Claim parked tasks onto this lease (runs on reader threads
+        and the dispatching thread). Refilling to ONE in-flight push is
+        unconditional — that is classic lease reuse. Pipelining a
+        SECOND push (so the worker's next request is already buffered
+        when it finishes) happens only under real queue pressure: a
+        short queue's tasks may be long-running, and queueing one
+        behind a busy worker would serialize work that an incoming
+        lease grant could run in parallel. A claimed task the channel
+        refuses (oversized spec, client teardown) is re-parked
+        head-of-queue for the loop."""
+        pool = self._lease_cache.get(key)
+        if pool is None:
+            return
+        while True:
+            target = (_LeaseChain.DEPTH
+                      if len(pool.queue) >= _PIPELINE_MIN_QUEUE else 1)
+            with chain.lock:
+                if chain.inflight >= target:
+                    return
+            nxt = self._claim_parked_task(key)
+            if nxt is None:
+                return
+            nspec, npt, nstrat = nxt
+            nspec.attempt = nspec.max_retries - npt.retries_left
+            self._emit_task_event(nspec, "SCHEDULED")
+            if npt.t_sched is not None:
+                self._observe_sched_latency(
+                    time.perf_counter() - npt.t_sched)
+                npt.t_sched = None
+            npt.running_on = winfo
+            self._emit_task_event(nspec, "DISPATCHED")
+            if not self._direct_push_normal(nspec, npt, winfo, token,
+                                            nm_addr, nstrat, chain):
+                npt.running_on = None
+                self._repark_task(key, nspec, npt, nstrat)
+                # if that refusal left the chain idle, the lease must
+                # still be disposed of exactly once (no-op when another
+                # holder or a racing dispose already owns it)
+                if chain.try_dispose():
+                    self._queue_lease_return(demand, winfo, token,
+                                             nm_addr, strat,
+                                             strat != "SPREAD")
+                return
+
+    def _repark_task(self, key: tuple, spec: TaskSpec, pt, strat) -> None:
+        """Head-of-queue re-park (claim raced a channel teardown); arms
+        a loop-side fetch check so the task cannot strand."""
+        pool = self._lease_pool_for(key)
+        pool.queue.appendleft((spec, pt, strat))
+        if not pool.fetch_armed:
+            pool.fetch_armed = True
+            self._fetch_requests.append((key, spec.resources, pool,
+                                         strat))
+            self._ring_loop()
+
+    def _claim_parked_task(self, key: tuple):
+        """Thread-safe claim of the next live parked task for this
+        scheduling key — the deque pop IS the claim (atomic under the
+        GIL); cancelled/finished entries are skipped. None when empty."""
+        pool = self._lease_cache.get(key)
+        if pool is None:
+            return None
+        q = pool.queue
+        while True:
+            try:
+                spec, pt, strat = q.popleft()
+            except IndexError:
+                return None
+            if pt.cancelled or pt.done:
+                continue
+            return spec, pt, strat
+
+    async def _handle_task_error_reply(self, spec: TaskSpec, pt, reply):
+        """Loop side of a direct-channel task_error reply (the lease was
+        already parked by the reader thread)."""
+        _, err_blob, tb = reply
+        if pt.done:
+            return
+        if pt.cancelled:
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} cancelled while running"))
+            return
+        if spec.retry_exceptions and pt.retries_left > 0:
+            pt.retries_left -= 1
+            self._resubmit(spec, pt, _push_strategy(spec))
+            return
+        try:
+            cause = deserialize(err_blob)
+        except Exception as e:
+            cause = RuntimeError(f"undeserializable task error: {e}")
+        self._fail_task(spec, TaskError(cause, spec.name, tb))
+
+    async def _handle_direct_push_loss(self, spec: TaskSpec, pt,
+                                       winfo, token, nm_addr, exc,
+                                       release: bool = True):
+        """Loop side of a direct-channel connection loss mid-push —
+        mirrors the asyncio path's worker-crash retry clause. With a
+        pipelined lease, only the LAST outstanding push's handler
+        releases it (release=True)."""
+        pt.running_on = None
+        if release:
+            await self._release_lease(winfo, token, nm_addr,
+                                      reusable=False)
+        if pt.done:
+            return
+        if pt.cancelled:
+            # force-cancel kills the worker mid-task; that death is the
+            # cancellation succeeding, not a crash
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} cancelled while running"))
+            return
+        if pt.retries_left > 0:
+            pt.retries_left -= 1
+            logger.warning("task %s worker crash, retrying (%s)",
+                           spec.name, exc)
+            await asyncio.sleep(0.05)
+            self._resubmit(spec, pt, _push_strategy(spec))
+            return
+        self._fail_task(spec, WorkerCrashedError(
+            f"worker died running {spec.name}: {exc}"))
+
+    def _task_finished(self, status: str):
+        if self._m_finished is None:
+            return
+        try:
             self._inflight_tasks = max(0, self._inflight_tasks - 1)
-            _bm.tasks_finished.inc(tags={"status": status})
-            _bm.task_queue_depth.set(
-                float(self._inflight_tasks),
-                tags={"owner": self.worker_id.hex()[:12]})
+            self._m_finished[status].inc()
+            self._m_queue_depth.set(float(self._inflight_tasks))
         except Exception:
             pass
 
     def _complete_task(self, spec: TaskSpec, results: list, winfo: WorkerInfo):
+        # direct-actor reader threads complete tasks off the IO loop, so
+        # the terminal done-check/flag and pin release must be atomic
+        # against the loop-side cancel/fail paths
+        with self._completion_lock:
+            self._complete_task_locked(spec, results, winfo)
+
+    def _complete_task_locked(self, spec: TaskSpec, results: list,
+                              winfo: WorkerInfo):
         pt = self.pending_tasks.get(spec.task_id)
         if pt is not None and pt.done:
             return  # lost the race with a cancel-fail; returns hold errors
@@ -1620,6 +2443,7 @@ class CoreWorker:
                 self.object_meta[oid] = ObjectMeta(
                     oid, size=size, in_shm=True, node_ids=[winfo.node_id])
             self._signal_object_ready(oid)
+            self._wake_sync_waiter(oid)
         if pt is not None:
             pt.done = True
             for oid in pt.pinned:
@@ -1628,6 +2452,10 @@ class CoreWorker:
                 self._task_finished("ok")  # submit; keep the pair honest
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
+        with self._completion_lock:
+            self._fail_task_locked(spec, error)
+
+    def _fail_task_locked(self, spec: TaskSpec, error: Exception):
         pt = self.pending_tasks.get(spec.task_id)
         if pt is not None and pt.done:
             # already failed/completed (e.g. cancelled while queued, then
@@ -1657,6 +2485,7 @@ class CoreWorker:
             meta = self.object_meta.setdefault(oid, ObjectMeta(oid))
             meta.error = error
             self._signal_object_ready(oid)
+            self._wake_sync_waiter(oid)
         if pt is not None:
             pt.done = True
             for oid in pt.pinned:
@@ -1671,16 +2500,28 @@ class CoreWorker:
         task_id = TaskID.for_actor_task(actor_id)
         spec_args, pinned = self._prepare_args(args)
         spec_kwargs, pinned_kw = self._prepare_args(kwargs)
+        runtime_env = self._package_runtime_env(options.runtime_env)
+        # Actor-creation specs carry a function id too: the class blob is
+        # published to GCS KV synchronously (the spec travels via the GCS
+        # to the node manager — no owner connection to piggyback on) and
+        # the creating worker fetches it once per class. A pool of N
+        # identical actors ships the class N times -> once per worker.
+        if runtime_env is None:
+            fid, blob = self.fn_table.register(cls, self.job_id)
+            self._publish_code_blob(fid, blob, sync=True)
+            function_blob = None
+        else:
+            fid, function_blob = None, _dumps_code_now(cls)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=getattr(cls, "__name__", "Actor"),
-            function_blob=_dumps_code(cls),
+            function_blob=function_blob, function_id=fid,
             args=spec_args, kwargs=spec_kwargs, num_returns=1,
             resources=self._demand_for(options),
             owner=self.worker_info, actor_id=actor_id,
             is_actor_creation=True, actor_options=options,
             scheduling_strategy=options.scheduling_strategy,
-            runtime_env=self._package_runtime_env(options.runtime_env),
+            runtime_env=runtime_env,
             trace_ctx=_trace_carrier())
         self.io.run(self.gcs.register_actor(spec))
         return actor_id
@@ -1718,12 +2559,89 @@ class CoreWorker:
         refs = self._register_task(spec, pinned + pinned_kw)
         self._emit_task_event(spec, "PENDING_ARGS")
         sub = self.get_actor_submitter(actor_id)
-        self._spawn_from_thread(sub.submit(spec))
+        if spec.num_returns == 1 and not spec.tensor_transport \
+                and self._try_direct_actor_submit(sub, spec):
+            return refs
+        sub.note_async_queued()
+        self._spawn_from_thread(sub.submit(spec, queued=True))
         if spec.num_returns == -1:
             from ray_tpu.core.streaming import ObjectRefGenerator
 
             return ObjectRefGenerator(self, spec.task_id)
         return refs
+
+    def _try_direct_actor_submit(self, sub: "_ActorTaskSubmitter",
+                                 spec: TaskSpec) -> bool:
+        """Sync fast lane for actor calls: serialize + send on THIS
+        (caller) thread over the worker's direct channel; the channel's
+        reader thread completes the task and wakes sync getters. False
+        => caller must take the asyncio submitter path. Stands down
+        whenever asyncio submissions are queued (order preservation),
+        the actor isn't resolved-ALIVE, or the channel is unavailable."""
+        if sub.state != ActorState.ALIVE or sub.pending_async:
+            return False
+        if spec.method_name in sub.async_methods:
+            return False  # async bodies must overlap on the actor loop
+        address, dport = sub.address, sub.direct_port
+        if address is None or not dport:
+            return False
+        # prefer the reader-less sync client: the eventual getter pumps
+        # the reply on its own thread (2 thread wakes per round-trip);
+        # fall back to the reader-thread client if the dial failed
+        dc = self._sync_direct_client_for(address.host, dport)
+        sync_mode = dc is not None
+        if dc is None:
+            dc = self._direct_client_for(address.host, dport)
+            if dc is None:
+                return False
+        # the return's sync-waiter event was created by _register_task
+        oid = ObjectID.for_return(spec.task_id, 0)
+        node_id = sub.node_id or self.node_id
+
+        def on_reply(reply):
+            if reply[0] == "task_error":
+                _, err_blob, tb = reply
+                try:
+                    cause = deserialize(err_blob)
+                except Exception as e:
+                    cause = RuntimeError(f"undeserializable error: {e}")
+                self._fail_task(spec, TaskError(cause, spec.name, tb))
+            else:
+                self._complete_task(
+                    spec, reply[1],
+                    WorkerInfo(WorkerID.nil(), node_id, address))
+            self._sync_read_owners.pop(oid, None)
+
+        def on_error(exc):
+            self._sync_read_owners.pop(oid, None)
+            if isinstance(exc, RemoteError):
+                # handler-level failure with a live connection: the
+                # asyncio path owns the authoritative semantics — replay
+                # through it (it terminally fails or retries)
+                self._spawn_from_thread(sub.submit(spec))
+            else:
+                self._spawn_from_thread(
+                    sub.handle_direct_loss(address, spec))
+
+        with sub._seq_lock:
+            if sub.pending_async:
+                return False
+            spec.seq_no = sub.seq
+            spec.attempt = 0
+            self._emit_task_event(spec, "SCHEDULED")
+            self._emit_task_event(spec, "DISPATCHED")
+            if sync_mode:
+                self._sync_read_owners[oid] = dc
+            sent = dc.try_call(
+                "push_actor_task",
+                (spec, self.worker_info.address.key()),
+                on_reply, on_error)
+            if sent:
+                sub.seq += 1  # a failed send must not burn a seq —
+                # the worker's gate would wait on it forever
+            elif sync_mode:
+                self._sync_read_owners.pop(oid, None)
+        return sent
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.io.run(self.gcs.kill_actor(actor_id, no_restart))
@@ -1752,23 +2670,22 @@ class CoreWorker:
         return self.io.run(self._cancel_on_loop(tid, force))
 
     async def _cancel_on_loop(self, tid: TaskID, force: bool) -> bool:
-        pt = self.pending_tasks.get(tid)
-        if pt is None or pt.done:
-            return False
-        pt.cancelled = True
-        pt.retries_left = 0
+        # check-and-set under the completion lock: a direct reader thread
+        # completing the task concurrently either finishes first (we see
+        # pt.done and return False) or sees pt.cancelled and fails the
+        # task with TaskCancelledError — cancel-wins stays atomic
+        with self._completion_lock:
+            pt = self.pending_tasks.get(tid)
+            if pt is None or pt.done:
+                return False
+            pt.cancelled = True
+            pt.retries_left = 0
         winfo = pt.running_on
         if winfo is None:
-            # not yet on a worker: fail the returns now and withdraw the
-            # pending lease waiter — a cancelled task must stop competing
-            # for capacity (and feeding autoscaler demand)
-            lw, pt.lease_waiter = pt.lease_waiter, None
-            if lw is not None:
-                pool, fut = lw
-                if fut in pool.waiters:
-                    pool.waiters.remove(fut)
-                if not fut.done():
-                    fut.cancel()
+            # not yet on a worker: fail the returns now — the parked
+            # pool-queue entry is skipped at claim time (pt.done), so a
+            # cancelled task stops competing for capacity (and feeding
+            # autoscaler demand)
             self._fail_task(pt.spec, TaskCancelledError(
                 f"task {pt.spec.name} cancelled before it started"))
             return True
@@ -1897,7 +2814,133 @@ class CoreWorker:
         except Exception:
             pass
 
+    # ------------------------------------------------- direct-call plane
+    def _direct_push_task(self, spec: TaskSpec):
+        """Direct-channel normal-task execution (runs on a direct-server
+        connection thread). The body runs INLINE on this thread under
+        the worker-wide exec mutex — no executor round-trip (2 thread
+        handoffs per task on a small host); the single-execution
+        invariant and the cancel machinery (_exec_thread_ident async-exc
+        delivery) are enforced inside _execute_task itself."""
+        if spec.function_id is not None and spec.function_blob is not None:
+            self.fn_cache.stage_blob(spec.function_id, spec.function_blob)
+        return self._execute_task(spec)
+
+    def _direct_push_actor_task(self, arg):
+        """Direct-channel ordered actor-task execution (connection
+        thread). Same seq gate as the asyncio handler — the blocking
+        enter() parks this connection's thread until predecessors from
+        the same caller have been dispatched. Sync bodies run inline on
+        this thread; async bodies go to the actor loop as usual."""
+        spec, caller_key = arg
+        gate = self._actor_gates.setdefault(caller_key, _SeqGate())
+        out = gate.enter(spec.seq_no,
+                         lambda: self._dispatch_actor_task_direct(spec))
+        if out is _INLINE:
+            # ordering already secured: dispatch pre-acquired the exec
+            # mutex under the gate lock; run the body here and release
+            try:
+                return self._execute_actor_task(spec)
+            finally:
+                self._exec_mutex.release()
+        return out.result()
+
+    def _dispatch_actor_task_direct(self, spec: TaskSpec):
+        """Dispatch step for the direct path (runs under the seq-gate
+        lock). Async methods keep the actor loop (their bodies must
+        overlap). Sync methods claim the exec mutex HERE — while the
+        gate is still closed to successors — so start order equals seq
+        order even when a successor races in via the asyncio/executor
+        path; the caller then runs the body inline."""
+        if self._method_is_async(spec.method_name):
+            return asyncio.run_coroutine_threadsafe(
+                self._run_async_method(spec), self._actor_async_loop.loop)
+        self._exec_mutex.acquire()
+        return _INLINE
+
+    def _method_is_async(self, method_name: str) -> bool:
+        """Cached is-this-an-async-method lookup (the inspect pair costs
+        ~10us per call on the hot path; the instance's methods are fixed
+        for the worker's lifetime)."""
+        hit = self._method_kind.get(method_name)
+        if hit is None:
+            import inspect
+
+            method = getattr(self.actor_instance, method_name, None)
+            hit = bool(asyncio.iscoroutinefunction(method)
+                       or inspect.isasyncgenfunction(method))
+            self._method_kind[method_name] = hit
+        return hit
+
+    def rpc_direct_port(self, conn, arg=None):
+        """Direct-channel endpoint discovery (actor submitters resolve
+        an actor's ADDRESS from the GCS, then ask the worker itself for
+        its direct port — keeps the GCS schema untouched). Advertises 0
+        when calls must be able to OVERLAP on this worker (threaded
+        max_concurrency>1): a direct connection thread blocks per call,
+        which would serialize them. An async-capable actor advertises
+        the port PLUS its async method names — the owner keeps those on
+        the asyncio path (their bodies overlap on the actor loop) while
+        sync methods, whose bodies the single executor serializes
+        anyway, still take the direct lane."""
+        if self._direct_server is None:
+            return 0
+        if getattr(self.executor, "_max_workers", 1) != 1:
+            return 0
+        if self._actor_async_loop is None:
+            return self._direct_server.port
+        import inspect
+
+        cls = type(self.actor_instance)
+        async_methods = sorted(
+            m for m in dir(cls) if not m.startswith("__")
+            and (asyncio.iscoroutinefunction(getattr(cls, m, None))
+                 or inspect.isasyncgenfunction(getattr(cls, m, None))))
+        return (self._direct_server.port, async_methods)
+
+    def _direct_client_for(self, host: str, direct_port: int):
+        """Cached DirectClient for a worker endpoint, or None when the
+        channel is unavailable (no port, chaos testing active, or the
+        dial failed — callers fall back to the asyncio path)."""
+        return self._cached_direct_client(self._direct_clients, host,
+                                          direct_port, reader=True)
+
+    def _sync_direct_client_for(self, host: str, direct_port: int):
+        """Reader-less variant for the sync fast lane (replies pumped by
+        getter threads via drive())."""
+        return self._cached_direct_client(self._sync_direct_clients, host,
+                                          direct_port, reader=False)
+
+    def _cached_direct_client(self, cache: dict, host: str,
+                              direct_port: int, reader: bool):
+        if not direct_port or get_config().testing_rpc_failure_prob > 0:
+            return None
+        key = (host, direct_port)
+        dc = cache.get(key)
+        if dc is not None and not dc.closed:
+            return dc
+        # dial OUTSIDE the lock: a hung host's 10s connect must not
+        # stall every other thread's access to healthy clients. Racing
+        # creators are rare; the loser's connection is closed.
+        try:
+            from ray_tpu.core.direct import DirectClient
+
+            fresh = DirectClient(host, direct_port, reader=reader)
+        except OSError:
+            return None
+        with self._direct_lock:
+            cur = cache.get(key)
+            if cur is not None and not cur.closed:
+                fresh.close()
+                return cur
+            cache[key] = fresh
+            return fresh
+
     async def rpc_push_task(self, conn, spec: TaskSpec):
+        if spec.function_id is not None and spec.function_blob is not None:
+            # stage the piggybacked blob BEFORE the executor hop: a later
+            # same-connection push omitting the blob must always find it
+            self.fn_cache.stage_blob(spec.function_id, spec.function_blob)
         loop = asyncio.get_running_loop()
         self._ensure_executor_alive()
         return await loop.run_in_executor(
@@ -1917,8 +2960,10 @@ class CoreWorker:
             error=truncate_error(type(e).__name__, str(e), tb))
 
     def _execute_task(self, spec: TaskSpec):
-        from ray_tpu._internal import otel
+        with self._exec_mutex:
+            return self._execute_task_mutexed(spec)
 
+    def _execute_task_mutexed(self, spec: TaskSpec):
         # visible to the RPC loop thread for cancel_task (the exec context
         # is a threading.local, so it can't serve cross-thread lookups)
         self._exec_thread_ident = threading.get_ident()
@@ -1929,7 +2974,7 @@ class CoreWorker:
         # trace id across the whole task tree (ref: _private/tracing
         # _wrap_task_execution). No-op context when tracing is off.
         try:
-            with otel.execute_span(
+            with _otel.execute_span(
                     spec.name or "task", getattr(spec, "trace_ctx", None),
                     task_id=spec.task_id.hex()) as sp:
                 out = self._execute_task_body(spec)
@@ -1944,12 +2989,11 @@ class CoreWorker:
         self._observe_exec_latency(dur, "task")
         return out
 
-    @staticmethod
-    def _observe_exec_latency(dur_s: float, kind: str):
+    def _observe_exec_latency(self, dur_s: float, kind: str):
+        if self._m_exec_lat is None:
+            return
         try:
-            from ray_tpu.util import builtin_metrics as _bm
-
-            _bm.task_exec_latency.observe(dur_s, tags={"kind": kind})
+            self._m_exec_lat[kind].observe(dur_s)
         except Exception:
             pass
 
@@ -1997,7 +3041,7 @@ class CoreWorker:
         restore_env = None
         try:
             restore_env = self._apply_runtime_env(spec)
-            fn = cloudpickle.loads(spec.function_blob)
+            fn = self._resolve_function(spec)
             args = self._resolve_args(spec.args)
             kwargs = self._resolve_args(spec.kwargs)
             result = fn(*args, **kwargs)
@@ -2094,7 +3138,7 @@ class CoreWorker:
         self._emit_task_event(spec, "RUNNING")
         try:
             self._apply_runtime_env(spec)
-            cls = cloudpickle.loads(spec.function_blob)
+            cls = self._resolve_function(spec)
             args = self._resolve_args(spec.args)
             kwargs = self._resolve_args(spec.kwargs)
             self.actor_instance = cls(*args, **kwargs)
@@ -2127,34 +3171,33 @@ class CoreWorker:
         ordered but bodies overlap — same as the reference's threaded/async
         actors (out_of_order_actor_scheduling_queue.cc)."""
         spec, caller_key = arg
-        st = self._actor_seq_state.get(caller_key)
-        if st is None:
-            st = {"next": 0, "cond": asyncio.Condition()}
-            self._actor_seq_state[caller_key] = st
-        async with st["cond"]:
-            await st["cond"].wait_for(lambda: st["next"] >= spec.seq_no)
-            if st["next"] == spec.seq_no:
-                st["next"] = spec.seq_no + 1
-                st["cond"].notify_all()
-        import inspect
+        gate = self._actor_gates.setdefault(caller_key, _SeqGate())
+        while True:
+            ok, fut = gate.try_enter(spec.seq_no,
+                                     lambda: self._dispatch_actor_task(spec))
+            if ok:
+                return await asyncio.wrap_future(fut)
+            # out-of-order arrival (mixed direct/asyncio paths or a
+            # reconnect): poll until the predecessor passes the gate —
+            # rare, so a 1ms cadence costs nothing in steady state
+            await asyncio.sleep(0.001)
 
-        loop = asyncio.get_running_loop()
-        method = getattr(self.actor_instance, spec.method_name, None)
-        if asyncio.iscoroutinefunction(method) or \
-                inspect.isasyncgenfunction(method):
+    def _dispatch_actor_task(self, spec: TaskSpec):
+        """Queue one ordered actor task for execution; returns a
+        concurrent.futures.Future. Runs under the seq-gate lock (from
+        either the asyncio handler or a direct-call thread) so the
+        executor's FIFO order equals seq order."""
+        if self._method_is_async(spec.method_name):
             # async actor: runs concurrently on the actor's asyncio loop
-            cfut = asyncio.run_coroutine_threadsafe(
+            return asyncio.run_coroutine_threadsafe(
                 self._run_async_method(spec), self._actor_async_loop.loop)
-            return await asyncio.wrap_future(cfut)
-        # run_in_executor queues FIFO, so start order is preserved; the
-        # executor's max_workers bounds actual concurrency
-        return await loop.run_in_executor(
-            self.executor, self._execute_actor_task, spec)
+        # executor queues FIFO, so start order is preserved; its
+        # max_workers bounds actual concurrency
+        self._ensure_executor_alive()
+        return self.executor.submit(self._execute_actor_task, spec)
 
     async def _run_async_method(self, spec: TaskSpec):
         import inspect
-
-        from ray_tpu._internal import otel
 
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
@@ -2162,7 +3205,7 @@ class CoreWorker:
         # span covers the async execution path too (trace ids stay
         # consistent; interleaved async spans are handled by the
         # tracer's entry-removal discipline)
-        with otel.execute_span(
+        with _otel.execute_span(
                 spec.method_name or "actor_task",
                 getattr(spec, "trace_ctx", None),
                 task_id=spec.task_id.hex(),
@@ -2202,11 +3245,18 @@ class CoreWorker:
         return self._resolve_args(args)
 
     def _execute_actor_task(self, spec: TaskSpec):
-        from ray_tpu._internal import otel
+        # threaded actors (max_concurrency>1) must let bodies overlap —
+        # the mutex only backs the single-threaded executor's invariant
+        # (the direct lane is disabled for threaded actors anyway)
+        if getattr(self.executor, "_max_workers", 1) != 1:
+            return self._execute_actor_task_mutexed(spec)
+        with self._exec_mutex:
+            return self._execute_actor_task_mutexed(spec)
 
+    def _execute_actor_task_mutexed(self, spec: TaskSpec):
         t0 = time.perf_counter()
         self._emit_task_event(spec, "RUNNING")
-        with otel.execute_span(
+        with _otel.execute_span(
                 spec.method_name or "actor_task",
                 getattr(spec, "trace_ctx", None),
                 task_id=spec.task_id.hex(),
@@ -2342,6 +3392,17 @@ class _ActorTaskSubmitter:
         # address observed to be dead (connection refused/lost); GCS may lag
         # behind the death, so an ALIVE report at this address is stale
         self._avoid_address: Address | None = None
+        # direct fast lane: seq allocation is shared between the sync
+        # fast path (user threads) and the asyncio path (IO loop), so it
+        # needs a real lock; direct_port is learned from the worker
+        # itself after resolution (0 = unknown/unavailable)
+        self._seq_lock = threading.Lock()
+        self.direct_port = 0
+        self.async_methods: frozenset = frozenset()
+        # asyncio submissions queued but not yet seq-stamped: the fast
+        # lane stands down while any exist, so one caller's submission
+        # order is preserved across the two paths
+        self.pending_async = 0
 
     async def _ensure_resolved(self):
         if not self._resolve_started:
@@ -2369,10 +3430,13 @@ class _ActorTaskSubmitter:
                 continue
             if state == ActorState.ALIVE and address is not None:
                 if address != self.address:
-                    self.seq = 0  # fresh incarnation: restart ordering
+                    with self._seq_lock:
+                        self.seq = 0  # fresh incarnation: restart ordering
+                    self.direct_port = 0
                 self.address = address
                 self.node_id = node_id
                 self._resolved.set()
+                self.cw._spawn(self._learn_direct_port(address))
                 return
             if state == ActorState.DEAD:
                 self._resolved.set()
@@ -2388,20 +3452,71 @@ class _ActorTaskSubmitter:
             if info.address == self._avoid_address:
                 return
             if info.address != self.address:
-                self.seq = 0
+                with self._seq_lock:
+                    self.seq = 0
+                self.direct_port = 0
             self.address = info.address
             self.node_id = info.node_id
             self._resolved.set()
+            self.cw._spawn(self._learn_direct_port(info.address))
         elif info.state == ActorState.DEAD:
             self.address = None
+            self.direct_port = 0
             self._resolved.set()
         elif info.state == ActorState.RESTARTING:
             self.address = None
+            self.direct_port = 0
             self._resolved.clear()
             self.cw._spawn(self._resolve_loop())
 
-    async def submit(self, spec: TaskSpec):
+    async def _learn_direct_port(self, address: Address):
+        """Ask the (now-ALIVE) actor worker for its direct-call port —
+        endpoint discovery stays out of the GCS schema. Async-capable
+        actors reply (port, async_method_names): those methods stay on
+        the asyncio path so their bodies can overlap."""
+        try:
+            conn = await self.cw._conn_to(address)
+            dp = await conn.call("direct_port", timeout=10)
+        except Exception:
+            dp = 0
+        async_methods: tuple | list = ()
+        if isinstance(dp, (tuple, list)):
+            dp, async_methods = dp
+        if self.address == address and self.state == ActorState.ALIVE:
+            self.async_methods = frozenset(async_methods)
+            self.direct_port = int(dp or 0)
+
+    def note_async_queued(self):
+        with self._seq_lock:
+            self.pending_async += 1
+
+    async def handle_direct_loss(self, address: Address, spec: TaskSpec):
+        """A direct-channel connection died mid-call: mirror the asyncio
+        path's failover — distrust the address, re-resolve via the GCS,
+        and retry only when the task has retry budget."""
+        if self.address == address:
+            self._avoid_address = address
+            self.address = None
+            self.direct_port = 0
+            self._resolved.clear()
+            self.cw._spawn(self._resolve_loop())
+        if spec.max_retries > 0:
+            spec.max_retries -= 1  # the lost attempt consumed one
+            await self.submit(spec)
+        else:
+            self.cw._fail_task(spec, ActorDiedError(
+                self.actor_id, "connection lost: direct channel closed"))
+
+    async def submit(self, spec: TaskSpec, queued: bool = False):
         attempts = spec.max_retries + 1
+        try:
+            await self._submit_attempts(spec, attempts)
+        finally:
+            if queued:
+                with self._seq_lock:
+                    self.pending_async -= 1
+
+    async def _submit_attempts(self, spec: TaskSpec, attempts: int):
         while attempts > 0:
             attempts -= 1
             await self._ensure_resolved()
@@ -2411,8 +3526,10 @@ class _ActorTaskSubmitter:
                 return
             # seq assigned synchronously post-resolution so pipelined calls
             # from this caller reach the current incarnation in order
-            spec.seq_no = self.seq
-            self.seq += 1
+            # (lock: the direct fast lane allocates from user threads)
+            with self._seq_lock:
+                spec.seq_no = self.seq
+                self.seq += 1
             address = self.address
             spec.attempt = spec.max_retries - attempts
             self.cw._emit_task_event(spec, "SCHEDULED")

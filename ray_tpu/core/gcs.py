@@ -608,10 +608,24 @@ class GcsServer:
         self.mark_dirty()
         return True
 
-    def rpc_finish_job(self, conn, job_id: JobID):
+    async def rpc_finish_job(self, conn, job_id: JobID):
         if job_id in self.jobs:
             self.jobs[job_id]["status"] = "FINISHED"
             self.jobs[job_id]["end_time"] = now()
+            self.mark_dirty()
+        # node managers relay this to their pooled workers, which drop
+        # the finished job's function-table entries (pooled workers
+        # outlive jobs; see core/function_table.py evict_job)
+        await self.publish("job_finished", job_id.hex())
+        # and sweep the job's code blobs out of the fn_table KV
+        # namespace — function ids are job-hex-prefixed, so a finished
+        # job's blobs would otherwise accumulate in GCS memory (and its
+        # snapshots) forever
+        table = self.kv.get("fn_table")
+        if table:
+            prefix = job_id.hex() + ":"
+            for k in [k for k in table if k.startswith(prefix)]:
+                del table[k]
             self.mark_dirty()
         return True
 

@@ -259,6 +259,9 @@ class NodeManager:
         self._last_metrics_pub = 0.0
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
+        # short-lived fire-and-forget relays (job-finished code
+        # eviction); self-cleaning via done-callbacks
+        self._relays: set[asyncio.Task] = set()
         self._pull_manager = _PullManager(self)
         self._restore_futs: dict[ObjectID, asyncio.Future] = {}
         self._push_sem: asyncio.Semaphore | None = None
@@ -285,6 +288,10 @@ class NodeManager:
             node_id=self.node_id, address=self.address,
             resources_total=dict(self.resources_total), labels=dict(self.labels))
         await self.gcs_conn.call("register_node", info)
+        # job teardown: evict the finished job's loaded code from every
+        # pooled worker on this node (their fn-cache LRUs outlive jobs)
+        self.gcs_conn.on_notify("pubsub:job_finished", self._on_job_finished)
+        await self.gcs_conn.call("subscribe", "job_finished")
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         if get_config().object_spilling_threshold > 0:
@@ -428,6 +435,25 @@ class NodeManager:
             self._doomed = [w for w in self._doomed
                             if w.proc.poll() is None]
             await asyncio.sleep(0.1)
+
+    def _on_job_finished(self, job_hex: str):
+        """pubsub relay: tell every live pooled worker to drop the
+        finished job's function-cache entries (best effort — a worker
+        that misses the evict just pays LRU pressure later). The relay
+        futures are short-lived and self-cleaning (self._tasks holds
+        only the long-lived loops stop() must cancel)."""
+        for w in list(self.workers.values()):
+            if w.conn is not None and not w.conn.closed:
+                t = asyncio.ensure_future(
+                    self._evict_job_code(w.conn, job_hex))
+                self._relays.add(t)
+                t.add_done_callback(self._relays.discard)
+
+    async def _evict_job_code(self, conn, job_hex: str):
+        try:
+            await conn.call("evict_job_code", job_hex, timeout=10)
+        except Exception:
+            pass  # worker mid-death: nothing to evict
 
     async def _on_worker_death(self, w: _Worker):
         if w.info is not None:
@@ -615,13 +641,27 @@ class NodeManager:
 
     # --------------------------------------------------------------- leases
     async def rpc_request_lease(self, conn, arg):
-        """Grant a leased worker for `demand`, spill, or queue.
+        """Grant leased worker(s) for `demand`, spill, or queue.
 
-        Returns ("granted", WorkerInfo, lease_token) |
-                ("spillback", Address) | ("infeasible", reason)
+        Batched form (4-tuple arg ending in `count`) returns
+        ("granted", [(WorkerInfo, lease_token), ...]) with 1..count
+        grants: the first lease takes the full queue-wait path, the rest
+        are granted only as long as resources are immediately acquirable
+        — a partial batch is a backpressure signal the client answers
+        with its next (queued) request. Legacy 2/3-tuple args keep the
+        ("granted", WorkerInfo, lease_token) shape.
+        Other replies: ("spillback", Address) | ("infeasible", reason).
         """
-        demand, allow_spill, strategy = (arg if len(arg) == 3
-                                         else (*arg, None))
+        count = 1
+        batched = False
+        if len(arg) == 4:
+            demand, allow_spill, strategy, count = arg
+            batched = True
+            count = max(1, int(count))
+        elif len(arg) == 3:
+            demand, allow_spill, strategy = arg
+        else:
+            (demand, allow_spill), strategy = arg, None
         from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
                                          NodeLabelSchedulingStrategy)
 
@@ -697,15 +737,28 @@ class NodeManager:
             fut = asyncio.get_running_loop().create_future()
             self._pending_leases.append((demand, fut))
             await fut
-        try:
-            w = await self._get_idle_worker()
-        except Exception as e:
-            self._release_resources(demand)
-            self._maybe_grant_pending()
-            return ("infeasible", f"worker startup failed: {e}")
-        w.busy = True
-        w.lease_resources = dict(demand)
-        return ("granted", w.info, w.info.worker_id.hex())
+        granted: list = []
+        while True:
+            try:
+                w = await self._get_idle_worker()
+            except Exception as e:
+                self._release_resources(demand)
+                self._maybe_grant_pending()
+                if granted:
+                    break  # partial batch beats failing granted leases
+                return ("infeasible", f"worker startup failed: {e}")
+            w.busy = True
+            w.lease_resources = dict(demand)
+            granted.append((w.info, w.info.worker_id.hex()))
+            # grant further batch members only while resources are
+            # immediately acquirable — never queue mid-batch (the first
+            # lease owns the queue-wait slot; a partial grant tells the
+            # client to come back, keeping the FIFO fair across clients)
+            if len(granted) >= count or not self._try_acquire(demand):
+                break
+        if not batched:
+            return ("granted", granted[0][0], granted[0][1])
+        return ("granted", granted)
 
     def rpc_return_lease(self, conn, lease_token: str):
         wid = WorkerID.from_hex(lease_token)

@@ -47,12 +47,24 @@ class MemoryStore:
     def put(self, object_id: ObjectID, value: Any, is_exception: bool = False):
         obj = _StoredObject(value, is_exception)
         self._objects[object_id] = obj
+        # no registered async waiter (the common case: getters are on
+        # the sync fast lane or haven't arrived): skip the loop wake —
+        # an off-loop put otherwise costs a self-pipe write + a loop
+        # iteration PER completion. Safe against the register race:
+        # wait_for re-checks the store AFTER appending its future.
+        if object_id not in self._waiters:
+            return
 
         def _wake():
             for fut in self._waiters.pop(object_id, []):
                 if not fut.done():
                     fut.set_result(obj)
-        self._loop.call_soon_threadsafe(_wake)
+        # loop-affine fast path: puts from the completion path run on the
+        # store's loop — waking inline skips a self-pipe write + handle
+        if asyncio._get_running_loop() is self._loop:
+            _wake()
+        else:
+            self._loop.call_soon_threadsafe(_wake)
 
     def contains(self, object_id: ObjectID) -> bool:
         return object_id in self._objects
@@ -66,6 +78,14 @@ class MemoryStore:
             return obj
         fut = self._loop.create_future()
         self._waiters.setdefault(object_id, []).append(fut)
+        # re-check: an off-loop put between the first check and the
+        # append saw no waiter and skipped its wake
+        obj = self._objects.get(object_id)
+        if obj is not None:
+            for fut in self._waiters.pop(object_id, []):
+                if not fut.done():
+                    fut.set_result(obj)
+            return obj
         return await fut
 
     def delete(self, object_id: ObjectID):

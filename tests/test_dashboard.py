@@ -182,8 +182,13 @@ def test_tasks_endpoint_and_summary(dash_cluster):
     while time.monotonic() < deadline:
         out = json.loads(_get(port, "/api/tasks?limit=50"))
         states = {t["name"]: t["state"] for t in out["tasks"]}
-        if states.get("dash_fail") == "FAILED" and \
-                states.get("dash_ok") == "FINISHED":
+        ok_done = sum(1 for t in out["tasks"]
+                      if t["name"] == "dash_ok"
+                      and t["state"] == "FINISHED")
+        # wait for ALL terminal events, not just the first: the three
+        # dash_ok tasks may run on different workers whose event
+        # buffers flush on independent 1s timers
+        if states.get("dash_fail") == "FAILED" and ok_done == 3:
             break
         time.sleep(0.3)
     by_name = {t["name"]: t for t in out["tasks"]}
