@@ -136,6 +136,22 @@ class Config:
     # beyond it the job holding the most records evicts oldest-first,
     # with per-job dropped accounting (ref: RAY_task_events_max_num_...).
     task_events_max_tasks: int = 10000
+    # Object-plane observability (`rayt memory` / GcsObjectManager
+    # analog): node managers and workers publish object-directory /
+    # ref-breakdown deltas to the GCS on the flush cadence, puts/returns
+    # capture a creation callsite, and the worker flush loop runs the
+    # shm-leak watchdog. Disabling removes the per-put capture cost and
+    # all report traffic.
+    object_state_enabled: bool = True
+    # GCS object-manager memory bound: max coalesced object records;
+    # same per-job oldest-first eviction + dropped accounting contract
+    # as task_events_max_tasks.
+    object_state_max_objects: int = 20000
+    # A shm segment that outlived every counted ref but still holds
+    # get-pins for longer than this is flagged by the leak watchdog
+    # (pins held by live zero-copy views are legal — the flag marks
+    # ones that look forgotten, surfaced via `rayt memory` summaries).
+    object_leak_grace_s: float = 5.0
 
     # ---- logging ----
     log_level: str = "INFO"

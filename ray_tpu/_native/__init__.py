@@ -385,6 +385,53 @@ class NativeArenaStore:
         return (self._lib.rayt_shm_evictions(self._handle)
                 if self._handle else 0)
 
+    # ------------------------------------------------------ observability
+    def get_ref_counts(self) -> dict:
+        """Outstanding zero-copy get-refs held by THIS process (the pins
+        the leak watchdog inspects): oid -> refcount snapshot."""
+        with self._lock:
+            return dict(self._held)
+
+    def stats(self) -> dict:
+        """Arena snapshot for the rayt_object_store_* gauges and node
+        object reports. Reads only the C getters (shared-header counters)
+        plus a fallback-dir scan — no allocator lock taken, safe on the
+        hot path. Mirrors ShmObjectStore.stats() keys; arena "zombies"
+        are get-ref-held blocks whose entry was already deleted, which
+        the C side frees on the last release — reported via held_refs."""
+        fb_objects = 0
+        fb_bytes = 0
+        try:
+            with os.scandir(self._fallback_dir) as it:
+                for e in it:
+                    if e.name.endswith(".creating"):
+                        continue
+                    try:
+                        fb_bytes += e.stat().st_size
+                        fb_objects += 1
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        with self._lock:
+            held = len(self._held)
+            unsealed = len(self._pending) + len(self._pending_fb)
+        return {
+            "segments": 1,  # one node-scoped arena segment
+            "unsealed": unsealed,
+            "zombie_segments": 0,
+            "zombie_bytes": 0,
+            "zombies_parked_total": 0,
+            "zombies_swept_total": 0,
+            "fallback_objects": fb_objects,
+            "fallback_bytes": fb_bytes,
+            "arena_used_bytes": self.used(),
+            "arena_capacity_bytes": self.capacity(),
+            "arena_objects": self.num_objects(),
+            "arena_evictions_total": self.evictions(),
+            "held_refs": held,
+        }
+
     def close(self):
         with self._lock:
             if self._handle:

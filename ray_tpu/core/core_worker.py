@@ -45,7 +45,7 @@ from ray_tpu.core.common import (ActorDiedError, ActorState, Address,
                                  PlacementGroupSchedulingStrategy,
                                  TaskCancelledError, TaskError, TaskSpec,
                                  WorkerCrashedError, WorkerInfo)
-from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, GcsClient
+from ray_tpu.core.gcs import CH_ACTOR, CH_NODE, CH_OBJECTS, GcsClient
 from ray_tpu.core.object_ref import ObjectRef, set_core_worker
 from ray_tpu.core.device_objects import (DeviceObjectStore,
                                           deserialize_array,
@@ -76,6 +76,43 @@ def _trace_carrier():
     if not _otel.tracing_enabled():
         return None
     return _otel.current_context_carrier()
+
+
+# package root (sep-terminated: a sibling dir like .../ray_tpu_ext must
+# NOT match), for skipping our own frames during callsite capture
+_PKG_PREFIX = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+# keep per-callsite cardinality + report size bounded: last two path
+# segments, hard char cap
+_CALLSITE_CAP = 160
+
+# re-send a flagged leak's held-duration once it aged this much past the
+# last sent value, so `rayt list objects --leaked` shows a real age, not
+# the flag-time ~grace seconds frozen forever
+_LEAK_AGE_RESEND_S = 5.0
+
+
+def _capture_callsite() -> str:
+    """First stack frame outside the ray_tpu package as ``file:line``,
+    truncated to the last two path segments (ref analog: `ray memory`'s
+    call-site column, RAY_record_ref_creation_sites). Cost is a few
+    sys._getframe hops — cheap enough for the rt.put hot path; gated by
+    object_state_enabled at the call sites."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover - shallow stack
+        return ""
+    depth = 0
+    while f is not None and depth < 32:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_PREFIX):
+            parts = fn.replace("\\", "/").rsplit("/", 2)
+            short = "/".join(parts[-2:]) if len(parts) > 1 else fn
+            return f"{short}:{f.f_lineno}"[:_CALLSITE_CAP]
+        f = f.f_back
+        depth += 1
+    return ""
 
 
 def _dumps_code_now(fn) -> bytes:
@@ -373,6 +410,28 @@ class CoreWorker:
             is_owner=self._owns, free_fn=self._free_object,
             notify_owner_fn=self._notify_owner_refcount,
             release_local_fn=self._release_shm_pins)
+        # object-plane observability (`rayt memory` feed): creation
+        # callsite + timestamp per owned object, leak-watchdog state,
+        # and the last published report for delta computation
+        self._object_state_enabled = get_config().object_state_enabled
+        self._object_sites: dict[ObjectID, tuple[str, float]] = {}
+        self._leak_since: dict[ObjectID, float] = {}
+        self._leaked: set[ObjectID] = set()
+        self._obj_report_last: dict = {"refs": {}, "pins": {}, "leaks": {}}
+        # bumped by the reconnect-reset: a baseline built BEFORE a GCS
+        # restart must not be committed after it (the restarted store
+        # is empty — stale baselines suppress the full re-send)
+        self._obj_report_epoch = 0
+        # owner-meta mutation counter (sites/sizes recorded at put /
+        # task completion / free): with the refcounter version, lets an
+        # idle flush tick skip the O(owned-objects) snapshot rebuild
+        self._obj_meta_version = 0
+        # shm args of CURRENTLY-EXECUTING task bodies: their get-pins
+        # are counted at the SUBMITTER, not here, so the watchdog must
+        # treat them as healthy (a 5s+ training step would otherwise
+        # flag every big arg as a leak). oid -> executing-body count.
+        self._arg_pins: collections.Counter = collections.Counter()
+        self._arg_pins_lock = threading.Lock()
         self.root_task_id = TaskID.for_normal_task(job_id)
         self._exec_ctx = _ExecutionContext()
         self._put_index = 0
@@ -648,6 +707,10 @@ class CoreWorker:
                 self._spawn(sub.on_actor_update(info))
 
         await self.gcs.subscribe(CH_ACTOR, on_actor_event)
+        # a restarted GCS has an EMPTY object manager: reset the delta
+        # baseline so the next flush re-sends this process's full
+        # object state (node managers do the same on re-register)
+        self.gcs.on_reconnect.append(self._reset_object_report_baseline)
         self._spawn(self._task_event_flush_loop())
         if self.mode == "worker":
             await self.node_conn.call(
@@ -801,7 +864,24 @@ class CoreWorker:
     def _free_object(self, oid: ObjectID):
         self._release_shm_pins(oid)
         self.memory_store.delete(oid)
+        self._object_sites.pop(oid, None)
+        self._obj_meta_version += 1
         meta = self.object_meta.pop(oid, None)
+        if meta is not None and meta.in_shm:
+            # drop THIS process's cached store mapping too: the
+            # fallback store's create path caches one that no _ShmGetPin
+            # tracks, so without this the creator keeps the segment
+            # mapped for its whole lifetime after the last ref died —
+            # exactly the drift the leak watchdog flags. Store-specific
+            # API: the native arena must NOT release here (its get-refs
+            # belong to live zero-copy views; fallback mappings park as
+            # zombies under live views, so dropping is always safe).
+            drop = getattr(self.shm, "drop_cached_mapping", None)
+            if drop is not None:
+                try:
+                    drop(oid)
+                except Exception:
+                    pass
         # Lineage retention (ref: task_manager.h:212 lineage pinning): the
         # VALUE is freed, but a reconstructable task's spec is kept so a
         # downstream task that lost its own output can transitively
@@ -913,7 +993,16 @@ class CoreWorker:
             self._put_index += 1
             idx = self._put_index
         oid = ObjectID.for_put(self.current_task_id(), idx)
-        self._store_owned_value(oid, value)
+        if self._object_state_enabled:
+            # recorded BEFORE the store (the announce reads the site);
+            # popped on failure or the entry would leak — _free_object,
+            # the normal cleanup, never runs without an ObjectRef
+            self._object_sites[oid] = (_capture_callsite(), time.time())
+        try:
+            self._store_owned_value(oid, value)
+        except BaseException:
+            self._object_sites.pop(oid, None)
+            raise
         return ObjectRef(oid, self.worker_info)
 
     def put_device(self, value: Any) -> ObjectRef:
@@ -930,7 +1019,13 @@ class CoreWorker:
             self._put_index += 1
             idx = self._put_index
         oid = ObjectID.for_put(self.current_task_id(), idx)
-        self.device_store.put(oid, value)
+        if self._object_state_enabled:
+            self._object_sites[oid] = (_capture_callsite(), time.time())
+        try:
+            self.device_store.put(oid, value)
+        except BaseException:
+            self._object_sites.pop(oid, None)
+            raise
         self.object_meta[oid] = ObjectMeta(
             oid, size=getattr(value, "nbytes", -1), in_device=True,
             holder=self.worker_info, node_ids=[self.node_id])
@@ -957,10 +1052,13 @@ class CoreWorker:
                               node_ids=[self.node_id])
             self.object_meta[oid] = meta
 
-            async def _announce(oid=oid, size=size):
+            site = self._object_sites.get(oid, ("", 0.0))[0]
+
+            async def _announce(oid=oid, size=size, site=site):
                 try:
                     await self.node_conn.call(
-                        "object_created", (oid, size, self.worker_info))
+                        "object_created",
+                        (oid, size, self.worker_info, site))
                 finally:
                     self._release_create_ref(oid)
 
@@ -2442,6 +2540,12 @@ class CoreWorker:
                 _, size = entry
                 self.object_meta[oid] = ObjectMeta(
                     oid, size=size, in_shm=True, node_ids=[winfo.node_id])
+            if self._object_state_enabled and oid not in self._object_sites:
+                # owner-side attribution for task returns: the submit
+                # site isn't reachable here, so the task NAME is the
+                # callsite (matches the node directory's "task:<name>")
+                self._object_sites[oid] = (f"task:{spec.name}", time.time())
+            self._obj_meta_version += 1  # size/site now known
             self._signal_object_ready(oid)
             self._wake_sync_waiter(oid)
         if pt is not None:
@@ -2758,7 +2862,8 @@ class CoreWorker:
                 await self._shm_create_async(oid, chunks, size)
                 try:
                     await self.node_conn.call(
-                        "object_created", (oid, size, spec.owner))
+                        "object_created",
+                        (oid, size, spec.owner, f"task:{spec.name}"))
                 finally:
                     self._release_create_ref(oid)
                 entry = ("shm", size, self.node_id)
@@ -3039,11 +3144,12 @@ class CoreWorker:
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
         restore_env = None
+        held_args: list = []
         try:
             restore_env = self._apply_runtime_env(spec)
             fn = self._resolve_function(spec)
-            args = self._resolve_args(spec.args)
-            kwargs = self._resolve_args(spec.kwargs)
+            args = self._resolve_args(spec.args, hold=held_args)
+            kwargs = self._resolve_args(spec.kwargs, hold=held_args)
             result = fn(*args, **kwargs)
             if spec.num_returns == -1:
                 return self._stream_returns(spec, result)
@@ -3053,6 +3159,7 @@ class CoreWorker:
             self._emit_task_failed(spec, e, tb)
             return ("task_error", serialize_to_bytes(e), tb)
         finally:
+            self._release_arg_pins(held_args)
             if restore_env is not None:
                 try:
                     restore_env()
@@ -3061,16 +3168,36 @@ class CoreWorker:
             self._exec_ctx.task_id = None
             self._exec_ctx.job_id = None
 
-    def _resolve_args(self, args):
+    def _resolve_args(self, args, hold: list | None = None):
+        """Resolve RefArg placeholders to values. `hold` (a list the
+        caller later passes to _release_arg_pins in its finally) marks
+        the resolved oids as executing-task args so the leak watchdog
+        doesn't flag their zero-copy pins — the counted ref lives at
+        the SUBMITTER, not in this process."""
+        def one(v):
+            if not isinstance(v, RefArg):
+                return v
+            if hold is not None:
+                hold.append(v.object_id)
+                with self._arg_pins_lock:
+                    self._arg_pins[v.object_id] += 1
+            return self.get([ObjectRef(v.object_id, v.owner,
+                                       _add_local_ref=False)])[0]
+
         if isinstance(args, dict):
-            return {k: (self.get([ObjectRef(v.object_id, v.owner,
-                                            _add_local_ref=False)])[0]
-                        if isinstance(v, RefArg) else v)
-                    for k, v in args.items()}
-        return [self.get([ObjectRef(v.object_id, v.owner,
-                                    _add_local_ref=False)])[0]
-                if isinstance(v, RefArg) else v
-                for v in args]
+            return {k: one(v) for k, v in args.items()}
+        return [one(v) for v in args]
+
+    def _release_arg_pins(self, oids: list):
+        if not oids:
+            return
+        with self._arg_pins_lock:
+            for oid in oids:
+                n = self._arg_pins.get(oid, 0)
+                if n <= 1:
+                    self._arg_pins.pop(oid, None)
+                else:
+                    self._arg_pins[oid] = n - 1
 
     def _package_returns(self, spec: TaskSpec, result):
         cfg = get_config()
@@ -3105,7 +3232,8 @@ class CoreWorker:
                 self._shm_create_blocking(oid, chunks, size)
                 try:
                     self.io.run(self.node_conn.call(
-                        "object_created", (oid, size, spec.owner)))
+                        "object_created",
+                        (oid, size, spec.owner, f"task:{spec.name}")))
                 finally:
                     self._release_create_ref(oid)
                 out.append(("shm", size))
@@ -3136,11 +3264,12 @@ class CoreWorker:
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
         self._emit_task_event(spec, "RUNNING")
+        held_args: list = []
         try:
             self._apply_runtime_env(spec)
             cls = self._resolve_function(spec)
-            args = self._resolve_args(spec.args)
-            kwargs = self._resolve_args(spec.kwargs)
+            args = self._resolve_args(spec.args, hold=held_args)
+            kwargs = self._resolve_args(spec.kwargs, hold=held_args)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = spec.actor_id
             # async actors: methods that are coroutines (or async gens)
@@ -3158,6 +3287,7 @@ class CoreWorker:
             self._emit_task_failed(spec, e, tb)
             return tb
         finally:
+            self._release_arg_pins(held_args)
             self._exec_ctx.task_id = None
             self._exec_ctx.job_id = None
 
@@ -3211,10 +3341,11 @@ class CoreWorker:
                 task_id=spec.task_id.hex(),
                 actor_id=(self.actor_id.hex()
                           if self.actor_id else "")) as sp:
+            held_args: list = []
             try:
                 method = getattr(self.actor_instance, spec.method_name)
-                args = self._resolve_args_async(spec.args)
-                kwargs = self._resolve_args_async(spec.kwargs)
+                args = self._resolve_args_async(spec.args, held_args)
+                kwargs = self._resolve_args_async(spec.kwargs, held_args)
                 if spec.num_returns == -1 and \
                         inspect.isasyncgenfunction(method):
                     out = await self._stream_returns_async(
@@ -3235,14 +3366,15 @@ class CoreWorker:
                 self._emit_task_failed(spec, e, tb)
                 return ("task_error", serialize_to_bytes(e), tb)
             finally:
+                self._release_arg_pins(held_args)
                 self._exec_ctx.task_id = None
                 self._exec_ctx.job_id = None
 
-    def _resolve_args_async(self, args):
+    def _resolve_args_async(self, args, hold: list | None = None):
         # async path: refs resolved via blocking get on a worker thread would
         # deadlock the actor loop only if it waited on itself; args are
         # resolved eagerly here via the IO loop (cheap for inline objects).
-        return self._resolve_args(args)
+        return self._resolve_args(args, hold=hold)
 
     def _execute_actor_task(self, spec: TaskSpec):
         # threaded actors (max_concurrency>1) must let bodies overlap —
@@ -3274,6 +3406,7 @@ class CoreWorker:
     def _execute_actor_task_body(self, spec: TaskSpec):
         self._exec_ctx.task_id = spec.task_id
         self._exec_ctx.job_id = spec.job_id
+        held_args: list = []
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
@@ -3288,8 +3421,8 @@ class CoreWorker:
             if method is None:
                 raise AttributeError(
                     f"actor has no method {spec.method_name!r}")
-            args = self._resolve_args(spec.args)
-            kwargs = self._resolve_args(spec.kwargs)
+            args = self._resolve_args(spec.args, hold=held_args)
+            kwargs = self._resolve_args(spec.kwargs, hold=held_args)
             result = method(*args, **kwargs)
             if spec.num_returns == -1:
                 return self._stream_returns(spec, result)
@@ -3299,6 +3432,7 @@ class CoreWorker:
             self._emit_task_failed(spec, e, tb)
             return ("task_error", serialize_to_bytes(e), tb)
         finally:
+            self._release_arg_pins(held_args)
             self._exec_ctx.task_id = None
             self._exec_ctx.job_id = None
 
@@ -3310,6 +3444,26 @@ class CoreWorker:
             # piggyback: release shm get-pins whose last holder died on a
             # thread that couldn't drain (reentrant/contended at the time)
             self._drain_pin_events()
+            if self._object_state_enabled:
+                try:
+                    self._leak_watchdog_tick()
+                    built = self._build_object_report()
+                    if built is not None:
+                        report, new_baseline = built
+                        epoch = self._obj_report_epoch
+                        await self.gcs.publish(CH_OBJECTS, report)
+                        # commit the delta baseline only once the
+                        # publish lands — a dropped send must be
+                        # retried next tick, or a refs_removed delta
+                        # would be lost forever and the GCS record
+                        # never freed. Epoch check: a GCS restart
+                        # during the await reset the baseline (the new
+                        # store is empty); committing over that reset
+                        # would suppress the full re-send.
+                        if epoch == self._obj_report_epoch:
+                            self._obj_report_last = new_baseline
+                except Exception:
+                    pass  # observability is best-effort
             events = self.task_events.drain()
             if not events:
                 continue
@@ -3317,6 +3471,133 @@ class CoreWorker:
                 await self.gcs.call("add_task_events", events)
             except Exception:
                 pass  # dropped on GCS hiccup: tracing is best-effort
+
+    # ------------------------------------------- object-plane observability
+    def _reset_object_report_baseline(self):
+        self._obj_report_epoch += 1
+        self._obj_report_last = {"refs": {}, "pins": {}, "leaks": {}}
+
+    def _held_get_refs(self) -> dict[ObjectID, int]:
+        """This process's outstanding zero-copy get-pins (store-level
+        truth: mappings cached / arena get-refs held)."""
+        getter = getattr(self.shm, "get_ref_counts", None)
+        if getter is None:
+            return {}
+        try:
+            return getter()
+        except Exception:
+            return {}
+
+    def _leak_watchdog_tick(self):
+        """Flag shm segments that outlived every counted ref but still
+        hold get-pins past the grace window (PR-4's pin contract, now
+        watchable in production instead of assert-only). A pin held by a
+        live zero-copy view is LEGAL — the flag marks ones that look
+        forgotten; it clears the moment the pin actually drops (or a
+        counted ref reappears)."""
+        held = self._held_get_refs()
+        now = time.monotonic()
+        grace = get_config().object_leak_grace_s
+        for oid in held:
+            if self.reference_counter.has_record(oid) \
+                    or oid in self._arg_pins:
+                # counted ref exists (or the pin belongs to a currently
+                # -executing task body's arg — its ref lives at the
+                # submitter): healthy pin, reset any timer
+                self._leak_since.pop(oid, None)
+                self._leaked.discard(oid)
+                continue
+            t0 = self._leak_since.setdefault(oid, now)
+            if now - t0 >= grace and oid not in self._leaked:
+                self._leaked.add(oid)
+                logger.warning(
+                    "shm leak watchdog: %s held by get-pins %.1fs past "
+                    "its last counted ref (grace %.1fs)", oid,
+                    now - t0, grace)
+                if _bm is not None:
+                    try:
+                        _bm.object_leaks_flagged.inc()
+                    except Exception:
+                        pass
+        # pins that dropped: clear timers + flags (the report's
+        # leaks_cleared delta tells the GCS to unflag)
+        for oid in list(self._leak_since):
+            if oid not in held:
+                self._leak_since.pop(oid, None)
+                self._leaked.discard(oid)
+
+    def _build_object_report(self) -> tuple[dict, dict] | None:
+        """Delta-encode this process's object state for the GCS object
+        manager: the owner-side ReferenceCounter breakdown (with size /
+        callsite / created-at attribution), outstanding get-pins, and
+        leak-watchdog flags. Returns (report, new_baseline) — the
+        CALLER commits the baseline after a successful publish — or
+        None when nothing changed since the last published report."""
+        held = self._held_get_refs()
+        now = time.monotonic()
+        pins = {oid.hex(): n for oid, n in held.items()}
+        leaks = {oid.hex(): now - self._leak_since.get(oid, now)
+                 for oid in self._leaked}
+        last = self._obj_report_last
+        versions = (self.reference_counter.version,
+                    self._obj_meta_version)
+        leaks_stale = (leaks.keys() != last["leaks"].keys()
+                       or any(v - last["leaks"][k] >= _LEAK_AGE_RESEND_S
+                              for k, v in leaks.items()))
+        if versions == last.get("versions") and pins == last["pins"] \
+                and not leaks_stale:
+            # idle tick: no ref/meta mutation, same pins, same flags —
+            # skip the O(owned-objects) snapshot + dict rebuild
+            return None
+        snap = self.reference_counter.debug_snapshot()
+        refs: dict[str, dict] = {}
+        for oid, rec in snap.items():
+            if not rec["owned"]:
+                continue
+            meta = self.object_meta.get(oid)
+            site, created = self._object_sites.get(oid, ("", 0.0))
+            refs[oid.hex()] = {
+                "local": rec["local"], "borrowers": rec["borrowers"],
+                "task_pins": rec["task_pins"], "escaped": rec["escaped"],
+                "size": meta.size if meta is not None else -1,
+                "inline": bool(meta.inline) if meta is not None else False,
+                "callsite": site, "created_at": created,
+                "job": oid.job_id().hex(),
+            }
+        changed_refs = {k: v for k, v in refs.items()
+                        if last["refs"].get(k) != v}
+        refs_removed = [k for k in last["refs"] if k not in refs]
+        changed_pins = {k: v for k, v in pins.items()
+                        if last["pins"].get(k) != v}
+        pins_removed = [k for k in last["pins"] if k not in pins]
+        # new flags always travel; existing ones re-send once their age
+        # advanced enough to matter (so the GCS shows a real duration)
+        changed_leaks = {
+            k: v for k, v in leaks.items()
+            if k not in last["leaks"]
+            or v - last["leaks"][k] >= _LEAK_AGE_RESEND_S}
+        leaks_cleared = [k for k in last["leaks"] if k not in leaks]
+        if not (changed_refs or refs_removed or changed_pins
+                or pins_removed or changed_leaks or leaks_cleared):
+            # versions moved but the visible state is identical (e.g. a
+            # ref added and dropped between ticks): record the versions
+            # so the next idle tick takes the cheap exit
+            self._obj_report_last = dict(last, versions=versions)
+            return None
+        report = {
+            "kind": "worker", "worker": self.worker_id.hex(),
+            "node": self.node_id.hex(), "ts": time.time(),
+            "refs": changed_refs, "refs_removed": refs_removed,
+            "pins": changed_pins, "pins_removed": pins_removed,
+            "leaks": changed_leaks, "leaks_cleared": leaks_cleared,
+        }
+        # the baseline keeps the ages actually SENT (not the freshly
+        # computed ones) so the next age-resend measures from the last
+        # value the GCS saw
+        sent_leaks = {k: changed_leaks.get(k, last["leaks"].get(k, v))
+                      for k, v in leaks.items()}
+        return report, {"refs": refs, "pins": pins, "leaks": sent_leaks,
+                        "versions": versions}
 
     def rpc_exit_worker(self, conn, arg=None):
         def _die():

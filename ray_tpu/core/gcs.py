@@ -29,8 +29,12 @@ from ray_tpu.util.metrics import CH_METRICS
 
 logger = setup_logger("gcs")
 
-# Pubsub channel names (CH_METRICS is canonical in util/metrics.py — the
-# emit side owns it; re-exported here next to its siblings)
+# Pubsub channel names (CH_METRICS is canonical in util/metrics.py,
+# CH_OBJECTS in core/gcs_object_manager.py — the owning side defines
+# them; re-exported here next to their siblings)
+from ray_tpu.core.gcs_object_manager import (CH_OBJECTS,  # noqa: E402
+                                             GcsObjectManager)
+
 CH_NODE = "node_events"          # {"event": "added"|"removed", "node": NodeInfo}
 CH_ACTOR = "actor_events"        # ActorInfo
 CH_ERROR = "error_events"
@@ -99,6 +103,10 @@ class GcsServer:
         self.task_manager = GcsTaskManager(
             max_tasks=cfg0.task_events_max_tasks)
         self._task_events_enabled = cfg0.task_events_enabled
+        # object-plane state store fed by the `object_state` pubsub
+        # channel (ref: gcs_object_manager.h / `ray memory` aggregation)
+        self.object_manager = GcsObjectManager(
+            max_objects=cfg0.object_state_max_objects)
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -325,6 +333,8 @@ class GcsServer:
                 self.metrics_store.ingest_many(message)
             else:
                 self.metrics_store.ingest(message)
+        elif channel == CH_OBJECTS:
+            self.object_manager.ingest(message)
         dead = []
         # snapshot: the notify below awaits, and a concurrent subscribe /
         # connection-close discard mutating the live set mid-iteration
@@ -504,6 +514,9 @@ class GcsServer:
         conn = self.node_conns.pop(node_id, None)
         self.node_resources_available.pop(node_id, None)
         self._mark_resource_change(node_id)
+        # the dead node's object directory + its workers' ref reports
+        # will never send removal deltas: purge them now
+        self.object_manager.on_node_dead(node_id.hex())
         self.mark_dirty()
         logger.warning("node %s lost (conn: %s)", node_id,
                        getattr(conn, "close_reason", "") or "untracked")
@@ -613,6 +626,8 @@ class GcsServer:
             self.jobs[job_id]["status"] = "FINISHED"
             self.jobs[job_id]["end_time"] = now()
             self.mark_dirty()
+        # the exiting driver owns the job's objects: drop their records
+        self.object_manager.on_job_finished(job_id.hex())
         # node managers relay this to their pooled workers, which drop
         # the finished job's function-table entries (pooled workers
         # outlive jobs; see core/function_table.py evict_job)
@@ -1014,6 +1029,18 @@ class GcsServer:
         counts + scheduling-vs-execution latency split."""
         return self.task_manager.summarize(**dict(arg or {}))
 
+    def rpc_list_objects_state(self, conn, arg=None):
+        """State API `list_objects` backend: filtered coalesced object
+        records (job / node / callsite / leaked, limit) from the object
+        manager — server-side, no full-store dump to the client."""
+        return self.object_manager.list(**dict(arg or {}))
+
+    def rpc_summarize_objects(self, conn, arg=None):
+        """State API `summarize_objects` backend: per-callsite and
+        per-node memory rollups + store stats + leak flags (`rayt
+        memory`'s data source)."""
+        return self.object_manager.summarize(**dict(arg or {}))
+
     def rpc_metrics_snapshot(self, conn, arg=None):
         return self.metrics_store.snapshot()
 
@@ -1112,6 +1139,11 @@ class GcsClient:
         self.conn = conn
         self.address = address
         self._subs: dict[str, list] = {}
+        # called (no args, on the reconnect loop) after a successful
+        # redial + subscription replay: lets delta publishers reset
+        # their baselines — the restarted GCS's stores are empty, so
+        # unchanged state must be re-sent in full
+        self.on_reconnect: list = []
         self._closing = False
         # stable identity for the server's per-client dedup tables
         self._client_id = uuid.uuid4().hex
@@ -1155,6 +1187,11 @@ class GcsClient:
                     await conn.call("subscribe", ch)
                 except Exception:
                     pass
+            for cb in list(self.on_reconnect):
+                try:
+                    cb()
+                except Exception:
+                    pass
             logger.info("GCS client reconnected")
             return
 
@@ -1167,6 +1204,7 @@ class GcsClient:
         "actor_handle_state", "get_placement_group", "metrics_snapshot",
         "metrics_names", "metrics_query",
         "get_task_events", "list_tasks", "summarize_tasks",
+        "list_objects_state", "summarize_objects",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU

@@ -28,6 +28,7 @@ from ray_tpu._internal.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import Connection, RawView, RpcServer, connect
 from ray_tpu.core.common import Address, NodeInfo, TaskSpec, WorkerInfo
+from ray_tpu.core.gcs_object_manager import CH_OBJECTS
 from ray_tpu.core.object_store import make_shm_store
 
 logger = setup_logger("node_manager")
@@ -274,6 +275,16 @@ class NodeManager:
         import threading
 
         self._spill_lock = threading.Lock()
+        # object-plane observability: last-published directory snapshot
+        # + store stats for delta publishes on the heartbeat cadence
+        self._object_state_enabled = get_config().object_state_enabled
+        self._objects_published: dict[str, dict] = {}
+        self._store_stats_published: dict | None = None
+        self._store_stats_cache: tuple[float, dict | None] = (0.0, None)
+        # set by every object_dir mutation: the publisher only rebuilds
+        # + diffs the directory view when something actually changed
+        # (an idle tick stays O(1) instead of O(objects))
+        self._objects_dirty = True
 
     # ------------------------------------------------------------ lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
@@ -339,6 +350,7 @@ class NodeManager:
                 await self._push_heartbeat()
                 await self._refresh_view()
                 await self._publish_node_metrics()
+                await self._publish_object_state()
                 await self._flush_task_events()
             except Exception:
                 if self.gcs_conn is not None and self.gcs_conn.closed \
@@ -370,10 +382,102 @@ class NodeManager:
             num_workers=len(self.workers),
             object_store_bytes=store_bytes,
             object_store_capacity=store_cap, ts=t)
+        if self._object_state_enabled:
+            from ray_tpu.util.builtin_metrics import \
+                object_store_gauge_records
+
+            try:
+                recs.extend(object_store_gauge_records(
+                    self.node_id.hex(), self._store_stats(), ts=t))
+            except Exception:
+                pass
         try:
             await self.gcs_conn.call("publish", (CH_METRICS, recs))
         except Exception:
             pass  # metrics are best-effort; heartbeats carry liveness
+
+    # --------------------------------------------- object-state reporting
+    def _store_stats(self) -> dict:
+        """Store-level snapshot for the object report + Prometheus
+        gauges: directory-derived byte totals plus the store's own
+        segment/zombie/fallback counters (ShmObjectStore.stats /
+        NativeArenaStore.stats). Cached briefly — the metrics publisher
+        and the object-state publisher both read it each heartbeat
+        tick, and the arena's fallback-dir scan stats every file."""
+        t = time.monotonic()
+        cached_at, cached = self._store_stats_cache
+        if cached is not None and t - cached_at < 0.5:
+            return cached
+        stats = {
+            "capacity_bytes": self._store_capacity(),
+            "used_bytes": self._unspilled_bytes(),
+            "pinned_bytes": sum(
+                m.get("size", 0) for m in list(self.object_dir.values())
+                if m.get("pinned") and not m.get("spilled")),
+            "spilled_bytes": self._spilled_bytes,
+            "num_objects": len(self.object_dir),
+            "num_spilled": self._spill_count,
+            "num_restored": self._restore_count,
+        }
+        snap = getattr(self.shm, "stats", None)
+        if snap is not None:
+            try:
+                stats.update(snap())
+            except Exception:
+                pass
+        self._store_stats_cache = (t, stats)
+        return stats
+
+    def _object_report(self) -> dict[str, dict]:
+        """Current object-directory view keyed by oid hex (the unit the
+        delta publisher diffs)."""
+        out: dict[str, dict] = {}
+        for oid, meta in list(self.object_dir.items()):
+            owner = meta.get("owner")
+            out[oid.hex()] = {
+                "size": meta.get("size", 0),
+                "job": oid.job_id().hex(),
+                "owner": owner.worker_id.hex() if owner is not None else "",
+                "spilled": bool(meta.get("spilled")),
+                "pinned": bool(meta.get("pinned")),
+                "callsite": meta.get("callsite", ""),
+                "created_at": meta.get("created_at", 0.0),
+            }
+        return out
+
+    async def _publish_object_state(self):
+        """Ship object-directory deltas + store stats to the GCS object
+        manager over the shared pubsub channel (ref analog: the raylet
+        reporting local object info to gcs_object_manager.h). Rides the
+        heartbeat cadence; an idle directory publishes nothing."""
+        if not self._object_state_enabled:
+            return
+        stats = self._store_stats()
+        if not self._objects_dirty \
+                and stats == self._store_stats_published:
+            return
+        # clear BEFORE building: a directory mutation that lands during
+        # the publish await re-sets the flag and republishes next tick
+        # (clearing after the await would eat that mutation whenever the
+        # store stats happen to be byte-identical)
+        self._objects_dirty = False
+        cur = self._object_report()
+        changed = {k: v for k, v in cur.items()
+                   if self._objects_published.get(k) != v}
+        removed = [k for k in self._objects_published if k not in cur]
+        if not changed and not removed \
+                and stats == self._store_stats_published:
+            return
+        msg = {"kind": "node", "node": self.node_id.hex(),
+               "ts": time.time(), "objects": changed, "removed": removed,
+               "store": stats}
+        try:
+            await self.gcs_conn.call("publish", (CH_OBJECTS, msg))
+        except Exception:
+            self._objects_dirty = True  # delta not delivered: retry
+            raise
+        self._objects_published = cur
+        self._store_stats_published = stats
 
     async def _flush_task_events(self):
         events = self.task_events.drain()
@@ -420,6 +524,10 @@ class NodeManager:
             self._view_version = 0
             self._hb_last_sent = None
             self._cluster_view = {}
+            # the restarted GCS's object manager is empty: resend the
+            # full directory on the next heartbeat, not just deltas
+            self._objects_published = {}
+            self._store_stats_published = None
             logger.info("re-registered with restarted GCS")
         except Exception:
             pass
@@ -471,6 +579,16 @@ class NodeManager:
                     (w.actor_id,
                      f"worker process exited with code {w.proc.returncode}",
                      w.info.worker_id if w.info else None))
+            except Exception:
+                pass
+        if self._object_state_enabled and w.info is not None:
+            # the dead worker's published get-pins/leak flags will never
+            # see removal deltas: tell the GCS object manager directly
+            try:
+                await self.gcs_conn.call(
+                    "publish", (CH_OBJECTS, {
+                        "kind": "worker_dead",
+                        "worker": w.info.worker_id.hex()}))
             except Exception:
                 pass
         logger.warning("worker %s died (code %s)",
@@ -1022,6 +1140,7 @@ class NodeManager:
             meta["spilled"] = path
             self._spilled_bytes += meta["size"]
             self._spill_count += 1
+            self._objects_dirty = True
         logger.info("spilled %s (%d bytes) to %s",
                     victim, meta["size"], path)
 
@@ -1134,6 +1253,7 @@ class NodeManager:
             pass
         meta["spilled"] = None
         self._restore_count += 1
+        self._objects_dirty = True
         return True
 
     async def rpc_restore_object(self, conn, oid: ObjectID):
@@ -1203,7 +1323,12 @@ class NodeManager:
 
     # ------------------------------------------------------ object directory
     def rpc_object_created(self, conn, arg):
-        object_id, size, owner = arg
+        # 4-tuple carries the creation callsite (env-gated capture at
+        # rt.put / task returns); legacy 3-tuple stays accepted
+        if len(arg) == 4:
+            object_id, size, owner, callsite = arg
+        else:
+            (object_id, size, owner), callsite = arg, ""
         # pin the primary copy: LRU eviction must not race the spill loop
         # (ref: plasma pins primaries; spilling is the only reclaim path)
         pinned = False
@@ -1212,13 +1337,17 @@ class NodeManager:
         except Exception:
             pass
         self.object_dir[object_id] = {"size": size, "owner": owner,
-                                      "pinned": pinned}
+                                      "pinned": pinned,
+                                      "callsite": callsite or "",
+                                      "created_at": time.time()}
+        self._objects_dirty = True
         return True
 
     def rpc_object_lookup(self, conn, object_id: ObjectID):
         return self.object_dir.get(object_id)
 
     def rpc_free_object(self, conn, object_id: ObjectID):
+        self._objects_dirty = True
         meta = self.object_dir.pop(object_id, None)
         if meta is not None and meta.get("spilled"):
             try:
@@ -1318,6 +1447,7 @@ class NodeManager:
         # pulled SECONDARY copy: not pinned (evictable; the primary or its
         # spill file elsewhere remains the durable copy)
         self.object_dir[object_id] = {"size": size, "owner": owner}
+        self._objects_dirty = True
 
     def _prepare_pull_segment(self, object_id: ObjectID, size: int) -> bool:
         """Allocate the (unsealed) destination for a streamed pull,
@@ -1332,6 +1462,7 @@ class NodeManager:
     def _finish_pull_segment(self, object_id: ObjectID, size: int, owner):
         self.shm.seal(object_id)
         self.object_dir[object_id] = {"size": size, "owner": owner}
+        self._objects_dirty = True
 
     async def rpc_store_remote_object(self, conn, arg):
         """Pull `object_id` from another node's manager into local shm —
@@ -1352,6 +1483,7 @@ class NodeManager:
                 "size": meta.get("size", 0),
                 "spilled": bool(meta.get("spilled")),
                 "pinned": bool(meta.get("pinned")),
+                "callsite": meta.get("callsite", ""),
                 "owner_worker": (owner.worker_id.hex()
                                  if owner is not None else None),
             })

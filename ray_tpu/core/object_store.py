@@ -27,6 +27,19 @@ from typing import Any
 from ray_tpu._internal.ids import ObjectID
 from ray_tpu._internal.serialization import deserialize, serialize, serialized_size
 
+_logger = None
+
+
+def _log():
+    # lazy: setup_logger pulls config; this module is imported by every
+    # process before config is necessarily finalized
+    global _logger
+    if _logger is None:
+        from ray_tpu._internal.logging_utils import setup_logger
+
+        _logger = setup_logger("object_store")
+    return _logger
+
 
 class _StoredObject:
     __slots__ = ("value", "is_exception")
@@ -130,6 +143,10 @@ class ShmObjectStore:
         # GC firing ObjectRef.__del__ can re-enter the release path on
         # the same thread mid-critical-section
         self._map_lock = threading.RLock()
+        # zombie lifecycle accounting for the observability layer:
+        # parked = close() refused by live views, swept = later reclaimed
+        self._zombies_parked = 0
+        self._zombies_swept = 0
 
     def create_and_seal(self, object_id: ObjectID, value: Any) -> int:
         chunks = serialize(value)
@@ -255,7 +272,14 @@ class ShmObjectStore:
                 pass
 
     def release_create_ref(self, object_id: ObjectID):
-        pass
+        """Drop the creation mapping cached by create_from_chunks(
+        hold=True): the segment is sealed and announced by now, and an
+        executor keeping it would (a) leak the mapping until process
+        exit and (b) read as a get-pin to the leak watchdog — every shm
+        task return would be falsely flagged once the grace window
+        passed, since the SUBMITTER owns the ref, not the executor. A
+        later local get simply reopens the still-named segment."""
+        self.release(object_id)
 
     def pin(self, object_id: ObjectID) -> bool:
         return True
@@ -287,13 +311,19 @@ class ShmObjectStore:
             except FileNotFoundError:
                 pass
             return False
+        # probe WITHOUT caching: a cached mapping counts as a get-pin
+        # (get_ref_counts), so a mere existence check — rt.wait from a
+        # borrower that never gets the value — would otherwise hold the
+        # segment forever and read as a watchdog leak. The probe handle
+        # closes immediately (no view can have been exported from it,
+        # so no orphan; actual reads cache via _mapping under the lock).
         try:
-            # open-and-cache through _mapping so a concurrent get_view
-            # can't double-open the segment and orphan one mapping
-            self._mapping(object_id)
-            return True
+            shm = shared_memory.SharedMemory(name=_shm_name(object_id))
         except FileNotFoundError:
             return False
+        _unregister_tracker(shm)
+        shm.close()
+        return True
 
     def _mapping(self, object_id: ObjectID) -> shared_memory.SharedMemory:
         with self._map_lock:
@@ -311,8 +341,16 @@ class ShmObjectStore:
         """Zero-copy view of the sealed payload. The mapping is cached
         (the pin): it stays open until release(), and release() keeps it
         open for as long as any exported view is alive (BufferError
-        tolerance). Raises FileNotFoundError if the segment is gone."""
-        return self._mapping(object_id).buf[:size]
+        tolerance). Raises FileNotFoundError if the segment is gone.
+
+        The slice happens under _map_lock: release_create_ref (announce
+        path) can release the creator's mapping concurrently with a
+        get, and a close between _mapping() returning and .buf being
+        sliced would hand back a dead buffer. Under the lock either the
+        slice lands first (close then BufferError-parks as a zombie) or
+        the release landed first and _mapping reopens fresh."""
+        with self._map_lock:
+            return self._mapping(object_id).buf[:size]
 
     def get(self, object_id: ObjectID, size: int) -> Any:
         """Zero-copy deserialize; the mapping stays cached so buffer views
@@ -320,7 +358,9 @@ class ShmObjectStore:
         return deserialize(self.get_view(object_id, size))
 
     def read_bytes(self, object_id: ObjectID, size: int) -> bytes:
-        return bytes(self._mapping(object_id).buf[:size])
+        with self._map_lock:  # see get_view: slice races release paths
+            view = self._mapping(object_id).buf[:size]
+        return bytes(view)
 
     def read_range_view(self, object_id: ObjectID, size: int, offset: int,
                         length: int):
@@ -328,7 +368,9 @@ class ShmObjectStore:
         chunk aliases the cached mapping, no copy. release_cb is None —
         the mapping stays cached (same lifetime as every other read) and
         unlink's BufferError tolerance covers views still in flight."""
-        return self._mapping(object_id).buf[offset:offset + length], None
+        with self._map_lock:  # see get_view: slice races release paths
+            return (self._mapping(object_id).buf[offset:offset + length],
+                    None)
 
     @staticmethod
     def _silence_del(shm: shared_memory.SharedMemory):
@@ -340,6 +382,17 @@ class ShmObjectStore:
         reclaim them once their views die."""
         shm.close = lambda: None  # type: ignore[method-assign]
 
+    def _park_zombie(self, shm: shared_memory.SharedMemory):
+        """Record a mapping whose close() was refused by live views; the
+        sweep reclaims it once they die. Counted + named at DEBUG so a
+        store that accumulates zombies is diagnosable from logs and the
+        rayt_object_store_zombie_* gauges instead of failing silently."""
+        with self._map_lock:
+            self._zombies.append(shm)
+            self._zombies_parked += 1
+        _log().debug("segment %s parked as zombie (live views pin the "
+                     "mapping past its unlink)", shm.name)
+
     def _sweep_zombies(self):
         """Retry closing unlinked-but-pinned mappings: views that were
         in flight at unlink time (RawView pushes, spill writes) die
@@ -350,12 +403,18 @@ class ShmObjectStore:
         with self._map_lock:  # appends race this sweep from other threads
             zombies, self._zombies = self._zombies, []
             alive = []
+            swept = []
             for shm in zombies:
                 try:
                     shm.close()
                 except BufferError:
                     alive.append(shm)
+                else:
+                    swept.append(shm.name)
+                    self._zombies_swept += 1
             self._zombies.extend(alive)
+        for name in swept:
+            _log().debug("zombie segment %s reclaimed (views died)", name)
 
     def release(self, object_id: ObjectID):
         self._sweep_zombies()
@@ -371,8 +430,7 @@ class ShmObjectStore:
                 # survives until the views die); a later get reopens the
                 # still-named segment fresh. Re-caching it would poison
                 # every subsequent access with _buf=None.
-                with self._map_lock:
-                    self._zombies.append(shm)
+                self._park_zombie(shm)
 
     def unlink(self, object_id: ObjectID):
         """Destroy the segment (node-manager only, when refcount hits 0).
@@ -408,8 +466,45 @@ class ShmObjectStore:
             # live zero-copy views: keep the (now anonymous) mapping
             # referenced so it survives until the views die; swept (and
             # actually closed) by the next release/unlink once they do
-            with self._map_lock:
-                self._zombies.append(shm)
+            self._park_zombie(shm)
+
+    def drop_cached_mapping(self, object_id: ObjectID):
+        """Release the cached mapping when the owner frees the object.
+        The create path caches a mapping that no get-pin tracks; without
+        this the creating process keeps the (already-unlinked) segment
+        mapped until exit. Live views are safe: release() parks a
+        view-pinned mapping as a zombie instead of unmapping it."""
+        self.release(object_id)
+
+    # ------------------------------------------------------ observability
+    def get_ref_counts(self) -> dict[ObjectID, int]:
+        """Live get-pin view for the object-state report / leak
+        watchdog: in this store the cached mapping IS the pin, so every
+        sealed entry in the cache counts as one held ref."""
+        with self._map_lock:
+            return {oid: 1 for oid in self._open
+                    if oid not in self._unsealed}
+
+    def stats(self) -> dict:
+        """Segment-level snapshot for the rayt_object_store_* gauges and
+        node object reports (mirrors NativeArenaStore.stats())."""
+        with self._map_lock:
+            zombie_bytes = 0
+            for shm in self._zombies:
+                try:
+                    zombie_bytes += shm.size
+                except Exception:
+                    pass
+            return {
+                "segments": len(self._open),
+                "unsealed": len(self._unsealed),
+                "zombie_segments": len(self._zombies),
+                "zombie_bytes": zombie_bytes,
+                "zombies_parked_total": self._zombies_parked,
+                "zombies_swept_total": self._zombies_swept,
+                "fallback_objects": 0,
+                "fallback_bytes": 0,
+            }
 
     def close(self):
         with self._map_lock:

@@ -54,6 +54,9 @@ class ReferenceCounter:
         mappings (owned objects go through free_fn, which unpins too)."""
         self._lock = threading.RLock()
         self._records: dict[ObjectID, _Record] = {}
+        # monotonically bumped by every count mutation: lets the object
+        # -state reporter skip snapshot rebuilds on idle flush ticks
+        self._version = 0
         self._is_owner = is_owner
         self._free = free_fn
         self._notify_owner = notify_owner_fn
@@ -79,6 +82,7 @@ class ReferenceCounter:
     def add_local_ref(self, ref: "ObjectRef"):
         with self._lock:
             self._record(ref.id).local += 1
+            self._version += 1
 
     def remove_local_ref(self, ref: "ObjectRef"):
         to_free = None
@@ -88,6 +92,7 @@ class ReferenceCounter:
             if rec is None:
                 return
             rec.local = max(0, rec.local - 1)
+            self._version += 1
             if rec.total() == 0:
                 if rec.owned:
                     to_free = ref.id
@@ -112,6 +117,7 @@ class ReferenceCounter:
     def on_ref_serialized(self, ref: "ObjectRef"):
         with self._lock:
             rec = self._record(ref.id)
+            self._version += 1
             if getattr(self._tls, "task_arg", 0) > 0:
                 pass  # pinned via add_task_pin by the submitter
             else:
@@ -122,13 +128,25 @@ class ReferenceCounter:
         with self._lock:
             rec = self._record(ref.id)
             rec.local += 1
+            self._version += 1
         if not self._is_owner(ref.id) and ref.owner is not None:
             self._notify_owner(ref.id, ref.owner, "add_borrower")
 
     # ---- owner-side borrower registry --------------------------------
     def add_borrower(self, oid: ObjectID, borrower_key: str):
         with self._lock:
-            self._record(oid).borrowers.add(borrower_key)
+            rec = self._records.get(oid)
+            if rec is None:
+                # stale notify: the owner already freed the object (every
+                # live owned object has a record — the owner's own refs
+                # hold it). Creating one here would resurrect a zombie
+                # record with borrowers={key} that nothing ever drops:
+                # total() stays 1 forever, has_record() pins the shm
+                # mapping for the process lifetime, and the snapshot
+                # shows a borrower for an object that no longer exists.
+                return
+            rec.borrowers.add(borrower_key)
+            self._version += 1
 
     def _drop_zero_record(self, oid: ObjectID, rec: _Record):
         """Remove a record whose count hit zero via a non-local-ref path
@@ -147,6 +165,7 @@ class ReferenceCounter:
             if rec is None:
                 return
             rec.borrowers.discard(borrower_key)
+            self._version += 1
             if rec.total() == 0:
                 to_free = self._drop_zero_record(oid, rec)
                 removed = True
@@ -159,6 +178,7 @@ class ReferenceCounter:
     def add_task_pin(self, oid: ObjectID):
         with self._lock:
             self._record(oid).task_pins += 1
+            self._version += 1
 
     def remove_task_pin(self, oid: ObjectID):
         to_free = None
@@ -168,6 +188,7 @@ class ReferenceCounter:
             if rec is None:
                 return
             rec.task_pins = max(0, rec.task_pins - 1)
+            self._version += 1
             if rec.total() == 0:
                 to_free = self._drop_zero_record(oid, rec)
                 removed = True
@@ -176,10 +197,36 @@ class ReferenceCounter:
         elif removed and self._release_local is not None:
             self._release_local(oid)
 
+    @property
+    def version(self) -> int:
+        """Mutation counter (racy read is fine: a missed bump is
+        caught on the next flush tick)."""
+        return self._version
+
     def stats(self) -> dict:
         with self._lock:
             return {
                 "num_tracked": len(self._records),
                 "num_owned": sum(1 for r in self._records.values() if r.owned),
                 "num_escaped": sum(r.escaped for r in self._records.values()),
+            }
+
+    def debug_snapshot(self) -> dict[ObjectID, dict]:
+        """Consistent point-in-time per-oid breakdown, taken in one lock
+        hold so counts across objects are mutually coherent (a ref
+        moving between objects can never show up twice or not at all).
+        Feeds the object-state reports behind `rayt memory` /
+        `state_api.list_objects` (ref analog: `ray memory` rendering
+        reference_count.h's per-object local/submitted/borrower split)."""
+        with self._lock:
+            return {
+                oid: {
+                    "local": rec.local,
+                    "borrowers": len(rec.borrowers),
+                    "task_pins": rec.task_pins,
+                    "escaped": rec.escaped,
+                    "owned": rec.owned,
+                    "total": rec.total(),
+                }
+                for oid, rec in self._records.items()
             }

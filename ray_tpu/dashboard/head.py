@@ -16,6 +16,11 @@ Endpoints:
                                   task lifecycle records (GCS task manager)
   GET  /api/tasks/summary       — ?job= per-task-name state counts +
                                   sched-vs-exec latency split
+  GET  /api/objects             — ?job=&node=&callsite=&leaked=&limit=
+                                  coalesced object records (GCS object
+                                  manager: size/callsite/refs/pins/leaks)
+  GET  /api/objects/summary     — ?job= per-callsite + per-node memory
+                                  rollups with store stats + leak flags
   GET  /api/timeline            — Chrome trace JSON of the GCS task
                                   lifecycle store: nested per-phase slices
                                   (load in Perfetto / chrome://tracing)
@@ -285,6 +290,8 @@ class DashboardHead:
         app.router.add_get("/api/metrics/query", self._metrics_query)
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/tasks/summary", self._tasks_summary)
+        app.router.add_get("/api/objects", self._objects)
+        app.router.add_get("/api/objects/summary", self._objects_summary)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/jobs", self._jobs_list)
         app.router.add_post("/api/jobs", self._jobs_submit)
@@ -455,6 +462,30 @@ class DashboardHead:
         from aiohttp import web
 
         out = self.gcs.task_manager.summarize(
+            job_id=request.query.get("job") or None)
+        return web.json_response(out)
+
+    async def _objects(self, request):
+        """Filtered object-plane records (GCS object manager; ref:
+        `ray memory` / the Objects tab feed)."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.object_manager.list(
+                job_id=q.get("job") or None,
+                node_id=q.get("node") or None,
+                callsite=q.get("callsite") or None,
+                leaked_only=q.get("leaked", "") in ("1", "true", "yes"),
+                limit=int(q.get("limit", 100)))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
+    async def _objects_summary(self, request):
+        from aiohttp import web
+
+        out = self.gcs.object_manager.summarize(
             job_id=request.query.get("job") or None)
         return web.json_response(out)
 

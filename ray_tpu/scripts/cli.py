@@ -205,6 +205,13 @@ def cmd_list(args):
             name=args.task_name or None, limit=args.limit, detail=True)
         print(json.dumps(out, indent=2, default=str))
         return
+    if kind == "objects":
+        out = state_api.list_objects(
+            job_id=args.job or None, node_id=args.node or None,
+            callsite=args.callsite or None,
+            leaked_only=bool(args.leaked), limit=args.limit, detail=True)
+        print(json.dumps(out, indent=2, default=str))
+        return
     fn = {"nodes": state_api.list_nodes, "actors": state_api.list_actors,
           "jobs": state_api.list_jobs,
           "pgs": state_api.list_placement_groups,
@@ -251,17 +258,65 @@ def cmd_profile(args):
 
 
 def cmd_memory(args):
-    """Object report (ref analog: `ray memory`)."""
+    """Object report (ref analog: `ray memory`): live per-node totals
+    plus the GCS object manager's per-callsite / per-node rollups and
+    leak-watchdog flags. Column glossary: README "Object observability"."""
     from ray_tpu import state_api
 
     _attach(args)
+    if getattr(args, "job", None):
+        _print_object_summary(state_api.summarize_objects(
+            job_id=args.job))
+        return
     s = state_api.memory_summary()
     print(f"{s['num_objects']} objects, {s['total_bytes'] / 1e6:.1f} MB "
           f"({s['spilled_objects']} spilled, {s['pinned_objects']} pinned)")
     for o in s["objects"][:50]:
-        flags = ("S" if o["spilled"] else "-") +             ("P" if o["pinned"] else "-")
+        flags = ("S" if o["spilled"] else "-") + \
+            ("P" if o["pinned"] else "-")
         print(f"  {o['object_id'][:16]}  {o['size']:>12}  {flags}  "
-              f"node={o['node_id'][:8]}")
+              f"node={o['node_id'][:8]}  {o.get('callsite') or ''}")
+    if s.get("summary"):
+        _print_object_summary(s["summary"])
+
+
+def _print_object_summary(summary: dict):
+    """`ray memory --group-by` style tables from summarize_objects."""
+    t = summary.get("totals", {})
+    dropped = sum(summary.get("dropped", {}).values())
+    print(f"\ncluster object state: {t.get('objects', 0)} tracked, "
+          f"{t.get('bytes', 0) / 1e6:.1f} MB "
+          f"({t.get('pinned_bytes', 0) / 1e6:.1f} MB pinned, "
+          f"{t.get('spilled_bytes', 0) / 1e6:.1f} MB spilled, "
+          f"{t.get('leaked_objects', 0)} leaked"
+          + (f", {dropped} evicted from the GCS store" if dropped else "")
+          + ")")
+    by_site = summary.get("by_callsite", {})
+    if by_site:
+        fmt = "{:<44} {:>6} {:>12} {:>12} {:>12} {:>7}"
+        print(fmt.format("callsite", "count", "bytes", "pinned",
+                         "spilled", "leaked"))
+        for site, e in by_site.items():
+            print(fmt.format(site[:44], e["count"], e["total_bytes"],
+                             e["pinned_bytes"], e["spilled_bytes"],
+                             e["leaked_count"]))
+    by_node = summary.get("by_node", {})
+    if by_node:
+        print("\nper node:")
+        for node, e in sorted(by_node.items()):
+            store = e.get("store", {})
+            extra = ""
+            if store:
+                extra = (f"  store {store.get('used_bytes', 0) / 1e6:.1f}"
+                         f"/{store.get('capacity_bytes', 0) / 1e6:.0f} MB"
+                         f"  zombies={store.get('zombie_segments', 0)}"
+                         f" (swept {store.get('zombies_swept_total', 0)})")
+                if store.get("fallback_bytes"):
+                    extra += (f"  fallback="
+                              f"{store['fallback_bytes'] / 1e6:.1f} MB")
+            print(f"  {node[:12]}  {e['objects']} objects  "
+                  f"{e['total_bytes'] / 1e6:.1f} MB  "
+                  f"leaked={e['leaked_count']}{extra}")
 
 
 def cmd_timeline(args):
@@ -511,10 +566,15 @@ def main(argv=None):
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
-                                     "workers", "tasks"])
-    sp.add_argument("--job", help="tasks: filter by job id (hex)")
+                                     "workers", "tasks", "objects"])
+    sp.add_argument("--job", help="tasks/objects: filter by job id (hex)")
     sp.add_argument("--state", help="tasks: filter by lifecycle state")
     sp.add_argument("--task-name", help="tasks: filter by task name")
+    sp.add_argument("--node", help="objects: filter by node id (hex)")
+    sp.add_argument("--callsite", help="objects: filter by creation "
+                                       "callsite (exact)")
+    sp.add_argument("--leaked", action="store_true",
+                    help="objects: only leak-watchdog-flagged records")
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
@@ -542,7 +602,10 @@ def main(argv=None):
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_profile)
 
-    sp = sub.add_parser("memory", help="object store contents per node")
+    sp = sub.add_parser("memory",
+                        help="object store contents + per-callsite / "
+                             "per-node rollups and leak flags")
+    sp.add_argument("--job", help="summarize one job's objects only")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
 

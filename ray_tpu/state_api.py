@@ -194,10 +194,45 @@ def summarize_tasks(*, job_id: Optional[str] = None) -> dict:
     return cw.io.run(cw.gcs.call("summarize_tasks", filters))
 
 
-def list_objects() -> list[dict]:
-    """Per-node object directory dump (ref analog: `ray memory`)."""
-    import asyncio
+def list_objects(*, job_id: Optional[str] = None,
+                 node_id: Optional[str] = None,
+                 callsite: Optional[str] = None,
+                 leaked_only: bool = False, limit: int = 0,
+                 detail: bool = False) -> Any:
+    """`ray list objects` analog: coalesced cluster-wide object records
+    from the GCS object manager (ref: gcs_object_manager.h / `ray
+    memory`), filtered SERVER-side (job / node / callsite / leaked,
+    limit). Each record carries size, creation callsite + timestamp,
+    owner, per-node spill/pin state, the owner's ref breakdown (local /
+    borrowers / task pins / escaped), per-worker zero-copy get-pins,
+    and leak-watchdog flags. Reports flow on the ~1s flush cadence, so
+    a just-created object can lag by a beat."""
+    cw = _cw()
+    filters: dict = {"limit": limit, "leaked_only": leaked_only}
+    if job_id is not None:
+        filters["job_id"] = job_id
+    if node_id is not None:
+        filters["node_id"] = node_id
+    if callsite is not None:
+        filters["callsite"] = callsite
+    out = cw.io.run(cw.gcs.call("list_objects_state", filters))
+    return out if detail else out["objects"]
 
+
+def summarize_objects(*, job_id: Optional[str] = None) -> dict:
+    """`ray memory` summary analog: per-callsite and per-node memory
+    rollups with pinned/spilled/leaked breakdowns, per-node store stats
+    (segments, zombies, fallback/arena bytes), and dropped-record
+    accounting from the GCS object manager."""
+    cw = _cw()
+    filters = {"job_id": job_id} if job_id is not None else {}
+    return cw.io.run(cw.gcs.call("summarize_objects", filters))
+
+
+def list_node_objects() -> list[dict]:
+    """LIVE per-node object-directory dump (dials every node manager —
+    the pre-aggregation surface; use list_objects for the cluster-wide
+    coalesced records with ref breakdowns)."""
     from ray_tpu._internal.rpc import connect
 
     cw = _cw()
@@ -223,13 +258,20 @@ def list_objects() -> list[dict]:
 
 
 def memory_summary() -> dict:
-    objs = list_objects()
+    """`rayt memory` data: live per-node directory totals (exact at call
+    time) + the GCS object manager's callsite/leak rollups."""
+    objs = list_node_objects()
+    try:
+        summary = summarize_objects()
+    except Exception:
+        summary = None
     return {
         "num_objects": len(objs),
         "total_bytes": sum(o["size"] for o in objs),
         "spilled_objects": sum(1 for o in objs if o["spilled"]),
         "pinned_objects": sum(1 for o in objs if o["pinned"]),
         "objects": objs,
+        "summary": summary,
     }
 
 

@@ -82,6 +82,12 @@ ingest_batches = Counter(
     "rayt_ingest_batches_total", "Batches delivered to the train loop",
     tag_keys=("experiment", "rank"))
 
+# ---- object plane (core_worker leak watchdog; see `rayt memory`) ----
+object_leaks_flagged = Counter(
+    "rayt_object_leaks_flagged_total",
+    "Shm segments flagged by the leak watchdog: get-pins outlived every "
+    "counted ref past RAYT_OBJECT_LEAK_GRACE_S")
+
 
 def node_gauge_records(node_hex: str, *, resources_total: dict,
                        resources_available: dict, num_workers: int,
@@ -110,4 +116,37 @@ def node_gauge_records(node_hex: str, *, resources_total: dict,
     if object_store_capacity:
         g("rayt_node_object_store_utilization",
           object_store_bytes / object_store_capacity)
+    return recs
+
+
+def object_store_gauge_records(node_hex: str, stats: dict, *,
+                               ts: float) -> list:
+    """Object-plane store gauges from a node manager's store snapshot
+    (node_manager._store_stats): byte-level occupancy split + segment /
+    zombie / fallback counters, so `rayt memory` numbers are graphable
+    and alertable from Prometheus. Emitted on the node manager's GCS
+    connection next to the resource gauges (that process has no core
+    worker)."""
+    recs = []
+
+    def g(name, value):
+        recs.append({"name": name, "kind": "gauge", "value": float(value),
+                     "tags": {"node": node_hex}, "ts": ts})
+
+    g("rayt_object_store_used_bytes", stats.get("used_bytes", 0))
+    g("rayt_object_store_capacity_bytes", stats.get("capacity_bytes", 0))
+    g("rayt_object_store_pinned_bytes", stats.get("pinned_bytes", 0))
+    g("rayt_object_store_spilled_bytes", stats.get("spilled_bytes", 0))
+    g("rayt_object_store_zombie_bytes", stats.get("zombie_bytes", 0))
+    g("rayt_object_store_fallback_bytes", stats.get("fallback_bytes", 0))
+    g("rayt_object_store_objects", stats.get("num_objects", 0))
+    g("rayt_object_store_segments", stats.get("segments", 0))
+    g("rayt_object_store_zombie_segments",
+      stats.get("zombie_segments", 0))
+    g("rayt_object_store_zombies_swept_total",
+      stats.get("zombies_swept_total", 0))
+    if "arena_used_bytes" in stats:
+        g("rayt_object_store_arena_used_bytes", stats["arena_used_bytes"])
+        g("rayt_object_store_arena_evictions_total",
+          stats.get("arena_evictions_total", 0))
     return recs

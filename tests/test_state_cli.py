@@ -85,6 +85,16 @@ def test_cli_start_status_stop(tmp_path):
         r = cli("summary", "tasks", "--address", address)
         assert r.returncode == 0, r.stderr
         assert "0 tasks stored" in r.stdout
+
+        # object state API plumbing (empty cluster: no objects yet)
+        r = cli("list", "objects", "--address", address)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["objects"] == [] and out["total"] == 0
+
+        r = cli("memory", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "0 objects" in r.stdout
     finally:
         r = cli("stop")
         assert r.returncode == 0, r.stderr
