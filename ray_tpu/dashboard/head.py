@@ -286,6 +286,7 @@ class DashboardHead:
         app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/serve", self._serve)
+        app.router.add_get("/api/data", self._data)
         app.router.add_get("/api/metrics/names", self._metrics_names)
         app.router.add_get("/api/metrics/query", self._metrics_query)
         app.router.add_get("/api/tasks", self._tasks)
@@ -413,6 +414,40 @@ class DashboardHead:
                 {"app": app, "deployment": dep, **entry}
                 for (app, dep), entry in sorted(deployments.items())],
             "replicas_alive": replicas_alive,
+        })
+
+    async def _data(self, request):
+        """Data-plane overview from the metrics pipeline: per-op exchange
+        totals (bytes / partitions / reduce-wait from the
+        rayt_data_exchange_* counters) plus ingest delivery throughput —
+        the head stays a pure reader of the time-series store."""
+        from aiohttp import web
+
+        store = self.gcs.metrics_store
+        fields = {"rayt_data_exchange_bytes_total": "bytes_total",
+                  "rayt_data_exchange_partitions_total": "partitions_total",
+                  "rayt_data_exchange_reduce_wait_s": "reduce_wait_s"}
+        exchanges: dict[str, dict] = {}
+        ingest = {}
+        for m in store.snapshot():  # one walk serves both tables
+            field = fields.get(m["name"])
+            if field is not None:
+                op = m["tags"].get("op", "")
+                exchanges.setdefault(op, {})[field] = m["value"]
+            elif m["name"] == "rayt_ingest_tokens_per_s":
+                ingest[m["tags"].get("rank", "")] = m["value"]
+        # recent exchange bandwidth: counter->rate over the last window
+        rates = store.query("rayt_data_exchange_bytes_total",
+                            window_s=300.0, step_s=60.0)
+        for s in rates["series"]:
+            op = s["tags"].get("op", "")
+            pts = [v for _, v in s["points"] if v is not None]
+            if op in exchanges and pts:
+                exchanges[op]["bytes_per_s"] = pts[-1]
+        return web.json_response({
+            "exchanges": [{"op": op, **vals}
+                          for op, vals in sorted(exchanges.items())],
+            "ingest_tokens_per_s": ingest,
         })
 
     async def _metrics_names(self, request):

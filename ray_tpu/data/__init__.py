@@ -15,9 +15,12 @@ from ray_tpu.data.datasink import (Datasink, FileDatasink,  # noqa: F401
 from ray_tpu.data.datasource import (read_csv, read_json,  # noqa: F401
                                      read_npz, read_parquet, read_text,
                                      write_parquet)
+from ray_tpu.data.exchange import (ExchangeController,  # noqa: F401
+                                   ExchangeSpec)
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.llm_corpus import (CorpusCursor,  # noqa: F401
-                                     TokenCorpus, read_token_corpus)
+                                     TokenCorpus, build_corpus,
+                                     read_token_corpus)
 from ray_tpu.data.partitioning import Partitioning  # noqa: F401
 
 

@@ -19,7 +19,7 @@ from ray_tpu.data.executor import (ActorPoolStrategy, MapSpec,
 
 @dataclasses.dataclass
 class _AllToAll:
-    kind: str      # repartition | shuffle | sort
+    kind: str      # repartition | shuffle | sort | dedup
     args: dict
 
 
@@ -71,9 +71,39 @@ class Dataset:
         return self._with(_AllToAll("shuffle", {"seed": seed}))
 
     def sort(self, key: str | Callable, descending: bool = False) -> "Dataset":
-        key_fn = key if callable(key) else (lambda row, _k=key: row[_k])
+        # the RAW key travels to the executor: a string key lets the
+        # exchange run vectorized columnar kernels (argsort/searchsorted
+        # over the key column); callable keys force row kernels
         return self._with(_AllToAll(
-            "sort", {"key": key_fn, "descending": descending}))
+            "sort", {"key": key, "descending": descending}))
+
+    def drop_duplicates(self, key: Optional[str] = None) -> "Dataset":
+        """Keep one row per distinct `key` (whole-row identity when
+        key=None — that path materializes rows even for columnar
+        blocks). Runs as a hash exchange + per-partition
+        first-occurrence set; row ORDER across the dataset is not
+        preserved (rows land in hash-partition order)."""
+        return self._with(_AllToAll("dedup", {"key": key}))
+
+    def unique(self, key: str) -> list:
+        """Distinct values of column `key`, sorted when the values are
+        mutually orderable (mixed/nullable columns come back in
+        partition order instead). The exchange's map side projects to
+        the key column before hash partitioning, so only key values —
+        never full rows — cross the wire or reach the driver."""
+        from ray_tpu.data.block import key_values
+
+        refs = self._executor.unique_values(
+            list(self._iter_block_refs()), key)
+        vals: list = []
+        for block in rt.get(refs):  # one batched gather, not n RTTs
+            if num_rows_of(block):
+                kv = key_values(block, key)
+                vals.extend(kv.tolist() if hasattr(kv, "tolist") else kv)
+        try:
+            return sorted(vals)
+        except TypeError:  # unorderable mix (e.g. None next to str)
+            return vals
 
     def limit(self, n: int) -> "Dataset":
         return self._with(_Limit(n))
@@ -138,7 +168,10 @@ class Dataset:
         return refs
 
     def _run_all_to_all(self, refs: Iterator, stage) -> Iterator:
-        """All-to-all stages are barriers: materialize, exchange."""
+        """All-to-all stages run through the pipelined exchange
+        (data/exchange.py). Input refs are materialized only to fix the
+        output partition count; the exchange itself overlaps map and
+        reduce tasks instead of barriering between them."""
         materialized = list(refs)
         if stage.kind == "repartition":
             return iter(self._executor.repartition(
@@ -146,6 +179,9 @@ class Dataset:
         if stage.kind == "shuffle":
             return iter(self._executor.random_shuffle(
                 materialized, stage.args["seed"]))
+        if stage.kind == "dedup":
+            return iter(self._executor.dedup(
+                materialized, stage.args["key"]))
         return iter(self._executor.sort(
             materialized, stage.args["key"], stage.args["descending"]))
 

@@ -6,20 +6,25 @@ task_pool_map_operator,actor_pool_map_operator}.py).
 Map stages stream: at most `max_in_flight` block tasks are outstanding per
 stage, so a long pipeline holds O(window) blocks in memory instead of the
 whole dataset — the reference's backpressure idea without its resource
-budgets. All-to-all stages (shuffle/sort/repartition) are barriers.
+budgets. All-to-all stages (repartition / random_shuffle / sort / hash
+shuffle / dedup) run through the exchange subsystem (data/exchange.py):
+columnar partition kernels on the map side, per-partition shard
+readiness + streaming reduce folds on the reduce side — pipelined
+map/reduce rather than a global barrier.
 """
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import random
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Iterator, Optional
 
 import ray_tpu as rt
-from ray_tpu.data.block import (Block, block_rows, concat_blocks,
-                                from_batch, iter_rows, split_block,
-                                to_batch)
+from ray_tpu.data.block import (Block, concat_blocks, dedup_block,
+                                from_batch, iter_rows, random_partition,
+                                range_partition, sample_keys,
+                                shuffle_block, sort_block,
+                                split_partition, to_batch)
 
 
 @dataclasses.dataclass
@@ -121,6 +126,7 @@ class StreamingExecutor:
         self.max_in_flight = max_in_flight
         self.execution_options = execution_options
         self.last_topology = None   # stats hook for tests/observability
+        self.last_exchange = None   # ExchangeStats of the last all-to-all
 
     # --------------------------------------------------------- map pipeline
     def stream_pipeline(self, refs: Iterator, specs: list) -> Iterator:
@@ -143,146 +149,176 @@ class StreamingExecutor:
         return self.stream_pipeline(refs, [spec])
 
     # --------------------------------------------------------- all-to-all
-    def repartition(self, refs: list, n: int) -> list:
-        """Distributed repartition: count -> per-block slice tasks ->
-        per-output concat tasks. No block ever lands on the driver (ref:
-        data/_internal/planner/exchange/ split+merge task pattern)."""
-        m = len(refs)
-        if m == 0:
-            return [rt.put([]) for _ in range(n)]
+    #
+    # Every all-to-all is one ExchangeSpec run by the pipelined
+    # ExchangeController (data/exchange.py): map-side partition kernels
+    # keep columnar blocks columnar (index-array take, no row dicts),
+    # shards ride the zero-copy shm plane as task returns, and reduce
+    # tasks start folding a partition the moment its shards exist —
+    # no global map barrier, and the driver never gathers block data.
 
-        def count(block: Block) -> int:
-            return len(block_rows(block))
+    def _exchange(self, spec, refs):
+        from ray_tpu.data.exchange import ExchangeController
+        from ray_tpu.data.streaming_executor import ExecutionOptions
 
-        count_task = rt.remote(num_cpus=0)(count)
-        counts = rt.get([count_task.remote(r) for r in refs])
-        total = sum(counts)
-        # global row range of output partition j: [j*total//n, (j+1)*...)
-        bounds = [(j * total) // n for j in range(n + 1)]
-        offsets = [0]
-        for c in counts:
-            offsets.append(offsets[-1] + c)
-
-        def slice_block(block: Block, start: int, cuts: list) -> list:
-            rows = block_rows(block)
-            return [rows[max(0, lo - start):max(0, hi - start)]
-                    for lo, hi in cuts]
-
-        slice_task = rt.remote(num_cpus=1, num_returns=n)(slice_block)
-        parts = []
-        for i, ref in enumerate(refs):
-            cuts = [(bounds[j], bounds[j + 1]) for j in range(n)]
-            result = slice_task.remote(ref, offsets[i], cuts)
-            parts.append(result if isinstance(result, list) else [result])
-
-        def merge(*shards: Block) -> Block:
-            return concat_blocks(shards)
-
-        merge_task = rt.remote(num_cpus=1)(merge)
-        return [merge_task.remote(*[p[j] for p in parts]) for j in range(n)]
-
-    def random_shuffle(self, refs: list, seed: Optional[int] = None) -> list:
-        """Distributed shuffle: map each block into N shards, then N
-        reduce tasks concatenate + locally shuffle their shard (ref:
-        data/_internal/planner/exchange/)."""
-        n = max(1, len(refs))
-
-        def shard(block: Block, n: int, seed) -> list[Block]:
-            rng = random.Random(seed)
-            shards: list[Block] = [[] for _ in range(n)]
-            for row in iter_rows(block):
-                shards[rng.randrange(n)].append(row)
-            return shards
-
-        def reduce_shards(seed, *shards: Block) -> Block:
-            out = concat_blocks(shards)
-            random.Random(seed).shuffle(out)
-            return out
-
-        shard_task = rt.remote(num_cpus=1, num_returns=n)(shard)
-        reduce_task = rt.remote(num_cpus=1)(reduce_shards)
-        parts = []
-        for i, ref in enumerate(refs):
-            s = seed + i if seed is not None else None
-            result = shard_task.remote(ref, n, s)
-            parts.append(result if isinstance(result, list) else [result])
-        out = []
-        for j in range(n):
-            s2 = seed + 10_000 + j if seed is not None else None
-            out.append(reduce_task.remote(s2, *[p[j] for p in parts]))
+        opts = self.execution_options or ExecutionOptions(
+            max_in_flight=self.max_in_flight)
+        controller = ExchangeController(spec, options=opts)
+        out = controller.run(refs)
+        self.last_exchange = controller.stats
         return out
 
-    def sort(self, refs: list, key: Callable, descending: bool) -> list:
-        """Distributed sample sort (ref: planner/exchange/sort_task_spec.py
-        TaskBasedShuffle): per-block local sort + key sampling, driver sees
-        ONLY the samples (tiny), range-partition tasks split each sorted
-        block at the sample quantiles, merge tasks heapq-merge shards."""
+    def repartition(self, refs: list, n: int) -> list:
+        """Distributed repartition via local split: each map task splits
+        its block into n near-equal slices (remainders rotated by block
+        index, so outputs balance within ±1 row per input block) and the
+        reduce side concatenates slice j of every block. No counting
+        pre-pass: the driver never blocks on a per-block rt.get(counts)
+        barrier the way the old split+merge pattern did.
+
+        Contract note: output partition j holds slice j OF EVERY input
+        block, so the global row order is not the input order (the old
+        count-then-slice path kept partitions globally contiguous —
+        that exactness is what the count barrier bought). Repartition
+        before order-sensitive stages, or sort afterwards."""
+        refs = list(refs)
+        if not refs:
+            return [rt.put([]) for _ in range(n)]
+        from ray_tpu.data.exchange import ExchangeSpec
+
+        return self._exchange(
+            ExchangeSpec(n, map_fn=_repartition_map, name="repartition"),
+            refs)
+
+    def random_shuffle(self, refs: list, seed: Optional[int] = None) -> list:
+        """Distributed shuffle: map tasks scatter rows uniformly across N
+        shards, reduce tasks concat + locally permute their partition.
+
+        Retry safety: the per-task seed is ALWAYS derived from a base
+        seed fixed at submission time plus the block index — with
+        seed=None the base is drawn once HERE and baked into the task
+        args, so a driver-level map-task retry reproduces the exact
+        shard assignment of the first attempt. (Fresh in-task randomness
+        would route rows differently on retry, duplicating them into
+        one reduce partition and losing them from another.)"""
+        refs = list(refs)
         n = max(1, len(refs))
+        base = seed if seed is not None \
+            else random.SystemRandom().randrange(1 << 31)
+
+        def shuffle_map(block: Block, n: int, idx: int) -> list[Block]:
+            return random_partition(block, n, seed=base + idx)
+
+        def shuffle_reduce(block: Block, j: int) -> Block:
+            return shuffle_block(block, seed=base + 10_000 + j)
+
+        from ray_tpu.data.exchange import ExchangeSpec
+
+        return self._exchange(
+            ExchangeSpec(n, map_fn=shuffle_map,
+                         finalize_fn=shuffle_reduce, name="shuffle"),
+            refs)
+
+    def sort(self, refs: list, key, descending: bool) -> list:
+        """Distributed sample sort (ref: planner/exchange/sort_task_spec.py
+        TaskBasedShuffle): a sampling pre-pass ships ~16 key values per
+        block to the driver (the only driver-side sync, and it is tiny),
+        quantiles of the pooled sample become the n-1 range bounds, map
+        tasks range-partition on them, and each reduce partition sorts
+        once. String keys on columnar blocks run fully vectorized
+        (argsort/searchsorted over the key column); callable keys fall
+        back to row kernels."""
+        refs = list(refs)
         if not refs:
             return []
+        n = len(refs)
+        if callable(key):
+            # a user key fn from a driver-local module pickles by
+            # reference inside our closures — register its module for
+            # by-value shipping (same contract as MapSpec user fns)
+            from ray_tpu._internal.serialization import ship_code_by_value
 
-        def sort_and_sample(block: Block, s: int) -> tuple:
-            rows = sorted(block_rows(block), key=key, reverse=descending)
-            step = max(1, len(rows) // s)
-            return rows, [key(r) for r in rows[::step]]
+            ship_code_by_value(key)
 
-        sas_task = rt.remote(num_cpus=1, num_returns=2)(sort_and_sample)
-        sorted_refs, sample_refs = [], []
-        for ref in refs:
-            b, s = sas_task.remote(ref, 16)
-            sorted_refs.append(b)
-            sample_refs.append(s)
+        def sample(block: Block) -> list:
+            return sample_keys(block, key, 16)
+
+        sample_task = rt.remote(num_cpus=1)(sample)
         samples = sorted(
-            (x for sub in rt.get(sample_refs) for x in sub),
+            (x for sub in rt.get([sample_task.remote(r) for r in refs])
+             for x in sub),
             reverse=descending)
         if not samples:  # every block empty
-            return sorted_refs
-        # n-1 partition boundaries at the sample quantiles
-        bounds = [samples[(len(samples) * j) // n] for j in range(1, n)] \
-            if samples else []
+            return refs
+        bounds = [samples[(len(samples) * j) // n] for j in range(1, n)]
 
-        def partition(rows: Block, bounds: list) -> list:
-            import bisect
+        def sort_map(block: Block, n: int, idx: int) -> list[Block]:
+            return range_partition(block, key, bounds, descending)
 
-            keys = [key(r) for r in rows]
-            if descending:  # bisect needs ascending; flip
-                keys = [_Neg(k) for k in keys]
-                bounds = [_Neg(b) for b in bounds]
-            shards, lo = [], 0
-            for b in bounds:
-                hi = bisect.bisect_right(keys, b, lo)
-                shards.append(rows[lo:hi])
-                lo = hi
-            shards.append(rows[lo:])
-            return shards
+        def sort_reduce(block: Block, j: int) -> Block:
+            return sort_block(block, key, descending)
 
-        part_task = rt.remote(num_cpus=1, num_returns=n)(partition)
-        parts = []
-        for ref in sorted_refs:
-            result = part_task.remote(ref, bounds)
-            parts.append(result if isinstance(result, list) else [result])
+        from ray_tpu.data.exchange import ExchangeSpec
 
-        def merge(*shards: Block) -> Block:
-            import heapq
+        return self._exchange(
+            ExchangeSpec(n, map_fn=sort_map, finalize_fn=sort_reduce,
+                         name="sort"),
+            refs)
 
-            return list(heapq.merge(
-                *[block_rows(s) for s in shards], key=key,
-                reverse=descending))
+    def hash_partitioned(self, refs: list, key, n: Optional[int] = None,
+                         finalize_fn=None, name: str = "groupby") -> list:
+        """Hash exchange: all rows with equal `key` land in the same
+        output partition (the groupby/dedup substrate). `finalize_fn`
+        runs once per partition after its shards folded."""
+        refs = list(refs)
+        n = n or max(1, len(refs))
+        from ray_tpu.data.block import hash_partition
+        from ray_tpu.data.exchange import ExchangeSpec
 
-        merge_task = rt.remote(num_cpus=1)(merge)
-        return [merge_task.remote(*[p[j] for p in parts]) for j in range(n)]
+        if callable(key):  # user key fns ship like MapSpec fns
+            from ray_tpu._internal.serialization import ship_code_by_value
+
+            ship_code_by_value(key)
+
+        def hash_map(block: Block, n: int, idx: int) -> list[Block]:
+            return hash_partition(block, key, n)
+
+        return self._exchange(
+            ExchangeSpec(n, map_fn=hash_map, finalize_fn=finalize_fn,
+                         name=name),
+            refs)
+
+    def dedup(self, refs: list, key) -> list:
+        """Distributed drop-duplicates: hash exchange on `key` (or
+        whole-row identity when key=None) + a per-partition
+        first-occurrence set in the reduce epilogue."""
+
+        def dedup_reduce(block: Block, j: int) -> Block:
+            return dedup_block(block, key)
+
+        return self.hash_partitioned(refs, key, finalize_fn=dedup_reduce,
+                                     name="dedup")
+
+    def unique_values(self, refs: list, key: str) -> list:
+        """Distinct values of column `key`: the map side projects each
+        block to the key column BEFORE hash partitioning, so only key
+        values — never full rows — cross the wire or reach the driver."""
+        refs = list(refs)
+        n = max(1, len(refs))
+        from ray_tpu.data.block import hash_partition, project_column
+        from ray_tpu.data.exchange import ExchangeSpec
+
+        def unique_map(block: Block, n: int, idx: int) -> list[Block]:
+            return hash_partition(project_column(block, key), key, n)
+
+        def unique_reduce(block: Block, j: int) -> Block:
+            return dedup_block(block, key)
+
+        return self._exchange(
+            ExchangeSpec(n, map_fn=unique_map,
+                         finalize_fn=unique_reduce, name="unique"),
+            refs)
 
 
-class _Neg:
-    """Order-reversing key wrapper for descending range partitioning."""
-
-    __slots__ = ("v",)
-
-    def __init__(self, v):
-        self.v = v
-
-    def __lt__(self, other):
-        return other.v < self.v
-
-    def __eq__(self, other):
-        return self.v == other.v
+def _repartition_map(block: Block, n: int, idx: int) -> list[Block]:
+    return split_partition(block, n, offset=idx % n)

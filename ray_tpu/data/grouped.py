@@ -1,29 +1,20 @@
 """GroupedData: hash-shuffle by key then per-partition aggregate (ref
-analog: python/ray/data/grouped_data.py + planner/exchange hash shuffle)."""
+analog: python/ray/data/grouped_data.py + planner/exchange hash shuffle).
+
+The shuffle itself is the exchange subsystem's hash exchange
+(data/exchange.py via StreamingExecutor.hash_partitioned): columnar
+blocks are routed by a vectorized key-column hash and never shatter
+into row dicts; the reduce side streams partial concats while map
+tasks are still running."""
 
 from __future__ import annotations
 
-import pickle
-import zlib
 from typing import Any, Callable
 
 import ray_tpu as rt
-from ray_tpu.data.block import Block, concat_blocks, iter_rows
+from ray_tpu.data.block import Block, iter_rows, stable_hash
 
-
-def _stable_hash(value: Any) -> int:
-    """Process-stable key hash: builtin hash() of str/bytes is randomized
-    per process (PYTHONHASHSEED), so two workers would route the same key
-    to different partitions. crc32 over a canonical pickle is stable."""
-    if isinstance(value, bytes):
-        data = value
-    elif isinstance(value, str):
-        data = value.encode()
-    elif isinstance(value, int):
-        return value & 0x7FFFFFFF
-    else:
-        data = pickle.dumps(value, protocol=4)
-    return zlib.crc32(data)
+_stable_hash = stable_hash  # back-compat alias (kernel moved to block.py)
 
 
 def _group_rows(part: Block, key: str) -> dict[Any, Block]:
@@ -74,29 +65,11 @@ class GroupedData:
         self._key = key
 
     def _partitions(self) -> list:
-        """Hash-partition rows by key across tasks, one output per input
-        block count (distributed shuffle, not a driver gather)."""
+        """Hash-partition rows by key, one output partition per input
+        block (the pipelined hash exchange: distributed shuffle, not a
+        driver gather — columnar blocks stay columnar)."""
         refs = list(self._dataset._iter_block_refs())
-        n = max(1, len(refs))
-        key = self._key
-
-        def shard(block: Block, n: int) -> list[Block]:
-            shards: list[Block] = [[] for _ in range(n)]
-            for row in iter_rows(block):
-                shards[_stable_hash(row[key]) % n].append(row)
-            return shards
-
-        def combine(*shards: Block) -> Block:
-            return concat_blocks(shards)
-
-        shard_task = rt.remote(num_cpus=1, num_returns=n)(shard)
-        combine_task = rt.remote(num_cpus=1)(combine)
-        parts = []
-        for ref in refs:
-            result = shard_task.remote(ref, n)
-            parts.append(result if isinstance(result, list) else [result])
-        return [combine_task.remote(*[p[j] for p in parts])
-                for j in range(n)]
+        return self._dataset._executor.hash_partitioned(refs, self._key)
 
     def aggregate(self, *agg_fns, **named_aggs: tuple[str, Callable]):
         """Two surfaces (ref: grouped_data.py aggregate):

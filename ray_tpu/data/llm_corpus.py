@@ -261,3 +261,83 @@ def read_token_corpus(paths, *, seq_len: int, dp_rank: int = 0,
     stateful-sequential by design — the cursor is the feature)."""
     return TokenCorpus(paths, seq_len=seq_len, dp_rank=dp_rank,
                        world_size=world_size, **kwargs)
+
+
+# ------------------------------------------------------- corpus building
+def _write_token_shard(block, path: str) -> dict:
+    """Pack one block of tokenized documents into one .npz token shard
+    (``tokens`` flat + ``doc_lens`` — the TokenCorpus format). Retry
+    safe the datasink way: the final name is deterministic per shard
+    index, the temp name is per-pid, and os.replace commits atomically —
+    a driver-level write-task retry replaces, never duplicates."""
+    import os
+
+    from ray_tpu.data.block import block_rows
+
+    docs = [np.asarray(r["tokens"], _TOKEN_DTYPE)
+            for r in block_rows(block)]
+    flat = (np.concatenate(docs) if docs
+            else np.empty(0, _TOKEN_DTYPE))
+    lens = np.asarray([len(d) for d in docs], np.int64)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:  # file handle: savez can't append .npz
+        np.savez(f, tokens=flat, doc_lens=lens)
+    os.replace(tmp, path)
+    return {"path": path, "docs": len(docs), "tokens": int(flat.size)}
+
+
+def build_corpus(inputs, out_dir: str, *, tokenize,
+                 text_column: str = "text", num_shards: int = 8,
+                 seed: int = 0, dedup: bool = True,
+                 tokenize_batch_size: int = 64,
+                 executor=None) -> list[str]:
+    """The flagship corpus-prep pipeline, end to end on the exchange
+    subsystem: multi-shard jsonl documents → content-hash dedup (hash
+    exchange + per-partition set) → ``tokenize`` via map_batches →
+    ``random_shuffle`` (pipelined shuffle exchange) → ``num_shards``
+    packed ``.npz`` token shards that :class:`TokenCorpus` / the train
+    ingest path (train/ingest.py) consume with the resumable-cursor
+    contract intact.
+
+    ``tokenize`` maps one document string to a list/array of token ids.
+    Returns the ordered list of written shard paths (deterministic
+    names, so ``TokenCorpus(out_dir, ...)`` re-expands identically)."""
+    import hashlib
+    import os
+
+    import ray_tpu as rt
+    from ray_tpu._internal.serialization import ship_code_by_value
+    from ray_tpu.data.datasource import read_json
+
+    # `tokenize` rides inside _tok (a module-level closure here), so it
+    # would pickle by REFERENCE — register its driver-local module for
+    # by-value shipping like any MapSpec user fn
+    ship_code_by_value(tokenize)
+    ds = read_json(inputs)
+    if executor is not None:
+        ds._executor = executor
+    if dedup:
+        col = text_column
+
+        def _content_hash(row: dict) -> dict:
+            return {**row, "_ch": hashlib.sha1(
+                row[col].encode()).hexdigest()}
+
+        ds = ds.map(_content_hash).drop_duplicates("_ch")
+
+    def _tok(rows: list) -> dict:
+        return {"tokens": [tokenize(r[text_column]) for r in rows]}
+
+    ds = ds.map_batches(_tok, batch_size=tokenize_batch_size,
+                        batch_format="rows")
+    ds = ds.random_shuffle(seed=seed).repartition(num_shards)
+
+    os.makedirs(out_dir, exist_ok=True)
+    write_task = rt.remote(num_cpus=1)(_write_token_shard)
+    paths = [os.path.join(out_dir, f"shard-{i:05d}.npz")
+             for i in range(num_shards)]
+    # the write barrier is the pipeline's commit point: every shard file
+    # is durably in place when build_corpus returns
+    rt.get([write_task.remote(ref, p)
+            for ref, p in zip(ds._iter_block_refs(), paths)])
+    return paths

@@ -82,6 +82,20 @@ ingest_batches = Counter(
     "rayt_ingest_batches_total", "Batches delivered to the train loop",
     tag_keys=("experiment", "rank"))
 
+# ---- data exchange (data/exchange.py all-to-all controller) ----
+data_exchange_bytes = Counter(
+    "rayt_data_exchange_bytes_total",
+    "Bytes of shard objects moved through the exchange plane (map-task "
+    "shard outputs, by owner object metadata)", tag_keys=("op",))
+data_exchange_partitions = Counter(
+    "rayt_data_exchange_partitions_total",
+    "Output partitions produced by exchanges", tag_keys=("op",))
+data_exchange_reduce_wait = Counter(
+    "rayt_data_exchange_reduce_wait_s",
+    "Cumulative seconds ready shards waited before a reduce-side task "
+    "consumed them (near zero when map and reduce pipeline well)",
+    tag_keys=("op",))
+
 # ---- object plane (core_worker leak watchdog; see `rayt memory`) ----
 object_leaks_flagged = Counter(
     "rayt_object_leaks_flagged_total",

@@ -20,6 +20,7 @@ import asyncio
 import bisect
 import threading
 import time
+import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
 _registry: dict[str, "Metric"] = {}
@@ -44,6 +45,10 @@ class _Batcher:
         self._hists: dict[tuple, dict] = {}
         self._scheduled = False
         self._scheduled_at = 0.0
+        # weakref to the core worker the pending flush was spawned on
+        # (weakref, not id(): a freed worker's address can be recycled
+        # by the allocator, which would defeat the identity check)
+        self._scheduled_cw: Optional[weakref.ref] = None
         self._interval: float | None = None  # cached from config
 
     def _stale_after(self) -> float:
@@ -78,11 +83,19 @@ class _Batcher:
                 h["sum"] += value
                 h["count"] += 1
             now = time.monotonic()
+            # reschedule when the pending flush is presumed dead: aged
+            # past the staleness bound, OR spawned on a PREVIOUS core
+            # worker (an rt.shutdown()/rt.init() cycle killed it with
+            # its loop — without this check the new cluster's first
+            # records sit buffered until the age-based self-heal fires)
             schedule = (not self._scheduled
-                        or now - self._scheduled_at > self._stale_after())
+                        or now - self._scheduled_at > self._stale_after()
+                        or self._scheduled_cw is None
+                        or self._scheduled_cw() is not cw)
             if schedule:
                 self._scheduled = True
                 self._scheduled_at = now
+                self._scheduled_cw = weakref.ref(cw)
         if schedule:
             self._spawn_flush(cw)
 

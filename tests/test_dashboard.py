@@ -146,7 +146,7 @@ def test_index_page_serves_spa(dash_cluster):
     html = _get(dash_cluster.dashboard_port, "/")
     assert html.lstrip().startswith("<!DOCTYPE html>")
     for endpoint in ("/api/nodes", "/api/actors", "/api/jobs",
-                     "/api/serve", "/api/cluster_status",
+                     "/api/serve", "/api/data", "/api/cluster_status",
                      "/api/tasks", "/api/tasks/summary",
                      "/api/objects", "/api/objects/summary",
                      "/api/metrics/names", "/api/metrics/query",
@@ -156,9 +156,21 @@ def test_index_page_serves_spa(dash_cluster):
     # incremental log tailing, task failure drill-down, object rollups
     for marker in ("view-metrics", "view-serve", "view-timeline",
                    "view-tasks", "task-summary", "task-err",
-                   "view-objects", "object-summary",
-                   "sparkline", "offset="):
+                   "view-objects", "object-summary", "view-data",
+                   "data-exchanges", "sparkline", "offset="):
         assert marker in html, marker
+    # one <script> block = one top-level scope: a duplicate const/let/
+    # function declaration is a parse-time SyntaxError that kills the
+    # WHOLE dashboard (no handler ever runs), and no JS engine runs in
+    # CI to catch it — so guard at the text level
+    import collections
+    import re
+
+    script = html.split("<script>")[1].split("</script>")[0]
+    decls = re.findall(r"^(?:const|let|function)\s+([A-Za-z_$][\w$]*)",
+                       script, flags=re.M)
+    dupes = [n for n, c in collections.Counter(decls).items() if c > 1]
+    assert not dupes, f"duplicate top-level JS declarations: {dupes}"
 
 
 def test_objects_endpoint_and_summary(dash_cluster):
@@ -425,3 +437,33 @@ def test_serve_view_and_timeline_endpoints(dash_cluster):
     # cheap count-only form (what the SPA polls)
     count = json.loads(_get(port, "/api/timeline?count=1"))
     assert count["events"] >= len(events)
+
+
+def test_data_endpoint_reports_exchange_counters(dash_cluster):
+    """/api/data (the SPA Data tab feed): per-op exchange totals from
+    the rayt_data_exchange_* counters land in the metrics store and
+    surface with bytes/partitions/reduce-wait fields."""
+    import numpy as np
+
+    from ray_tpu.data.block import NumpyBlock
+    from ray_tpu.data.executor import StreamingExecutor
+
+    execu = StreamingExecutor()
+    refs = [rt.put(NumpyBlock({"x": np.arange(5000)})) for _ in range(3)]
+    out = execu.random_shuffle(refs, seed=2)
+    rt.wait(out, num_returns=len(out), timeout=60)
+
+    port = dash_cluster.dashboard_port
+    deadline = time.monotonic() + 30
+    ops = {}
+    while time.monotonic() < deadline:
+        data = json.loads(_get(port, "/api/data"))
+        ops = {x["op"]: x for x in data["exchanges"]}
+        if "shuffle" in ops:
+            break
+        time.sleep(0.3)  # batched publish flushes on a ~200ms cadence
+    assert "shuffle" in ops, data
+    row = ops["shuffle"]
+    assert row["partitions_total"] == 3.0
+    assert row.get("bytes_total", 0) > 0
+    assert "ingest_tokens_per_s" in data

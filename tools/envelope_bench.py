@@ -70,6 +70,91 @@ def _leg(results, dimension, unit, reference, fn):
     results.append(row)
 
 
+def measure_shuffle(rt, *, mib: int = 128, legacy_mib: int = 32,
+                    blocks: int = 8, timeout: float = 1200.0) -> dict:
+    """Data-plane shuffle bandwidth: a columnar dataset random_shuffled
+    through the pipelined exchange (data/exchange.py — columnar
+    partition kernels, streaming reduce folds) vs the pre-exchange
+    BARRIER executor (per-row dict sharding, every reduce waiting on
+    every map). The legacy leg runs at a smaller size — its per-row
+    path is orders of magnitude slower and the GB/s rate is what's
+    compared. Bytes counted once through the exchange (map+reduce)."""
+    import random
+
+    from ray_tpu.data.block import NumpyBlock, concat_blocks, iter_rows
+    from ray_tpu.data.executor import StreamingExecutor
+
+    def mk_refs(total_mib: int):
+        rows = total_mib * (1 << 20) // 8 // blocks  # one float64 column
+        refs = [rt.put(NumpyBlock(
+            {"v": np.random.default_rng(i).random(rows)}))
+            for i in range(blocks)]
+        return refs, rows * blocks * 8
+
+    def drain(refs):
+        ready, _ = rt.wait(refs, num_returns=len(refs), timeout=timeout)
+        assert len(ready) == len(refs), "shuffle did not complete"
+
+    ex = StreamingExecutor()
+    refs, nbytes = mk_refs(mib)
+    t0 = time.monotonic()
+    drain(ex.random_shuffle(refs, seed=1))
+    dt = time.monotonic() - t0
+    pipelined = nbytes / (1 << 30) / dt
+    stats = ex.last_exchange
+
+    # pipelined AT THE BARRIER LEG'S SIZE: rates aren't size-invariant
+    # (fixed task overheads dominate small runs), so the recorded
+    # speedup compares equal datasets
+    refs, nbytes_small = mk_refs(legacy_mib)
+    t0 = time.monotonic()
+    drain(ex.random_shuffle(refs, seed=1))
+    pipelined_small = nbytes_small / (1 << 30) / (time.monotonic() - t0)
+
+    # the old barrier executor, verbatim shape: rows shard one dict at a
+    # time, and every reduce task depends on EVERY map task's output
+    def shard(block, n, seed):
+        rng = random.Random(seed)
+        shards = [[] for _ in range(n)]
+        for row in iter_rows(block):
+            shards[rng.randrange(n)].append(row)
+        return shards
+
+    def reduce_shards(seed, *shards):
+        out = concat_blocks(shards)
+        random.Random(seed).shuffle(out)
+        return out
+
+    refs, nbytes_legacy = mk_refs(legacy_mib)
+    n = len(refs)
+    shard_task = rt.remote(num_cpus=1, num_returns=n)(shard)
+    reduce_task = rt.remote(num_cpus=1)(reduce_shards)
+    t0 = time.monotonic()
+    parts = []
+    for i, ref in enumerate(refs):
+        res = shard_task.remote(ref, n, 1 + i)
+        parts.append(res if isinstance(res, list) else [res])
+    drain([reduce_task.remote(10_001 + j, *[p[j] for p in parts])
+           for j in range(n)])
+    dt_legacy = time.monotonic() - t0
+    barrier = nbytes_legacy / (1 << 30) / dt_legacy
+
+    return {
+        "blocks": blocks,
+        "pipelined": {"mib": mib, "gib_per_s": round(pipelined, 3)},
+        "pipelined_at_barrier_size": {
+            "mib": legacy_mib, "gib_per_s": round(pipelined_small, 3)},
+        "barrier_rows": {"mib": legacy_mib,
+                         "gib_per_s": round(barrier, 3)},
+        # same-size comparison (cross-size ratios flatter the big run)
+        "speedup_same_size": round(pipelined_small / barrier, 1)
+            if barrier else None,
+        # folds only launch while the map side is unfinished, so this
+        # count is reduce work that ran before all maps completed
+        "reduce_folds_before_maps_done": stats.folds if stats else 0,
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=16)
@@ -254,6 +339,11 @@ def main():
         _leg(results, "bulk_data_plane_throughput", "GiB/s",
              "plasma zero-copy reads (memcpy-bound put, copy-free get)",
              bulk_throughput)
+
+        _leg(results, "shuffle_gb_per_s", "GiB/s",
+             "task-based exchange shuffle (pipelined map/reduce, "
+             "columnar kernels)",
+             lambda: measure_shuffle(rt))
 
         def broadcast():
             arr = np.zeros(args.broadcast_mib << 20, np.uint8)
