@@ -131,6 +131,45 @@ def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
         lambda: chan.execute(1).get(timeout=60), 1, duration))
     chan.teardown()
 
-    for a in (c, ac, e1, e2):
+    # zero-copy channel bandwidth: a 1 MiB numpy payload echoed through a
+    # single-stage channel DAG (driver ring -> actor -> output ring); the
+    # scatter write + slot-view deserialize path must sustain GB/s where
+    # the old pickle+join+bytes() tick plateaued well under 1
+    e3 = Echo.remote()
+    with InputNode() as inp:
+        gb_node = e3.apply.bind(inp)
+    gdag = gb_node.experimental_compile(channels=True,
+                                        buffer_size_bytes=2 << 20)
+    mib = np.zeros(1 << 20, np.uint8)
+    gdag.execute(mib).get(timeout=60)
+    r = _timeit("dag_channel_gigabytes_per_second",
+                lambda: gdag.execute(mib).get(timeout=60), 1,
+                max(duration, 1.0))
+    r["rate_per_s"] = round(r["rate_per_s"] * mib.nbytes / (1 << 30), 3)
+    results.append(r)
+    gdag.teardown()
+
+    # DCN ring channel tick rate: producer->consumer items over the RPC
+    # plane (loopback), credit window pacing the pipeline — the per-tick
+    # cost of a cross-node DAG edge
+    import uuid as _uuid
+
+    from ray_tpu.dag.dcn_channel import DcnProducerChannel, create_endpoint
+
+    cons = create_endpoint(f"bench-{_uuid.uuid4().hex[:12]}", 8, 1 << 20)
+    prod = DcnProducerChannel(cons.spec)
+
+    def dcn_window():
+        for i in range(8):
+            prod.write(i)
+        for _ in range(8):
+            cons.read(timeout=60)
+
+    results.append(_timeit("dag_dcn_ticks_per_second", dcn_window, 8,
+                           duration))
+    prod.close()
+    cons.close()
+
+    for a in (c, ac, e1, e2, e3):
         rt.kill(a)
     return results

@@ -150,6 +150,21 @@ class RawView:
                 pass
 
 
+class Serialized:
+    """A value the CALLER already passed through serialize(): the rpc
+    layer frames the chunk list verbatim instead of re-serializing —
+    large payloads ride the scatter-gather path (each pickle-5 buffer
+    reaches the transport as its own buffer), small ones join once into
+    an inline frame. The DCN channel uses this to serialize on the
+    producer's tick thread and keep the event loop to pure framing."""
+
+    __slots__ = ("chunks", "total")
+
+    def __init__(self, chunks: list):
+        self.chunks = chunks
+        self.total = serialized_size(chunks)
+
+
 # Coalesced small-frame writes flush once the per-tick buffer holds this
 # many bytes (bounds the latency/copy cost of the join for bursty ticks).
 COALESCE_MAX_BYTES = 256 * 1024
@@ -172,8 +187,11 @@ def _frames(msgid: int, kind: int, method: str, value) -> list:
         head = msgpack.packb([msgid, kind, method, None, len(value)],
                              use_bin_type=True)
         return [_LEN.pack(len(head)) + head, value]
-    chunks = serialize(value)
-    total = serialized_size(chunks)
+    if isinstance(value, Serialized):
+        chunks, total = value.chunks, value.total
+    else:
+        chunks = serialize(value)
+        total = serialized_size(chunks)
     if total >= RAW_THRESHOLD:
         head = msgpack.packb([msgid, kind, method, _SG_TAG, total],
                              use_bin_type=True)

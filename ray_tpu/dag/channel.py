@@ -28,18 +28,34 @@ but only the native path is *specified* for ARM hosts.
 Capacity gives pipelining: a ring of N slots lets N ticks be in flight
 between two stages before the producer blocks (GPipe-style microbatch
 overlap over host edges).
+
+Zero-copy ticks: ``write`` serializes with pickle-5 out-of-band buffers
+and scatter-writes each chunk straight into the ring slot (no
+intermediate ``bytes`` join); ``read`` deserializes over a memoryview of
+the slot, so large numpy payloads come back as views ALIASING the ring.
+The slot-pin rule makes that safe: a slot's ``read_seq`` release is
+deferred until no deserialized view aliases it (weakref finalizers feed
+a release deque drained from the consumer's read/close paths, the same
+GC-reentrancy-safe shape as the object plane's ``_ShmGetPin``). Slots
+release in ring order, so a long-held view eventually backpressures the
+producer — hold at most ``n_slots - 1`` live views per ring, or copy out
+(``np.array(v)``).
 """
 
 from __future__ import annotations
 
+import collections
 import ctypes
-import pickle
 import struct
 import sys
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from multiprocessing import shared_memory
+
+from ray_tpu._internal.serialization import (deserialize, serialize,
+                                             serialized_size)
 
 _HDR = struct.Struct("<QQQQB")  # write_seq, read_seq, slot_size, n_slots, closed
 _LEN = struct.Struct("<Q")      # per-slot payload length prefix
@@ -90,6 +106,51 @@ class ChannelClosed(Exception):
     pass
 
 
+class _SlotPin:
+    """Tracks the deserialized out-of-band views aliasing ONE ring slot.
+
+    Same reentrancy discipline as the object plane's ``_ShmGetPin``
+    (core_worker.py): wrapper finalizers only ever append to the
+    consumer's release deque — every read_seq mutation happens on the
+    consumer's read path, which drains the deque. Wrappers are held by
+    strong refs until ``seal()`` arms their finalizers, so no release
+    event can fire before the pin's count is final."""
+
+    __slots__ = ("seq", "_events", "_wrappers", "_count")
+
+    def __init__(self, seq: int, events: collections.deque):
+        self.seq = seq
+        self._events = events
+        self._wrappers: list = []
+        self._count = 0
+
+    def wrap(self, view: memoryview):
+        """buffer_wrapper for deserialize(): interpose a weakref-able
+        read-only holder between pickle and the raw slot view."""
+        import numpy as np
+
+        w = np.frombuffer(view.toreadonly(), dtype=np.uint8)
+        self._wrappers.append(w)  # strong ref: finalizer armed at seal()
+        return w
+
+    def seal(self) -> bool:
+        """Arm the finalizers. True => nothing aliases the slot: the
+        caller releases its read_seq immediately."""
+        wrappers, self._wrappers = self._wrappers, []
+        if not wrappers:
+            return True
+        self._count = len(wrappers)
+        for w in wrappers:
+            weakref.finalize(w, self._events.append, self)
+        return False
+
+    def dec(self) -> bool:
+        """One view died (drained on the consumer thread). True => last
+        view: release the slot."""
+        self._count -= 1
+        return self._count == 0
+
+
 @dataclass(frozen=True)
 class ChannelSpec:
     """Serializable descriptor shipped to actors inside the DAG schedule."""
@@ -108,6 +169,7 @@ class ShmChannel:
         self.spec = spec
         self._owner = owner
         self._buf = shm.buf
+        self._closed_locally = False
         self._atomics = _atomics_lib()
         self._base_addr = 0
         if self._atomics is not None:
@@ -116,6 +178,14 @@ class ShmChannel:
             anchor = ctypes.c_char.from_buffer(shm.buf)
             self._base_addr = ctypes.addressof(anchor)
             del anchor
+        # consumer-side zero-copy state: the local read cursor may run
+        # ahead of the PUBLISHED read_seq, which lags at the oldest slot
+        # still aliased by a live deserialized view (slot-pin rule)
+        _, r, _ = self._seqs()
+        self._cursor = r          # next seq this consumer will read
+        self._read_pub = r        # last published read_seq
+        self._unreleased: set[int] = set()   # read but still pinned
+        self._pin_events: collections.deque = collections.deque()
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -133,6 +203,9 @@ class ShmChannel:
         return cls(shm, spec, owner=False)
 
     def close(self):
+        if self._closed_locally:
+            return  # idempotent: a ring is closed exactly once per holder
+        self._closed_locally = True
         try:
             self._mark_closed()
         except Exception:
@@ -145,6 +218,13 @@ class ShmChannel:
         try:
             self._buf = None
             self._shm.close()
+        except BufferError:
+            # live deserialized views still alias the ring (slot-pin
+            # rule): the mapping stays until they die. Neutralize this
+            # instance's close so __del__ doesn't spew 'Exception
+            # ignored ... BufferError' — the map dies with the views or
+            # the process (same idiom as the object store's zombies).
+            self._shm.close = lambda: None  # type: ignore[method-assign]
         except Exception:
             pass
         if self._owner:
@@ -196,29 +276,21 @@ class ShmChannel:
                 f"item of {len(payload)} bytes exceeds the channel slot "
                 f"size {self.spec.slot_size}; recompile the DAG with a "
                 f"larger buffer_size_bytes")
-        deadline = None if timeout is None else time.monotonic() + timeout
-        pause = 0.0
-        while True:
-            w, r, closed = self._seqs()
-            if closed:
-                raise ChannelClosed()
-            if w - r < self.spec.n_slots:
-                break
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("channel write timed out (ring full)")
-            time.sleep(pause)
-            pause = min(0.001, pause + 0.00005)
+        w = self._wait_writable(timeout)
         off = self._slot_off(w)
         _LEN.pack_into(self._buf, off, len(payload))
         self._buf[off + _LEN.size:off + _LEN.size + len(payload)] = payload
         self._set_write_seq(w + 1)  # publish LAST
 
     def read_bytes(self, timeout: float | None = None) -> bytes:
+        """Copy read: materializes the slot payload to bytes and releases
+        the slot immediately (shares the consumer cursor with read())."""
         deadline = None if timeout is None else time.monotonic() + timeout
         pause = 0.0
         while True:
-            w, r, closed = self._seqs()
-            if w > r:
+            self._drain_pin_events()
+            w, _, closed = self._seqs()
+            if w > self._cursor:
                 break
             if closed:
                 raise ChannelClosed()
@@ -226,15 +298,131 @@ class ShmChannel:
                 raise TimeoutError("channel read timed out (ring empty)")
             time.sleep(pause)
             pause = min(0.001, pause + 0.00005)
-        off = self._slot_off(r)
+        off = self._slot_off(self._cursor)
         (length,) = _LEN.unpack_from(self._buf, off)
         payload = bytes(self._buf[off + _LEN.size:off + _LEN.size + length])
-        self._set_read_seq(r + 1)  # release LAST
+        seq, self._cursor = self._cursor, self._cursor + 1
+        self._release_seq(seq)
         return payload
 
     # ----------------------------------------------------------- object api
+    # write()/read() are the zero-copy tick path: pickle-5 chunks scatter
+    # straight into the slot, reads deserialize over a slot view under
+    # the slot-pin rule. write_bytes()/read_bytes() above remain the raw
+    # copy path (also the bench baseline the zero-copy numbers gate
+    # against).
+
     def write(self, value, timeout: float | None = None):
-        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+        self.write_chunks(serialize(value), timeout=timeout)
+
+    def write_chunks(self, chunks: list, total: int | None = None,
+                     timeout: float | None = None):
+        """Scatter-write a serialize() chunk list into the next slot: one
+        memcpy per chunk into shared memory, no intermediate join."""
+        if total is None:
+            total = serialized_size(chunks)
+        if total > self.spec.slot_size:
+            # non-retryable (unlike a transiently-full ring, which blocks)
+            raise ValueError(
+                f"item of {total} bytes exceeds the channel slot size "
+                f"{self.spec.slot_size}; recompile the DAG with a larger "
+                f"buffer_size_bytes")
+        w = self._wait_writable(timeout)
+        off = self._slot_off(w)
+        _LEN.pack_into(self._buf, off, total)
+        pos = off + _LEN.size
+        for c in chunks:
+            n = len(c) if isinstance(c, bytes) else c.nbytes
+            self._buf[pos:pos + n] = c
+            pos += n
+        self._set_write_seq(w + 1)  # publish LAST
 
     def read(self, timeout: float | None = None):
-        return pickle.loads(self.read_bytes(timeout))
+        """Zero-copy read: deserializes over a memoryview of the slot.
+        Out-of-band buffers (numpy payloads) alias the ring; the slot is
+        not reused while any such view is alive (slot-pin rule)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pause = 0.0
+        gc_nudge = time.monotonic() + 0.05
+        while True:
+            self._drain_pin_events()
+            w, _, closed = self._seqs()
+            if w > self._cursor:
+                break
+            if closed:
+                raise ChannelClosed()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out (ring empty)")
+            if self._read_pub < self._cursor and not self._pin_events \
+                    and time.monotonic() > gc_nudge:
+                # Unpublished slots + an empty ring can mean the producer
+                # is parked on OUR unreleased slots, while the views that
+                # pin them sit in a reference CYCLE (observed: a jitted
+                # learner's first trace) that only the cyclic collector
+                # will break — and this quiet spin allocates too little
+                # to ever trigger it. Nudge the collector so finalizers
+                # fire and the ring drains itself.
+                import gc
+
+                gc.collect()
+                gc_nudge = time.monotonic() + 0.5
+            time.sleep(pause)
+            pause = min(0.001, pause + 0.00005)
+        off = self._slot_off(self._cursor)
+        (length,) = _LEN.unpack_from(self._buf, off)
+        payload = self._buf[off + _LEN.size:off + _LEN.size + length]
+        pin = _SlotPin(self._cursor, self._pin_events)
+        self._cursor += 1
+        try:
+            value = deserialize(payload, buffer_wrapper=pin.wrap)
+        except Exception:
+            self._release_seq(pin.seq)
+            raise
+        if pin.seal():
+            self._release_seq(pin.seq)
+        # else: the slot releases via the pin's finalizer events ONLY —
+        # it must NOT enter _unreleased yet, or an earlier slot's release
+        # walk would publish read_seq past this still-pinned slot and the
+        # producer would overwrite memory a live view aliases
+        return value
+
+    # ------------------------------------------------------- slot pinning
+    def _wait_writable(self, timeout: float | None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pause = 0.0
+        while True:
+            w, r, closed = self._seqs()
+            if closed:
+                raise ChannelClosed()
+            if w - r < self.spec.n_slots:
+                return w
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out (ring full)")
+            time.sleep(pause)
+            pause = min(0.001, pause + 0.00005)
+
+    def _release_seq(self, seq: int):
+        """Mark one read slot RELEASABLE (its views are all dead);
+        publish read_seq up to the first still-pinned slot (in ring
+        order — the producer's free-slot math needs a contiguous
+        prefix). ``_unreleased`` holds only releasable slots parked
+        behind a pinned predecessor — never still-pinned ones."""
+        self._unreleased.add(seq)
+        if seq != self._read_pub:
+            return
+        pub = self._read_pub
+        while pub in self._unreleased:
+            self._unreleased.discard(pub)
+            pub += 1
+        self._read_pub = pub
+        if self._buf is not None:
+            self._set_read_seq(pub)
+
+    def _drain_pin_events(self):
+        """Apply view-death events queued by wrapper finalizers. Runs only
+        on the consumer's read path (single consumer), so no lock."""
+        events = self._pin_events
+        while events:
+            pin = events.popleft()
+            if pin.dec():
+                self._release_seq(pin.seq)

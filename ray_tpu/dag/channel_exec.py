@@ -4,39 +4,59 @@ Ref analog: python/ray/dag/compiled_dag_node.py:757 (CompiledDAG),
 dag_node_operation.py:14 (static per-actor READ/COMPUTE/WRITE schedules),
 experimental/channel/shared_memory_channel.py (pre-allocated mutable
 channels). The point: after compile, a tick involves ZERO task
-submissions — the driver writes the input into pre-created shm rings, the
+submissions — the driver writes the input into pre-created channels, the
 actors run frozen schedules in long-lived loops, values move
-producer→consumer through SPSC rings, and the driver reads outputs from
-rings. Per-tick cost is a few pickle+memcpy+seq-bump operations instead
-of task specs, leases, and object-store round trips.
+producer→consumer through SPSC channels, and the driver reads outputs
+from channels. Per-tick cost is a few serialize+memcpy+seq-bump
+operations instead of task specs, leases, and object-store round trips.
+
+Channel selection is PER EDGE at compile time:
+  * both endpoints on the driver's node  -> shm ring (dag/channel.py,
+    zero-copy ticks under the slot-pin rule),
+  * any endpoint off the driver's node   -> DCN ring channel over the
+    existing RPC plane (dag/dcn_channel.py: persistent peer connection,
+    scatter-gather frames, credit window == n_slots) — multi-node actor
+    graphs stay on the fast path instead of falling back to the
+    4x-slower per-call executor.
 
 Eligibility (else ``compile_channels`` raises ``Ineligible`` and the
 caller falls back to the per-call executor in dag/compiled.py):
   * every compute node is a ClassMethodNode (actors only),
   * no device edges (tensor_transport) — those ride the device-object
-    plane, whose payloads should NOT transit host shm rings,
-  * all actors live on the driver's node (shm reaches them). Multi-node
-    DAGs fall back; a DCN ring channel is the natural extension.
+    plane, whose payloads should NOT transit host channels.
 
 Per-tick error semantics mirror the reference: an exception in one actor
 is wrapped and FLOWS along the graph edges (consumers skip compute and
 forward it), so the driver's ``get()`` raises while the DAG stays alive
-for the next tick.
+for the next tick; the captured remote traceback is chained onto the
+re-raised exception.
 """
 
 from __future__ import annotations
 
 import pickle
+import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
-from ray_tpu.dag.channel import ChannelClosed, ChannelSpec, ShmChannel
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.dag.dcn_channel import (DcnProducerChannel, _dcn_create_endpoints,
+                                     attach_channel, create_endpoint)
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode, InputAttributeNode,
                               InputNode, MultiOutputNode)
 
 
 class Ineligible(Exception):
     """This DAG can't use the channel fast path; use the per-call one."""
+
+
+class DagRemoteTraceback(Exception):
+    """Carrier for the traceback captured inside an actor's tick; chained
+    as the __cause__ of the re-raised remote exception so the driver's
+    stack trace shows where the tick actually failed."""
+
+    def __str__(self):
+        return "\n--- remote tick traceback ---\n" + (self.args[0] or "")
 
 
 class _TickError:
@@ -63,8 +83,8 @@ class _Op:
 
 @dataclass
 class _ActorSchedule:
-    in_channels: list = field(default_factory=list)    # ChannelSpecs (reads)
-    out_channels: list = field(default_factory=list)   # ChannelSpecs (writes)
+    in_channels: list = field(default_factory=list)    # channel specs (reads)
+    out_channels: list = field(default_factory=list)   # channel specs (writes)
     ops: list = field(default_factory=list)
     input_ch: int | None = None       # index into in_channels for driver input
     collective_group: str | None = None
@@ -78,7 +98,7 @@ def _dag_actor_loop(self, sched_blob: bytes):
     actor's ordered queue stays free for normal method calls, which
     interleave with DAG ticks exactly like the reference's compiled
     graphs. The thread attaches channels once and ticks until the driver
-    closes the input rings (teardown) — no per-tick control plane."""
+    closes the input channels (teardown) — no per-tick control plane."""
     import threading
 
     sched: _ActorSchedule = pickle.loads(sched_blob)
@@ -90,28 +110,40 @@ def _dag_actor_loop(self, sched_blob: bytes):
 
 
 def _dag_loop_body(self, sched: _ActorSchedule):
-    ins: list[ShmChannel] = []
-    outs: list[ShmChannel] = []
+    import os
+    _trace = None
+    if os.environ.get("RAYT_DAG_TRACE"):
+        _tf = open(f"/tmp/dagtrace-{os.getpid()}.log", "a", buffering=1)
+        _trace = lambda *a: _tf.write(" ".join(map(str, a)) + "\n")  # noqa
+        _trace("loop start", type(self).__name__,
+               [op.method for op in sched.ops])
+    ins: list = []
+    outs: list = []
     group = None
     try:
         # attach incrementally so a startup failure still closes whatever
         # came up (peers then see ChannelClosed instead of a timeout)
         for s in sched.in_channels:
-            ins.append(ShmChannel.attach(s))
+            ins.append(attach_channel(s))
         for s in sched.out_channels:
-            outs.append(ShmChannel.attach(s))
+            outs.append(attach_channel(s))
         if sched.collective_group:
             from ray_tpu.util.collective import init_collective_group
 
             group = init_collective_group(
                 sched.collective_world, sched.collective_rank,
                 group_name=sched.collective_group)
+        tick_no = 0
         while True:
             reads: dict[int, Any] = {}
 
             def read_ch(i):
                 if i not in reads:
+                    if _trace:
+                        _trace("tick", tick_no, "read ch", i)
                     reads[i] = ins[i].read()
+                    if _trace:
+                        _trace("tick", tick_no, "read ch", i, "done")
                 return reads[i]
 
             locals_: dict[int, Any] = {}
@@ -176,15 +208,31 @@ def _dag_loop_body(self, sched: _ActorSchedule):
 
                         result = _TickError(e, traceback.format_exc())
                 locals_[op.pos] = result
-                for w in op.writes:
-                    outs[w].write(result)
+                if _trace:
+                    _trace("tick", tick_no, "computed", op.method,
+                           "writes", op.writes)
+                try:
+                    for w in op.writes:
+                        outs[w].write(result)
+                        if _trace:
+                            _trace("tick", tick_no, "wrote", w)
+                except ChannelClosed:
+                    stop = True   # a downstream peer tore down mid-tick
+                    break
             if stop:
                 break
+            tick_no += 1
     finally:
         for ch in outs:   # propagate shutdown downstream
-            ch.close()
+            try:
+                ch.close()
+            except Exception:
+                pass
         for ch in ins:
-            ch.close()
+            try:
+                ch.close()
+            except Exception:
+                pass
         if group is not None:
             try:
                 group.destroy()
@@ -194,7 +242,7 @@ def _dag_loop_body(self, sched: _ActorSchedule):
 
 
 class ChannelDagRef:
-    """Future for one tick; resolves from the output rings in order."""
+    """Future for one tick; resolves from the output channels in order."""
 
     def __init__(self, dag: "ChannelCompiledDAG", tick: int):
         self._dag = dag
@@ -204,16 +252,31 @@ class ChannelDagRef:
         return self._dag._get_tick(self._tick, timeout)
 
 
+@dataclass
+class _ChanPlan:
+    """One channel to materialize. ``owner`` is the CONSUMER process:
+    None = the driver (creates shm rings and driver-side DCN endpoints
+    locally), else the id()-key of the consuming actor handle (its
+    worker creates the DCN endpoint via one compile-time RPC)."""
+    kind: str                 # "shm" | "dcn"
+    owner: int | None         # None = driver
+    n_slots: int
+    slot_size: int
+    spec: Any = None          # filled at materialization
+    handle: Any = None        # driver-held handle, when the driver is a peer
+
+
 class ChannelCompiledDAG:
     def __init__(self, output_node: DAGNode, topo: list[DAGNode],
                  buffer_size_bytes: int = 1 << 20, max_inflight: int = 8):
-        import ray_tpu as rt
-
         self.output_node = output_node
         self._closed = False
         self._tick = 0
         self._next_read = 0
         self._buffered: dict[int, Any] = {}
+        # outputs already consumed for the in-progress wave (a get()
+        # deadline can fire mid-wave; the next get() resumes here)
+        self._partial: list = []
 
         compute = [n for n in topo if isinstance(n, ClassMethodNode)]
         if not compute:
@@ -225,34 +288,51 @@ class ChannelCompiledDAG:
             raise Ineligible(f"unsupported node type {type(n).__name__}")
         if any(getattr(n, "tensor_transport", False) for n in compute):
             raise Ineligible("device edges use the device-object plane")
-        self._check_locality(compute)
 
-        # ---- build per-actor schedules + channels -----------------------
+        from ray_tpu.api import _core_worker
+
+        self._cw = _core_worker()
+        my_node = self._cw.node_id
+        placement = self._actor_placement(compute)   # id(actor) -> node_id
+
+        # ---- plan per-actor schedules + channels -------------------------
+        # Channels are PLANNED first (schedules hold plan indices) and
+        # materialized after the graph walk: DCN endpoints live in consumer
+        # processes, so they take one compile-time RPC per consumer actor.
         slots = max(2, max_inflight)
-        mk = lambda: ShmChannel.create(buffer_size_bytes, slots)  # noqa: E731
-        self._all_channels: list[ShmChannel] = []
+        plans: list[_ChanPlan] = []
+
+        def plan_channel(consumer_key: int | None,
+                         producer_key: int | None) -> int:
+            """consumer/producer: id(actor handle), or None = driver."""
+            c_node = my_node if consumer_key is None else \
+                placement[consumer_key]
+            p_node = my_node if producer_key is None else \
+                placement[producer_key]
+            if c_node == my_node and p_node == my_node:
+                # same node as the driver: driver-created shm ring
+                # reaches both peers (driver, or actors on this node)
+                plans.append(_ChanPlan("shm", None, slots,
+                                       buffer_size_bytes))
+            else:
+                # DCN endpoint lives in the CONSUMER'S process — always
+                # the consuming actor's worker (even when that actor
+                # shares the driver's node: the registry that resolves
+                # the consumer side at attach is per-process, not
+                # per-node); None = the driver itself consumes (outputs)
+                plans.append(_ChanPlan("dcn", consumer_key, slots,
+                                       buffer_size_bytes))
+            return len(plans) - 1
+
         scheds: dict[int, _ActorSchedule] = {}     # id(actor) -> schedule
         actors: dict[int, Any] = {}
         pos_of = {id(n): i for i, n in enumerate(topo)}
-        owner = {id(n): n.actor for n in compute}
-        consumers_of: dict[int, list] = {}
-        for n in compute:
-            for up in n._upstream():
-                consumers_of.setdefault(id(up), []).append(n)
 
         def sched_for(actor) -> _ActorSchedule:
             if id(actor) not in scheds:
                 scheds[id(actor)] = _ActorSchedule()
                 actors[id(actor)] = actor
             return scheds[id(actor)]
-
-        def channel(spec_holder_sched, direction) -> int:
-            ch = mk()
-            self._all_channels.append(ch)
-            lst = (spec_holder_sched.in_channels if direction == "in"
-                   else spec_holder_sched.out_channels)
-            lst.append(ch.spec)
-            return len(lst) - 1, ch
 
         # edge channels: (producer node, consumer actor) -> in_ch index
         edge_in: dict[tuple[int, int], int] = {}
@@ -263,17 +343,18 @@ class ChannelCompiledDAG:
                         up.actor is not n.actor:
                     key = (id(up), id(n.actor))
                     if key not in edge_in:
-                        idx, ch = channel(sched, "in")
-                        edge_in[key] = idx
-                        # producer writes the same ring
+                        plan_idx = plan_channel(id(n.actor), id(up.actor))
+                        sched.in_channels.append(plan_idx)
+                        edge_in[key] = len(sched.in_channels) - 1
+                        # producer writes the same channel
                         psched = sched_for(up.actor)
-                        psched.out_channels.append(ch.spec)
+                        psched.out_channels.append(plan_idx)
                         psched._edge_out = getattr(psched, "_edge_out", {})
                         psched._edge_out[key] = \
                             len(psched.out_channels) - 1
 
         # input channels: one per actor that consumes the driver input
-        self._input_channels: list[ShmChannel] = []
+        self._input_plan_idx: list[int] = []
         for aid, sched in scheds.items():
             needs_input = any(
                 isinstance(up, (InputNode, InputAttributeNode))
@@ -281,9 +362,10 @@ class ChannelCompiledDAG:
                 for up in n._upstream())
             has_reads = bool(sched.in_channels)
             if needs_input or not has_reads:
-                idx, ch = channel(sched, "in")
-                sched.input_ch = idx
-                self._input_channels.append(ch)
+                plan_idx = plan_channel(aid, None)
+                sched.in_channels.append(plan_idx)
+                sched.input_ch = len(sched.in_channels) - 1
+                self._input_plan_idx.append(plan_idx)
 
         # output channels: one per DAG output node, in output order
         if isinstance(output_node, MultiOutputNode):
@@ -292,18 +374,17 @@ class ChannelCompiledDAG:
         else:
             out_nodes = [output_node]
             self._multi = False
-        self._output_channels: list[ShmChannel] = []
+        self._output_plan_idx: list[int] = []
         for on in out_nodes:
             if not isinstance(on, ClassMethodNode):
                 raise Ineligible("outputs must be actor method results")
             sched = sched_for(on.actor)
-            ch = mk()
-            self._all_channels.append(ch)
-            sched.out_channels.append(ch.spec)
+            plan_idx = plan_channel(None, id(on.actor))
+            sched.out_channels.append(plan_idx)
             sched._out_idx = getattr(sched, "_out_idx", {})
             sched._out_idx.setdefault(id(on), []).append(
                 len(sched.out_channels) - 1)
-            self._output_channels.append(ch)
+            self._output_plan_idx.append(plan_idx)
 
         # ops, in topo order per actor
         for n in compute:
@@ -339,6 +420,31 @@ class ChannelCompiledDAG:
         # collective groups: nodes marked by dag.collective.allreduce
         self._wire_collectives(compute, scheds, actors)
 
+        # ---- materialize channels ---------------------------------------
+        self._materialize_channels(plans, actors)
+        self.channel_kinds = {"shm": sum(p.kind == "shm" for p in plans),
+                              "dcn": sum(p.kind == "dcn" for p in plans)}
+
+        # schedules now carry real specs instead of plan indices
+        for sched in scheds.values():
+            sched.in_channels = [plans[i].spec for i in sched.in_channels]
+            sched.out_channels = [plans[i].spec for i in sched.out_channels]
+
+        # driver-held handles. Input channels need a PRODUCER handle on
+        # the driver (dial actor-owned DCN endpoints); outputs and
+        # driver-created rings use the materialized handle directly.
+        self._input_channels = []
+        for i in self._input_plan_idx:
+            p = plans[i]
+            if p.handle is None:          # actor-owned DCN endpoint
+                p.handle = DcnProducerChannel(p.spec, self._cw)
+            self._input_channels.append(p.handle)
+        self._output_channels = [plans[i].handle
+                                 for i in self._output_plan_idx]
+        # every driver-held handle, each closed exactly once at teardown
+        self._driver_channels = [p.handle for p in plans
+                                 if p.handle is not None]
+
         # ---- launch the actor loops ------------------------------------
         self._loop_refs = []
         for aid, sched in scheds.items():
@@ -362,21 +468,18 @@ class ChannelCompiledDAG:
         out += [v for v in n.kwargs.values() if isinstance(v, DAGNode)]
         return out
 
-    def _check_locality(self, compute):
-        """All actors must be reachable by shm: same node as the driver.
-        Waits briefly for still-constructing actors to get placed."""
+    def _actor_placement(self, compute) -> dict[int, str]:
+        """Resolve each actor's node so compile can pick shm vs DCN per
+        edge. Waits briefly for still-constructing actors to get placed."""
         import time as _time
 
-        from ray_tpu.api import _core_worker
-
-        cw = _core_worker()
-        my_node = cw.node_id
-        seen = set()
+        cw = self._cw
+        placement: dict[int, str] = {}
         for n in compute:
-            aid = n.actor._actor_id
-            if aid in seen:
+            key = id(n.actor)
+            if key in placement:
                 continue
-            seen.add(aid)
+            aid = n.actor._actor_id
             deadline = _time.monotonic() + 60.0
             while True:
                 node_id = None
@@ -390,9 +493,39 @@ class ChannelCompiledDAG:
                 if _time.monotonic() > deadline:
                     raise Ineligible("actor placement unknown")
                 _time.sleep(0.05)
-            if node_id != my_node:
-                raise Ineligible("actors span nodes; shm channels are "
-                                 "node-local (fallback executor used)")
+            placement[key] = node_id
+        return placement
+
+    def _materialize_channels(self, plans: list[_ChanPlan], actors: dict):
+        """Create driver-owned channels locally, then actor-owned DCN
+        endpoints via one __rayt_apply__ per consumer actor."""
+        import ray_tpu as rt
+
+        by_owner: dict[int, list[int]] = {}
+        for i, p in enumerate(plans):
+            if p.owner is None:
+                if p.kind == "shm":
+                    ch = ShmChannel.create(p.slot_size, p.n_slots)
+                else:
+                    ch = create_endpoint(f"dag-{uuid.uuid4().hex[:16]}",
+                                         p.n_slots, p.slot_size, self._cw)
+                p.spec, p.handle = ch.spec, ch
+            else:
+                by_owner.setdefault(p.owner, []).append(i)
+        if not by_owner:
+            return
+        from ray_tpu.api import ActorMethod
+
+        pending = []
+        for owner, idxs in by_owner.items():
+            reqs = [(f"dag-{uuid.uuid4().hex[:16]}", plans[i].n_slots,
+                     plans[i].slot_size) for i in idxs]
+            m = ActorMethod(actors[owner], "__rayt_apply__")
+            pending.append((idxs, m.remote(_dcn_create_endpoints, reqs)))
+        for idxs, ref in pending:
+            specs = rt.get(ref, timeout=120.0)
+            for i, spec in zip(idxs, specs):
+                plans[i].spec = spec
 
     def _wire_collectives(self, compute, scheds, actors):
         for n in compute:
@@ -414,43 +547,87 @@ class ChannelCompiledDAG:
             value = args[0]
         else:
             value = (args, kwargs)
+        from ray_tpu._internal.serialization import (serialize,
+                                                     serialized_size)
+
+        # serialize ONCE, scatter the same chunk list into every input
+        # channel (N-runner broadcasts pay one serialize, not N)
+        chunks = serialize(value)
+        total = serialized_size(chunks)
         for ch in self._input_channels:
-            ch.write(value, timeout=300.0)
+            ch.write_chunks(chunks, total, timeout=300.0)
         ref = ChannelDagRef(self, self._tick)
         self._tick += 1
         return ref
 
     # pipelined submission is the default: execute() never waits for
-    # results, so successive calls overlap through the rings
+    # results, so successive calls overlap through the channels
     execute_async = execute
 
     def _get_tick(self, tick: int, timeout: float | None):
+        """Resolve one tick's outputs under ONE overall deadline (the
+        per-channel reads share it, so the total wait is `timeout`, not
+        timeout × n_outputs). A deadline firing MID-WAVE keeps the
+        already-consumed outputs in ``self._partial``: the next get()
+        resumes at the first unread channel, so the per-channel cursors
+        never desynchronize across ticks."""
+        import time as _time
+
+        deadline = _time.monotonic() + (300.0 if timeout is None
+                                        else timeout)
         while tick not in self._buffered:
-            vals = [ch.read(timeout=timeout if timeout is not None else 300.0)
-                    for ch in self._output_channels]
+            vals = self._partial
+            while len(vals) < len(self._output_channels):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"tick {self._next_read} output read timed out")
+                vals.append(
+                    self._output_channels[len(vals)].read(
+                        timeout=remaining))
             self._buffered[self._next_read] = vals
+            self._partial = []
             self._next_read += 1
         vals = self._buffered.pop(tick)
         err = next((v for v in vals if isinstance(v, _TickError)), None)
         if err is not None:
-            raise err.err
+            raise err.err from DagRemoteTraceback(err.tb)
         return vals if self._multi else vals[0]
 
     def teardown(self):
         if self._closed:
             return
         self._closed = True
+        # close inputs FIRST: actor loops drain and exit, closing their
+        # own edge/output ends (shutdown cascades along graph edges)
         for ch in self._input_channels:
-            ch.close()
+            try:
+                ch.close()
+            except Exception:
+                pass
         import ray_tpu as rt
 
         try:
+            # short first wait: loops exit in ms when nothing is blocked
             rt.wait(self._loop_refs, num_returns=len(self._loop_refs),
-                    timeout=30.0)
+                    timeout=2.0)
         except Exception:
             pass
-        for ch in self._all_channels + self._output_channels:
-            ch.close()
+        # then every driver-held handle exactly once (close() is
+        # idempotent, so handles shared with _input_channels are safe).
+        # This also unblocks actor loops still parked on a FULL
+        # driver-held ring (write sees the closed flag) or an un-drained
+        # output channel, letting them exit cleanly below.
+        for ch in self._driver_channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        try:
+            rt.wait(self._loop_refs, num_returns=len(self._loop_refs),
+                    timeout=25.0)
+        except Exception:
+            pass
 
     def __del__(self):
         try:

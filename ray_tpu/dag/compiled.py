@@ -1,10 +1,10 @@
 """Per-call compiled DAG execution — the FALLBACK executor.
 
-Eligible DAGs compile onto pre-allocated shm channels with frozen
-per-actor schedules instead (dag/channel_exec.py — the fast path, ref
-analog: python/ray/dag/compiled_dag_node.py:757 + dag_node_operation.py);
-this module handles the rest: function nodes, device edges, multi-node
-actor graphs.
+Eligible DAGs compile onto pre-allocated channels (shm rings node-local,
+DCN ring channels cross-node) with frozen per-actor schedules instead
+(dag/channel_exec.py — the fast path, ref analog:
+python/ray/dag/compiled_dag_node.py:757 + dag_node_operation.py);
+this module handles the rest: function nodes and device edges.
 
 compile() topologically sorts the graph once and freezes the submission
 plan; execute() replays it with object refs wired producer→consumer, so

@@ -15,11 +15,13 @@ class DAGNode:
     def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
                              max_inflight: int = 8,
                              channels: object = "auto") -> "object":
-        """Compile the DAG. channels="auto" uses the pre-allocated shm
+        """Compile the DAG. channels="auto" uses the pre-allocated
         channel fast path (dag/channel_exec.py) when the graph is
-        eligible (actor-only, host edges, node-local), else falls back to
-        the per-call executor; True forces channels (raises if
-        ineligible); False forces the per-call executor."""
+        eligible (actor-only, host edges): node-local edges ride shm
+        rings, cross-node edges ride DCN channels over the RPC plane.
+        Falls back to the per-call executor only for function nodes and
+        device edges; True forces channels (raises if ineligible);
+        False forces the per-call executor."""
         from ray_tpu.dag.compiled import CompiledDAG
 
         if channels in ("auto", True):

@@ -42,6 +42,22 @@ class EnvRunner:
         self._params = params
         return True
 
+    def sample_dag(self, weights, num_steps: int) -> dict:
+        """Compiled-DAG tick (Podracer Sebulba shape): fresh weights ride
+        the DAG's input channel edge when the learner broadcast them this
+        tick (None = keep sampling with the current, possibly stale,
+        weights — IMPALA's defining asynchrony).
+
+        The weights are COPIED out of the channel: zero-copy reads alias
+        the input ring slot, and params held across ticks would pin it
+        past the ring's capacity (the slot-pin rule's copy-on-hold
+        requirement)."""
+        if weights is not None:
+            import jax
+
+            self.set_weights(jax.tree.map(lambda x: np.array(x), weights))
+        return self.sample(num_steps)
+
     def sample(self, num_steps: int) -> dict:
         """Rollout num_steps per env; returns flat [T, N, ...] arrays plus
         completed-episode returns for metrics."""

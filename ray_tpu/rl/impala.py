@@ -68,6 +68,21 @@ class IMPALAConfig:
     use_appo_loss: bool = False
     clip_eps: float = 0.2
     seed: int = 0
+    # steady-state execution plane: compile the env_runner→aggregator→
+    # learner loop onto a channel DAG (dag/channel_exec.py — the Sebulba
+    # shape from the Podracer paper: runners feed rings, the learner
+    # consumes, weights broadcast back over the input channel edge).
+    # Ticks then cost ring writes instead of task submissions; pipeline
+    # depth (ticks in flight) is max_requests_in_flight, which bounds
+    # weight staleness exactly like the per-call path's in-flight cap.
+    # False restores plain actor calls (per-runner retry/fault tolerance
+    # at per-call speed).
+    use_compiled_dag: bool = True
+    # DAG-mode result granularity (rllib's min-work-per-train-iteration):
+    # ticks are cheap enough that one update per train() call would make
+    # driver-side bookkeeping the bottleneck — drain this many updates
+    # per iteration (soft 5s cap keeps slow-env iterations bounded)
+    min_updates_per_iteration: int = 4
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
@@ -79,6 +94,40 @@ class APPOConfig(IMPALAConfig):
     architecture + the clipped surrogate objective)."""
     use_appo_loss: bool = True
     broadcast_interval: int = 2
+
+
+def _tree_leaves(tree):
+    """Flatten a (possibly nested) param pytree without importing jax on
+    the driver."""
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _tree_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _tree_leaves(v)
+    else:
+        yield tree
+
+
+def _tree_copy(tree):
+    """Copy a param pytree's arrays (jax-free): the copy-on-hold rule
+    for values retained across compiled-DAG ticks."""
+    if isinstance(tree, dict):
+        return {k: _tree_copy(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_copy(v) for v in tree)
+    return np.array(tree) if isinstance(tree, np.ndarray) else tree
+
+
+def _sample_fragment_nbytes(module_cfg, rollout_fragment_length: int,
+                            num_envs_per_runner: int) -> int:
+    """Upper-bound one runner fragment's raw array bytes (sizes channel
+    slots for the RL DAGs — shared by IMPALA and PPO)."""
+    obs_elems = int(np.prod(getattr(module_cfg, "obs_shape", ())
+                            or (getattr(module_cfg,
+                                        "observation_size", 4),)))
+    per_step = (obs_elems + 8) * 4
+    return rollout_fragment_length * num_envs_per_runner * per_step
 
 
 class AggregatorActor:
@@ -109,6 +158,24 @@ class AggregatorActor:
         self._buf = []
         self._timesteps = 0
         return batch
+
+    def add_many(self, min_batch_timesteps: int, *samples) -> list:
+        """Compiled-DAG tick: fold every runner's fragment from this tick
+        into the buffer; returns the train batches that became ready (one
+        tick can complete several when fragments are large).
+
+        Fragments are COPIED out of their edge channels before buffering:
+        zero-copy reads alias the ring slots, and samples held across
+        ticks (until a batch fills) would pin more slots than the ring
+        has — the slot-pin rule's copy-on-hold requirement."""
+        batches = []
+        for s in samples:
+            s = {k: (np.array(v) if isinstance(v, np.ndarray) else v)
+                 for k, v in s.items()}
+            b = self.add(s, min_batch_timesteps)
+            if b is not None:
+                batches.append(b)
+        return batches
 
     def ping(self) -> bool:
         return True
@@ -255,6 +322,31 @@ class IMPALALearner:
         self.num_updates += 1
         return {k: float(v) for k, v in aux.items()}
 
+    def step(self, *batch_lists) -> dict:
+        """Compiled-DAG tick: consume the aggregators' ready batches
+        (possibly none — the tick still flows so the pipeline never
+        stalls), run one update per batch, and return fresh weights every
+        ``broadcast_interval`` updates — the driver feeds them into the
+        next tick's input edge, closing the Podracer weight loop over
+        channels."""
+        out = {"aux": {}, "updates": 0, "steps": 0,
+               "episode_returns": [], "weights": None}
+        for batches in batch_lists:
+            for batch in (batches or []):
+                out["episode_returns"].extend(
+                    batch.pop("episode_returns", []))
+                T, B = batch["rewards"].shape
+                out["steps"] += T * B
+                out["aux"] = self.update(batch)
+                out["updates"] += 1
+        self._since_broadcast = (getattr(self, "_since_broadcast", 0)
+                                 + out["updates"])
+        if out["updates"] and \
+                self._since_broadcast >= self.cfg.broadcast_interval:
+            out["weights"] = self.get_weights()
+            self._since_broadcast = 0
+        return out
+
     def get_weights(self):
         import jax
 
@@ -339,6 +431,92 @@ class IMPALA:
         self._iteration = 0
         self._recent_returns: list[float] = []
         self._total_steps = 0
+        # compiled-DAG execution plane (Sebulba shape): built once, ticks
+        # forever — see _build_dag
+        self._dag = None
+        self._dag_refs: list = []
+        self._next_weights = None
+        if config.use_compiled_dag:
+            self._build_dag()
+
+    # ----------------------------------------------- compiled-DAG plane
+    def _sample_nbytes(self) -> int:
+        cfg = self.config
+        return _sample_fragment_nbytes(self.module_cfg,
+                                       cfg.rollout_fragment_length,
+                                       cfg.num_envs_per_runner)
+
+    def _build_dag(self):
+        from ray_tpu.dag import InputNode
+
+        cfg = self.config
+        runners = self._runners.healthy_actors()
+        with InputNode() as inp:
+            samples = [r.sample_dag.bind(inp, cfg.rollout_fragment_length)
+                       for r in runners]
+            n_agg = len(self._aggregators)
+            agg_outs = [
+                self._aggregators[k].add_many.bind(
+                    cfg.train_batch_size, *samples[k::n_agg])
+                for k in range(n_agg)]
+            out = self._learner.step.bind(*agg_outs)
+        # slot sizing: the widest edge is agg→learner, which can carry a
+        # whole tick's worth of batches (every runner's fragment,
+        # re-concatenated); input edges carry a weights broadcast. 2x
+        # headroom over raw array bytes covers serialization framing.
+        frag_bytes = self._sample_nbytes()
+        weights_nbytes = 2 * sum(
+            int(np.asarray(w).nbytes)
+            for w in _tree_leaves(rt.get(
+                self._learner.get_weights.remote(),
+                timeout=cfg.call_timeout_s))) + (1 << 16)
+        buf = max(2 * frag_bytes * max(1, len(runners)) + (1 << 16),
+                  weights_nbytes, 1 << 20)
+        self._dag = out.experimental_compile(
+            buffer_size_bytes=buf,
+            max_inflight=max(2, cfg.max_requests_in_flight))
+
+    def _train_dag(self) -> dict:
+        """One iteration on the compiled DAG: keep `max_requests_in_flight`
+        ticks pipelined through the rings, drain results until at least
+        one learner update ran; weights returned by the learner ride the
+        NEXT tick's input edge to every runner."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        aux_last: dict = {}
+        updates = 0
+        depth = max(2, cfg.max_requests_in_flight)
+        deadline = time.monotonic() + 4 * cfg.call_timeout_s
+        want = max(1, cfg.min_updates_per_iteration)
+        soft_cap = time.monotonic() + 5.0
+        while updates < want and time.monotonic() < deadline:
+            if updates > 0 and time.monotonic() > soft_cap:
+                break  # slow env: return what we have past the soft cap
+            while len(self._dag_refs) < depth:
+                self._dag_refs.append(self._dag.execute(self._next_weights))
+                self._next_weights = None
+            ref = self._dag_refs.pop(0)
+            res = ref.get(timeout=4 * cfg.call_timeout_s)
+            self._recent_returns.extend(res["episode_returns"])
+            self._recent_returns = self._recent_returns[-100:]
+            self._total_steps += res["steps"]
+            updates += res["updates"]
+            if res["aux"]:
+                aux_last = res["aux"]
+            if res["weights"] is not None:
+                # copy-on-hold: the weights arrays alias an output ring
+                # slot; held across ticks they would pin it
+                self._next_weights = _tree_copy(res["weights"])
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            "num_learner_updates": updates,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{f"learner/{k}": v for k, v in aux_last.items()},
+        }
 
     def _pump_runners(self):
         cfg = self.config
@@ -354,6 +532,8 @@ class IMPALA:
     def train(self) -> dict:
         """One iteration: process sample results until at least one
         learner update has run."""
+        if self._dag is not None:
+            return self._train_dag()
         cfg = self.config
         t0 = time.perf_counter()
         aux_last: dict = {}
@@ -436,6 +616,12 @@ class IMPALA:
         self._broadcast_weights()
 
     def stop(self):
+        if self._dag is not None:
+            try:
+                self._dag.teardown()
+            except Exception:
+                pass
+            self._dag = None
         for a in (self._runners._actors + self._aggregators
                   + [self._learner]):
             try:

@@ -16,6 +16,7 @@ import ray_tpu as rt
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
 from ray_tpu.rl.env import make_vector_env, require_discrete
 from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.impala import _sample_fragment_nbytes, _tree_leaves
 from ray_tpu.rl.learner import JaxLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rl.module import MLPModuleConfig
 
@@ -37,6 +38,14 @@ class PPOConfig:
     num_epochs: int = 4
     minibatch_size: int = 256
     seed: int = 0
+    # steady-state sampling plane: compile the runner fleet onto a
+    # channel DAG (dag/channel_exec.py) — weights broadcast over the
+    # input edge, fragments stream back over output rings; one iteration
+    # submits `sample_waves` pipelined ticks (the waves overlap through
+    # the rings, so 2 waves cost ~1.2x one wave's wall time and double
+    # the on-policy batch per update). False restores per-call sampling.
+    use_compiled_dag: bool = True
+    sample_waves: int = 2
 
     def learner_config(self) -> PPOLearnerConfig:
         return PPOLearnerConfig(
@@ -87,16 +96,52 @@ class PPO:
         self._recent_returns: list[float] = []
         self._weights = rt.get(self._learners[0].get_weights.remote(),
                                timeout=120)
+        # compiled-DAG sampling plane (see PPOConfig.use_compiled_dag)
+        self._dag = None
+        if config.use_compiled_dag:
+            self._build_dag()
+
+    def _build_dag(self):
+        from ray_tpu.dag import InputNode, MultiOutputNode
+
+        cfg = self.config
+        runners = self._runners.healthy_actors()
+        with InputNode() as inp:
+            outs = [r.sample_dag.bind(inp, cfg.rollout_fragment_length)
+                    for r in runners]
+        node = MultiOutputNode(outs) if len(outs) > 1 else outs[0]
+        self._dag_multi = len(outs) > 1
+        sample_nbytes = 2 * _sample_fragment_nbytes(
+            self.module_cfg, cfg.rollout_fragment_length,
+            cfg.num_envs_per_runner) + (1 << 16)
+        weights_nbytes = 2 * sum(
+            int(np.asarray(w).nbytes) for w in _tree_leaves(self._weights)
+        ) + (1 << 16)
+        self._dag = node.experimental_compile(
+            buffer_size_bytes=max(sample_nbytes, weights_nbytes, 1 << 20),
+            max_inflight=max(2, cfg.sample_waves + 1))
 
     # ------------------------------------------------------------------ train
     def train(self) -> dict:
         cfg = self.config
         t0 = time.perf_counter()
-        weights_ref = rt.put(self._weights)
-        self._runners.foreach(
-            lambda a: a.set_weights.remote(weights_ref))
-        samples = self._runners.foreach(
-            lambda a: a.sample.remote(cfg.rollout_fragment_length))
+        if self._dag is not None:
+            # compiled-DAG sampling: wave 0 carries this iteration's
+            # weights over the input edge; later waves pipeline through
+            # the rings with the same weights (still on-policy — no
+            # update happens between waves)
+            refs = [self._dag.execute(self._weights if k == 0 else None)
+                    for k in range(max(1, cfg.sample_waves))]
+            samples = []
+            for ref in refs:
+                vals = ref.get(timeout=600)
+                samples.extend(vals if self._dag_multi else [vals])
+        else:
+            weights_ref = rt.put(self._weights)
+            self._runners.foreach(
+                lambda a: a.set_weights.remote(weights_ref))
+            samples = self._runners.foreach(
+                lambda a: a.sample.remote(cfg.rollout_fragment_length))
         if not samples:
             self._runners.probe_unhealthy()
             raise RuntimeError("all env runners unhealthy")
@@ -157,6 +202,12 @@ class PPO:
                 for lr in self._learners], timeout=120)
 
     def stop(self):
+        if self._dag is not None:
+            try:
+                self._dag.teardown()
+            except Exception:
+                pass
+            self._dag = None
         for a in self._runners._actors + self._learners:
             try:
                 rt.kill(a)

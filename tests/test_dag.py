@@ -214,6 +214,214 @@ def test_dag_allreduce_fallback_path(local_cluster):
     np.testing.assert_allclose(vb, [4.0])
 
 
+# --------------------------------------------- zero-copy slot-pin rule (r8)
+def test_channel_zero_copy_aliasing_and_slot_pin():
+    """read() deserializes over the slot: numpy payloads are views
+    ALIASING the ring; a slot is not reused while any view is live, and
+    a held view stays intact while the producer fills the other slots."""
+    import gc
+
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmChannel
+
+    ch = ShmChannel.create(slot_size=1 << 20, n_slots=4)
+    peer = ShmChannel.attach(ch.spec)
+    try:
+        arr = np.arange(4096, dtype=np.float64)
+        ch.write(arr)
+        out = peer.read()
+        np.testing.assert_array_equal(out, arr)
+        assert not out.flags.writeable          # ring views are read-only
+        w, r, _ = peer._seqs()
+        assert r == 0, "pinned slot must not publish read_seq"
+        # producer fills every OTHER slot, then must block: the pinned
+        # slot is not reused while the view lives
+        for i in range(3):
+            ch.write(np.full(16, float(i)))
+        with pytest.raises(TimeoutError):
+            ch.write(np.zeros(4), timeout=0.2)
+        np.testing.assert_array_equal(out, arr)  # held view intact
+        del out
+        gc.collect()
+        peer._drain_pin_events()
+        w, r, _ = peer._seqs()
+        assert r == 1, "dead view must release the slot"
+        ch.write(np.zeros(4), timeout=5.0)       # ring has room again
+        for i in range(3):
+            v = peer.read()
+            assert v[0] == float(i)
+            del v
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_channel_slot_release_is_in_ring_order():
+    """Out-of-order view death publishes read_seq only up to the first
+    still-live view (the producer's free-slot math needs a contiguous
+    prefix)."""
+    import gc
+
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmChannel
+
+    ch = ShmChannel.create(slot_size=1 << 16, n_slots=4)
+    peer = ShmChannel.attach(ch.spec)
+    try:
+        for i in range(3):
+            ch.write(np.full(64, float(i)))
+        v0, v1, v2 = peer.read(), peer.read(), peer.read()
+        del v1, v2                    # later slots die first
+        gc.collect()
+        peer._drain_pin_events()
+        _, r, _ = peer._seqs()
+        assert r == 0, "slot 0 still live: nothing may publish"
+        del v0
+        gc.collect()
+        peer._drain_pin_events()
+        _, r, _ = peer._seqs()
+        assert r == 3, "contiguous release after the head view dies"
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_channel_earlier_view_death_never_frees_later_pinned_slot():
+    """Regression: an EARLIER view dying while a LATER view is still
+    live must publish read_seq only past the dead slot — a still-pinned
+    successor entering the release walk would let the producer overwrite
+    memory the live view aliases."""
+    import gc
+
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmChannel
+
+    ch = ShmChannel.create(slot_size=1 << 16, n_slots=2)
+    peer = ShmChannel.attach(ch.spec)
+    try:
+        ch.write(np.full(64, 0.0))
+        ch.write(np.arange(64, dtype=np.float64))
+        v0 = peer.read()
+        v1 = peer.read()
+        del v0                        # HEAD view dies first
+        gc.collect()
+        peer._drain_pin_events()
+        _, r, _ = peer._seqs()
+        assert r == 1, f"slot 1 is still pinned by v1 but read_seq={r}"
+        # ring has exactly one free slot now: writes beyond it block
+        ch.write(np.full(64, 2.0))
+        with pytest.raises(TimeoutError):
+            ch.write(np.full(64, 3.0), timeout=0.2)
+        np.testing.assert_array_equal(v1, np.arange(64, dtype=np.float64))
+        del v1
+        gc.collect()
+        peer._drain_pin_events()
+        _, r, _ = peer._seqs()
+        assert r == 2
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_channel_scatter_write_chunks_roundtrip():
+    """write_chunks scatter-writes a serialize() chunk list (the
+    broadcast path serializes once for N channels)."""
+    from ray_tpu._internal.serialization import serialize, serialized_size
+    from ray_tpu.dag.channel import ShmChannel
+
+    import numpy as np
+
+    ch = ShmChannel.create(slot_size=1 << 20, n_slots=2)
+    peer = ShmChannel.attach(ch.spec)
+    try:
+        value = {"w": np.arange(1000, dtype=np.float32), "tag": "x"}
+        chunks = serialize(value)
+        total = serialized_size(chunks)
+        ch.write_chunks(chunks, total)
+        out = peer.read()
+        np.testing.assert_array_equal(out["w"], value["w"])
+        assert out["tag"] == "x"
+        # oversized payloads fail fast, not by corruption
+        with pytest.raises(ValueError):
+            ch.write(np.zeros(1 << 20, np.float64))
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_get_tick_single_deadline(local_cluster):
+    """_get_tick enforces ONE overall deadline across all output
+    channels. Outputs delivering STAGGERED at ~1s intervals with
+    timeout=1.3s: the old per-channel loop granted each read a fresh
+    1.3s window, so get() SUCCEEDED after ~4s — 3x past its timeout;
+    the shared deadline must raise at ~1.3s instead."""
+    import time
+
+    from ray_tpu.dag import MultiOutputNode
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class Sleepy:
+        def __init__(self, delay):
+            self.delay = delay
+
+        def nap(self, x):
+            time.sleep(self.delay)
+            return x
+
+    actors = [Sleepy.remote(1.0 * (i + 1)) for i in range(4)]
+    with InputNode() as inp:
+        dag = MultiOutputNode(
+            [a.nap.bind(inp) for a in actors]).experimental_compile(
+                channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    try:
+        ref = dag.execute(1)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            ref.get(timeout=1.3)
+        assert time.monotonic() - t0 < 3.0, "deadline was per-channel"
+        # a deadline firing MID-WAVE (some outputs consumed) must not
+        # desynchronize the channels: a later get resumes the wave and
+        # returns the SAME tick's value on every output
+        assert ref.get(timeout=30.0) == [1, 1, 1, 1]
+    finally:
+        dag.teardown()
+
+
+def test_teardown_closes_each_channel_once(local_cluster):
+    """Output channels live in the driver handle list once; teardown
+    closes every ring exactly once (close() is idempotent — no owner
+    double-unlink)."""
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+    @rt.remote
+    class E:
+        def f(self, x):
+            return x
+
+    e = E.remote()
+    with InputNode() as inp:
+        dag = e.f.bind(inp).experimental_compile(channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    assert dag.execute(7).get(timeout=60) == 7
+    import collections
+
+    calls = collections.Counter()
+    for ch in dag._driver_channels:
+        orig = ch._mark_closed
+        ch._mark_closed = (lambda _o=orig, _c=id(ch):
+                           (calls.update([_c]), _o())[-1])
+    dag.teardown()
+    dag.teardown()   # idempotent
+    assert len(calls) == len(dag._driver_channels), "a channel never closed"
+    assert all(v == 1 for v in calls.values()), \
+        f"a ring was closed more than once: {calls}"
+
+
 def test_channel_uses_native_release_acquire_atomics():
     """The SPSC seq words must ride the _native release/acquire helpers
     whenever the lib builds (ARM64-safe publish); pure-Python fallback

@@ -1,0 +1,194 @@
+"""Cross-node compiled-DAG tests: DCN ring channels over the RPC plane
+(dag/dcn_channel.py) keep multi-node actor graphs on the channel fast
+path instead of the per-call fallback."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+
+
+def _kill(*actors):
+    """Tests share one module-scoped cluster: free each test's actors so
+    the next test's placement isn't starved."""
+    for a in actors:
+        try:
+            rt.kill(a)
+        except Exception:
+            pass
+
+
+# module-scoped: one two-node cluster serves every DCN test (each test
+# uses fresh actors; booting a cluster per test would dominate the file)
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    # "red" pins actors to the head (the driver's node), "blue" to node
+    # B — every cross-node test below is placement-DETERMINISTIC
+    cluster = Cluster(head_resources={"CPU": 4.0, "red": 4.0})
+    node_b = cluster.add_node(num_cpus=4, resources={"blue": 4.0})
+    cluster.connect()
+    try:
+        yield cluster, node_b
+    finally:
+        cluster.shutdown()
+
+
+def test_cross_node_dag_compiles_onto_dcn_channels(two_node_cluster):
+    """A DAG spanning nodes must compile onto the channel plane with DCN
+    edges — NOT fall back to the per-call executor (channels='auto')."""
+    @rt.remote(num_cpus=1, resources={"red": 1.0})
+    class Local:
+        def inc(self, x):
+            return x + 1
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    class Remote:
+        def scale(self, x):
+            return x * 10
+
+    a, b = Local.remote(), Remote.remote()
+    with InputNode() as inp:
+        out = b.scale.bind(a.inc.bind(inp))
+    dag = out.experimental_compile()   # "auto" must pick channels
+    assert isinstance(dag, ChannelCompiledDAG)
+    assert dag.channel_kinds["dcn"] >= 1, dag.channel_kinds
+    try:
+        for i in range(6):
+            assert dag.execute(i).get(timeout=90) == (i + 1) * 10
+    finally:
+        dag.teardown()
+        _kill(a, b)
+
+
+def test_cross_node_dag_large_payload_and_multi_output(two_node_cluster):
+    """Numpy payloads past the scatter-gather threshold cross the DCN
+    edge intact, and multi-output DAGs mix shm + DCN output channels."""
+    @rt.remote(num_cpus=1, resources={"red": 1.0})
+    class Local:
+        def double(self, x):
+            return x * 2.0
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    class Remote:
+        def negate(self, x):
+            return -x
+
+    a, b = Local.remote(), Remote.remote()
+    with InputNode() as inp:
+        da = a.double.bind(inp)
+        nb = b.negate.bind(inp)
+        dag = MultiOutputNode([da, nb]).experimental_compile(
+            buffer_size_bytes=8 << 20)
+    assert isinstance(dag, ChannelCompiledDAG)
+    assert dag.channel_kinds["dcn"] >= 1
+    try:
+        arr = np.arange(500_000, dtype=np.float64)   # 4 MB
+        va, vb = dag.execute(arr).get(timeout=90)
+        np.testing.assert_array_equal(va, arr * 2.0)
+        np.testing.assert_array_equal(vb, -arr)
+    finally:
+        dag.teardown()
+        _kill(a, b)
+
+
+def test_error_flows_across_dcn_edge(two_node_cluster):
+    """An exception raised on the remote node flows along the DCN edge,
+    raises at the driver with the remote traceback chained, and leaves
+    the DAG alive for the next tick."""
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    class Boom:
+        def apply(self, x):
+            if x == 3:
+                raise ValueError("boom at 3")
+            return x
+
+    @rt.remote(num_cpus=1, resources={"red": 1.0})
+    class Pass:
+        def fwd(self, x):
+            return x
+
+    b, p = Boom.remote(), Pass.remote()
+    with InputNode() as inp:
+        out = p.fwd.bind(b.apply.bind(inp))   # error crosses the DCN edge
+    dag = out.experimental_compile()
+    assert isinstance(dag, ChannelCompiledDAG)
+    assert dag.channel_kinds["dcn"] >= 1
+    try:
+        assert dag.execute(1).get(timeout=90) == 1
+        with pytest.raises(ValueError, match="boom at 3") as ei:
+            dag.execute(3).get(timeout=90)
+        # remote tick traceback is chained onto the re-raised error
+        assert ei.value.__cause__ is not None
+        assert "boom at 3" in str(ei.value.__cause__)
+        # DAG survives the error tick
+        assert dag.execute(5).get(timeout=90) == 5
+    finally:
+        dag.teardown()
+        _kill(b, p)
+
+
+def test_teardown_while_peer_blocked(two_node_cluster):
+    """teardown() must unblock peers parked on a full/empty channel: a
+    fast producer fills the ring ahead of a slow consumer; closing the
+    channels cascades ChannelClosed through the graph and the loop refs
+    resolve instead of hanging."""
+    @rt.remote(num_cpus=1, resources={"red": 1.0})
+    class Fast:
+        def produce(self, x):
+            return np.zeros(1024, np.float64) + x
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    class Slow:
+        def consume(self, x):
+            time.sleep(0.5)
+            return float(x[0])
+
+    f, s = Fast.remote(), Slow.remote()
+    with InputNode() as inp:
+        out = s.consume.bind(f.produce.bind(inp))
+    dag = out.experimental_compile(max_inflight=2)
+    assert isinstance(dag, ChannelCompiledDAG)
+    refs = [dag.execute(i) for i in range(6)]   # more ticks than slots
+    assert refs[0].get(timeout=90) == 0.0
+    # producer is now ahead of the slow consumer (rings full); teardown
+    # must return promptly and the actor loops must exit
+    try:
+        t0 = time.monotonic()
+        dag.teardown()
+        assert time.monotonic() - t0 < 25.0
+        done, not_done = rt.wait(dag._loop_refs,
+                                 num_returns=len(dag._loop_refs),
+                                 timeout=10.0)
+        assert not not_done, "actor loops did not exit after teardown"
+    finally:
+        _kill(f, s)
+
+
+def test_dcn_channel_credit_backpressure(two_node_cluster):
+    """Direct DCN channel semantics (loopback): the credit window caps
+    in-flight items at n_slots, credits return as the consumer reads,
+    and either side closing surfaces ChannelClosed on the peer."""
+    from ray_tpu.dag.channel import ChannelClosed
+    from ray_tpu.dag.dcn_channel import DcnProducerChannel, create_endpoint
+
+    cons = create_endpoint("t-credit", 3, 1 << 20)
+    prod = DcnProducerChannel(cons.spec)
+    try:
+        for i in range(3):
+            prod.write(i)
+        with pytest.raises(TimeoutError):
+            prod.write(99, timeout=0.3)     # window exhausted
+        assert cons.read(timeout=10) == 0   # returns one credit
+        prod.write(99, timeout=10)
+        for expect in (1, 2, 99):
+            assert cons.read(timeout=10) == expect
+    finally:
+        prod.close()
+        with pytest.raises(ChannelClosed):
+            cons.read(timeout=10)
+        cons.close()
