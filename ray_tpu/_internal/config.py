@@ -152,6 +152,26 @@ class Config:
     # (pins held by live zero-copy views are legal — the flag marks
     # ones that look forgotten, surfaced via `rayt memory` summaries).
     object_leak_grace_s: float = 5.0
+    # ---- compiled-DAG execution-plane observability ----
+    # Per-tick deadline for ChannelCompiledDAG driver reads (get() with
+    # no explicit timeout) and execute()'s input-channel writes. The old
+    # hardcoded 300.0s, now tunable: RL loops on slow envs raise it,
+    # tests shrink it.
+    dag_tick_timeout_s: float = 300.0
+    # Compiled-DAG stall watchdog: an edge whose producer is parked on a
+    # full ring (or consumer on an empty one) for longer than this is
+    # flagged in the GCS dag record; when the blocked side's peer actor
+    # is DEAD, the record (and the _get_tick timeout error) names it.
+    dag_stall_grace_s: float = 5.0
+    # DAG-plane state reports: driver + actor loops publish per-channel
+    # tick/byte/occupancy/block stats on the `dag_state` channel at this
+    # cadence. Disabling removes registration, reports and the watchdog.
+    dag_state_enabled: bool = True
+    dag_state_report_interval_s: float = 1.0
+    # GCS dag-manager memory bound: max DAG records kept; beyond it the
+    # job holding the most records evicts oldest-first with per-job
+    # dropped accounting (same contract as task/object managers).
+    dag_state_max_dags: int = 500
 
     # ---- logging ----
     log_level: str = "INFO"

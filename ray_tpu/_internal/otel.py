@@ -161,6 +161,16 @@ def execute_span(name: str, carrier: Optional[dict], **attrs):
                  attrs)
 
 
+def export_chrome_trace(trace_dir: str, path: str) -> int:
+    """Render every exported span as Chrome trace slices via the shared
+    exporter in tracing.py (one pid row per process, one tid row per
+    trace id — a compiled-DAG tick's producer/consumer spans line up on
+    one row because they share the driver's trace id)."""
+    from ray_tpu._internal.tracing import export_chrome_trace as _export
+
+    return _export(read_spans(trace_dir), path)
+
+
 def read_spans(trace_dir: str) -> list[dict]:
     """Aggregate every process's exported spans (analysis/test helper)."""
     out: list[dict] = []

@@ -182,13 +182,32 @@ def to_chrome_trace(events: list[dict]) -> dict:
     """Chrome trace-viewer JSON (load via chrome://tracing / Perfetto).
 
     Accepts coalesced task records (GCS task manager, carry a "states"
-    map -> nested lifecycle slices) and legacy flat duration events
+    map -> nested lifecycle slices), otel spans (otel.py read_spans
+    dicts, carry "start_ns" — per-tick DAG spans land here, grouped by
+    pid and stitched by trace id), and legacy flat duration events
     (single "X" each); meta events are skipped.
     """
     trace_events: list[dict] = []
     for ev in events:
         if "states" in ev:
             trace_events.extend(_record_slices(ev))
+            continue
+        if "start_ns" in ev:
+            trace_events.append({
+                "name": ev.get("name", "span"),
+                "cat": ev.get("kind", "span"),
+                "ph": "X",
+                "ts": ev["start_ns"] // 1000,
+                "dur": max(1, (ev.get("end_ns", ev["start_ns"])
+                               - ev["start_ns"]) // 1000),
+                "pid": f"pid:{ev.get('pid', '?')}",
+                "tid": f"trace:{(ev.get('trace_id') or '?')[:8]}",
+                "args": {"trace_id": ev.get("trace_id", ""),
+                         "span_id": ev.get("span_id", ""),
+                         "parent_id": ev.get("parent_id"),
+                         "ok": ev.get("status_ok", True),
+                         **(ev.get("attributes") or {})},
+            })
             continue
         if ev.get("kind") == "meta":
             continue

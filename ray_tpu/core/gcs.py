@@ -30,8 +30,10 @@ from ray_tpu.util.metrics import CH_METRICS
 logger = setup_logger("gcs")
 
 # Pubsub channel names (CH_METRICS is canonical in util/metrics.py,
-# CH_OBJECTS in core/gcs_object_manager.py — the owning side defines
-# them; re-exported here next to their siblings)
+# CH_OBJECTS in core/gcs_object_manager.py, CH_DAGS in
+# core/gcs_dag_manager.py — the owning side defines them; re-exported
+# here next to their siblings)
+from ray_tpu.core.gcs_dag_manager import CH_DAGS, GcsDagManager  # noqa: E402
 from ray_tpu.core.gcs_object_manager import (CH_OBJECTS,  # noqa: E402
                                              GcsObjectManager)
 
@@ -107,6 +109,13 @@ class GcsServer:
         # channel (ref: gcs_object_manager.h / `ray memory` aggregation)
         self.object_manager = GcsObjectManager(
             max_objects=cfg0.object_state_max_objects)
+        # compiled-DAG execution-plane state store fed by the
+        # `dag_state` channel; the stall watchdog cross-references the
+        # actor table for dead-peer attribution
+        self.dag_manager = GcsDagManager(
+            max_dags=cfg0.dag_state_max_dags,
+            stall_grace_s=cfg0.dag_stall_grace_s,
+            actor_state=self._actor_state_by_hex)
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -335,6 +344,12 @@ class GcsServer:
                 self.metrics_store.ingest(message)
         elif channel == CH_OBJECTS:
             self.object_manager.ingest(message)
+        elif channel == CH_DAGS:
+            self.dag_manager.ingest(message)
+            # report deltas derive the rayt_dag_* Prometheus family
+            recs = self.dag_manager.drain_metric_records()
+            if recs:
+                self.metrics_store.ingest_many(recs)
         dead = []
         # snapshot: the notify below awaits, and a concurrent subscribe /
         # connection-close discard mutating the live set mid-iteration
@@ -628,6 +643,13 @@ class GcsServer:
             self.mark_dirty()
         # the exiting driver owns the job's objects: drop their records
         self.object_manager.on_job_finished(job_id.hex())
+        # ...and its compiled DAGs (their loops die with the driver);
+        # drain the gauge update this may emit (no report will follow
+        # to carry it — a dead job's stall must not read as live)
+        self.dag_manager.on_job_finished(job_id.hex())
+        recs = self.dag_manager.drain_metric_records()
+        if recs:
+            self.metrics_store.ingest_many(recs)
         # node managers relay this to their pooled workers, which drop
         # the finished job's function-table entries (pooled workers
         # outlive jobs; see core/function_table.py evict_job)
@@ -1041,6 +1063,27 @@ class GcsServer:
         memory`'s data source)."""
         return self.object_manager.summarize(**dict(arg or {}))
 
+    def _actor_state_by_hex(self, actor_hex: str):
+        """Liveness lookup for the dag manager's stall attribution.
+        O(actors) — only paid when an edge is blocked past the grace
+        window, never on the report hot path."""
+        for aid, info in self.actors.items():
+            if aid.hex() == actor_hex:
+                return info.state
+        return None
+
+    def rpc_list_dags(self, conn, arg=None):
+        """State API `list_dags` backend: filtered compiled-DAG records
+        (job / dag id / stalled-only, limit) with per-edge stats, stall
+        attribution, and sparkline history — server-side, no full-store
+        dump to the client."""
+        return self.dag_manager.list(**dict(arg or {}))
+
+    def rpc_summarize_dags(self, conn, arg=None):
+        """State API `summarize_dags` backend: DAG counts by state,
+        tick/byte/blocked-time totals, and current stalls."""
+        return self.dag_manager.summarize(**dict(arg or {}))
+
     def rpc_metrics_snapshot(self, conn, arg=None):
         return self.metrics_store.snapshot()
 
@@ -1205,6 +1248,7 @@ class GcsClient:
         "metrics_names", "metrics_query",
         "get_task_events", "list_tasks", "summarize_tasks",
         "list_objects_state", "summarize_objects",
+        "list_dags", "summarize_dags",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU

@@ -106,6 +106,69 @@ class ChannelClosed(Exception):
     pass
 
 
+class ChannelStats:
+    """Per-channel-instance counters for the DAG-plane observability
+    pipeline (PR-2/PR-6 symmetric: these feed the `dag_state` pubsub
+    reports and the `rayt_dag_*` Prometheus family).
+
+    Hot-path cost is a couple of attribute increments per tick; the
+    block-time fields are only touched when a read/write actually
+    parks. Read concurrently by the per-process reporter thread —
+    plain int/float attribute reads, no lock needed (GIL-consistent,
+    and a torn read is at worst one tick stale)."""
+
+    __slots__ = ("writes", "reads", "bytes_written", "bytes_read",
+                 "write_block_s", "read_block_s", "pins_sealed",
+                 "gc_nudges", "write_blocked_since", "read_blocked_since")
+
+    def __init__(self):
+        self.writes = 0
+        self.reads = 0
+        self.bytes_written = 0
+        self.bytes_read = 0
+        self.write_block_s = 0.0     # cumulative seconds parked on full
+        self.read_block_s = 0.0      # cumulative seconds parked on empty
+        self.pins_sealed = 0         # reads whose views aliased the slot
+        self.gc_nudges = 0           # collector kicks for cycle-trapped views
+        # monotonic timestamps while CURRENTLY parked (None otherwise);
+        # the reporter turns these into live blocked-durations so the
+        # stall watchdog sees a block that never returns
+        self.write_blocked_since: float | None = None
+        self.read_blocked_since: float | None = None
+
+    def end_write_block(self):
+        if self.write_blocked_since is not None:
+            self.write_block_s += time.monotonic() - self.write_blocked_since
+            self.write_blocked_since = None
+
+    def end_read_block(self):
+        if self.read_blocked_since is not None:
+            self.read_block_s += time.monotonic() - self.read_blocked_since
+            self.read_blocked_since = None
+
+    def blocked_now(self) -> tuple[float, float]:
+        """(write_blocked_s, read_blocked_s) of any IN-PROGRESS park."""
+        now = time.monotonic()
+        wb = self.write_blocked_since
+        rb = self.read_blocked_since
+        return (now - wb if wb is not None else 0.0,
+                now - rb if rb is not None else 0.0)
+
+    def snapshot(self) -> dict:
+        wb_now, rb_now = self.blocked_now()
+        return {
+            "writes": self.writes, "reads": self.reads,
+            "bytes_written": self.bytes_written,
+            "bytes_read": self.bytes_read,
+            "write_block_s": self.write_block_s + wb_now,
+            "read_block_s": self.read_block_s + rb_now,
+            "pins_sealed": self.pins_sealed,
+            "gc_nudges": self.gc_nudges,
+            "write_blocked_s_now": wb_now,
+            "read_blocked_s_now": rb_now,
+        }
+
+
 class _SlotPin:
     """Tracks the deserialized out-of-band views aliasing ONE ring slot.
 
@@ -186,6 +249,7 @@ class ShmChannel:
         self._read_pub = r        # last published read_seq
         self._unreleased: set[int] = set()   # read but still pinned
         self._pin_events: collections.deque = collections.deque()
+        self.stats = ChannelStats()
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -269,6 +333,42 @@ class ShmChannel:
         i = seq % self.spec.n_slots
         return _HDR_SIZE + i * (_LEN.size + self.spec.slot_size)
 
+    # -------------------------------------------------------- observability
+    def occupancy(self) -> int:
+        """Items published but not yet released (ring fill level).
+        Counts slots still pinned by live views — from the producer's
+        point of view they ARE occupied."""
+        if self._closed_locally:
+            return 0  # never touch the (possibly unmapped) ring
+        try:
+            w, r, _ = self._seqs()
+            return max(0, w - r)
+        except Exception:
+            return 0  # closed mapping mid-snapshot
+
+    def pinned_slots(self) -> int:
+        """Slots this consumer read whose views still alias the ring."""
+        return max(0, self._cursor - self._read_pub)
+
+    def cursor_state(self) -> tuple[int, int]:
+        """(reads consumed locally, items published by the producer) —
+        the per-output-channel positions the _get_tick timeout error
+        reports so mid-wave desync is diagnosable from the exception."""
+        if self._closed_locally:
+            return self._cursor, -1
+        try:
+            w, _, _ = self._seqs()
+        except Exception:
+            w = -1
+        return self._cursor, w
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["occupancy"] = self.occupancy()
+        snap["pinned_slots"] = self.pinned_slots()
+        snap["n_slots"] = self.spec.n_slots
+        return snap
+
     def write_bytes(self, payload: bytes, timeout: float | None = None):
         if len(payload) > self.spec.slot_size:
             # non-retryable (unlike a transiently-full ring, which blocks)
@@ -281,20 +381,28 @@ class ShmChannel:
         _LEN.pack_into(self._buf, off, len(payload))
         self._buf[off + _LEN.size:off + _LEN.size + len(payload)] = payload
         self._set_write_seq(w + 1)  # publish LAST
+        self.stats.writes += 1
+        self.stats.bytes_written += len(payload)
 
     def read_bytes(self, timeout: float | None = None) -> bytes:
         """Copy read: materializes the slot payload to bytes and releases
         the slot immediately (shares the consumer cursor with read())."""
         deadline = None if timeout is None else time.monotonic() + timeout
         pause = 0.0
+        st = self.stats
         while True:
             self._drain_pin_events()
             w, _, closed = self._seqs()
             if w > self._cursor:
+                st.end_read_block()
                 break
             if closed:
+                st.end_read_block()
                 raise ChannelClosed()
+            if st.read_blocked_since is None:
+                st.read_blocked_since = time.monotonic()
             if deadline is not None and time.monotonic() > deadline:
+                st.end_read_block()
                 raise TimeoutError("channel read timed out (ring empty)")
             time.sleep(pause)
             pause = min(0.001, pause + 0.00005)
@@ -303,6 +411,8 @@ class ShmChannel:
         payload = bytes(self._buf[off + _LEN.size:off + _LEN.size + length])
         seq, self._cursor = self._cursor, self._cursor + 1
         self._release_seq(seq)
+        st.reads += 1
+        st.bytes_read += length
         return payload
 
     # ----------------------------------------------------------- object api
@@ -336,6 +446,8 @@ class ShmChannel:
             self._buf[pos:pos + n] = c
             pos += n
         self._set_write_seq(w + 1)  # publish LAST
+        self.stats.writes += 1
+        self.stats.bytes_written += total
 
     def read(self, timeout: float | None = None):
         """Zero-copy read: deserializes over a memoryview of the slot.
@@ -344,14 +456,20 @@ class ShmChannel:
         deadline = None if timeout is None else time.monotonic() + timeout
         pause = 0.0
         gc_nudge = time.monotonic() + 0.05
+        st = self.stats
         while True:
             self._drain_pin_events()
             w, _, closed = self._seqs()
             if w > self._cursor:
+                st.end_read_block()
                 break
             if closed:
+                st.end_read_block()
                 raise ChannelClosed()
+            if st.read_blocked_since is None:
+                st.read_blocked_since = time.monotonic()
             if deadline is not None and time.monotonic() > deadline:
+                st.end_read_block()
                 raise TimeoutError("channel read timed out (ring empty)")
             if self._read_pub < self._cursor and not self._pin_events \
                     and time.monotonic() > gc_nudge:
@@ -365,6 +483,7 @@ class ShmChannel:
                 import gc
 
                 gc.collect()
+                st.gc_nudges += 1
                 gc_nudge = time.monotonic() + 0.5
             time.sleep(pause)
             pause = min(0.001, pause + 0.00005)
@@ -380,23 +499,34 @@ class ShmChannel:
             raise
         if pin.seal():
             self._release_seq(pin.seq)
-        # else: the slot releases via the pin's finalizer events ONLY —
-        # it must NOT enter _unreleased yet, or an earlier slot's release
-        # walk would publish read_seq past this still-pinned slot and the
-        # producer would overwrite memory a live view aliases
+        else:
+            st.pins_sealed += 1
+        # on the pinned branch the slot releases via the pin's finalizer
+        # events ONLY — it must NOT enter _unreleased yet, or an earlier
+        # slot's release walk would publish read_seq past this
+        # still-pinned slot and the producer would overwrite memory a
+        # live view aliases
+        st.reads += 1
+        st.bytes_read += length
         return value
 
     # ------------------------------------------------------- slot pinning
     def _wait_writable(self, timeout: float | None) -> int:
         deadline = None if timeout is None else time.monotonic() + timeout
         pause = 0.0
+        st = self.stats
         while True:
             w, r, closed = self._seqs()
             if closed:
+                st.end_write_block()
                 raise ChannelClosed()
             if w - r < self.spec.n_slots:
+                st.end_write_block()
                 return w
+            if st.write_blocked_since is None:
+                st.write_blocked_since = time.monotonic()
             if deadline is not None and time.monotonic() > deadline:
+                st.end_write_block()
                 raise TimeoutError("channel write timed out (ring full)")
             time.sleep(pause)
             pause = min(0.001, pause + 0.00005)

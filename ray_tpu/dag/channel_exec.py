@@ -35,15 +35,25 @@ re-raised exception.
 from __future__ import annotations
 
 import pickle
+import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 from ray_tpu.dag.dcn_channel import (DcnProducerChannel, _dcn_create_endpoints,
                                      attach_channel, create_endpoint)
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode, InputAttributeNode,
                               InputNode, MultiOutputNode)
+
+logger = setup_logger("dag")
+
+# pubsub channel the DAG-plane state reports ride (the owning side —
+# core/gcs_dag_manager.py, next to its consumer — defines it, same
+# convention as CH_OBJECTS/CH_METRICS)
+from ray_tpu.core.gcs_dag_manager import CH_DAGS  # noqa: E402
 
 
 class Ineligible(Exception):
@@ -69,6 +79,110 @@ class _TickError:
         self.tb = tb
 
 
+class _TraceTick:
+    """Envelope that threads the driver tick's span context through
+    channel writes when distributed tracing is on (RAYT_TRACING_DIR):
+    every process's per-tick span parents off the driver's execute
+    span, so one tick stitches into ONE trace across producer/consumer
+    processes. Consumers unwrap unconditionally, so mixed-enablement
+    clusters stay correct."""
+
+    __slots__ = ("carrier", "tick", "value")
+
+    def __init__(self, carrier, tick, value):
+        self.carrier = carrier
+        self.tick = tick
+        self.value = value
+
+    def __reduce__(self):
+        return (_TraceTick, (self.carrier, self.tick, self.value))
+
+
+# reusable no-op context for the untraced compute path
+import contextlib as _contextlib
+
+_NULL_SPAN = _contextlib.nullcontext({"ok": True})
+
+
+def _chan_key(spec) -> str:
+    """The channel's stable wire identity: shm segment name or DCN
+    token — the key dag registrations map to edge ids."""
+    return getattr(spec, "name", None) or getattr(spec, "token", "")
+
+
+class _DagReporter:
+    """Per-process DAG-plane state publisher: a daemon thread snapshots
+    this process's channel stats every report interval and publishes
+    them on the ``dag_state`` channel (fire-and-forget onto the core
+    worker's IO loop — observability must never block a tick). Runs in
+    the driver AND in every actor loop; it keeps publishing while the
+    loop thread is PARKED on a full/empty ring, which is exactly what
+    lets the GCS watchdog see a stall that never returns."""
+
+    def __init__(self, dag_id: str, channels: list, cw=None):
+        # channels: [(role, channel)] — role is this process's side
+        self._dag_id = dag_id
+        self._channels = channels
+        self._cw = cw
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        from ray_tpu._internal.config import get_config
+
+        cfg = get_config()
+        if not cfg.dag_state_enabled or not self._dag_id:
+            return
+        self._interval = cfg.dag_state_report_interval_s
+        self._thread = threading.Thread(
+            target=self._run, name="rayt-dag-report", daemon=True)
+        self._thread.start()
+
+    def stop(self, join: bool = False):
+        """Signal the thread to exit (it fires one final publish).
+        ``join=True`` waits for it — REQUIRED before closing the
+        channels it snapshots: a snapshot racing a close would hit the
+        shm ring's native-atomics load on an unmapped address (SIGSEGV,
+        not a catchable exception)."""
+        self._stop.set()
+        if join and self._thread is not None:
+            self._thread.join(timeout=3.0)
+
+    def _core_worker(self):
+        if self._cw is not None:
+            return self._cw
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            return get_core_worker()
+        except Exception:
+            return None
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            self.publish_once()
+        self.publish_once()  # final snapshot before the loop exits
+
+    def publish_once(self):
+        chans: dict[str, dict] = {}
+        for role, ch in self._channels:
+            try:
+                snap = ch.snapshot()
+                snap["role"] = role
+                chans[_chan_key(ch.spec)] = snap
+            except Exception:
+                pass  # channel closed mid-snapshot
+        cw = self._core_worker()
+        if not chans or cw is None or cw.gcs is None:
+            return
+        report = {"kind": "report", "dag_id": self._dag_id,
+                  "ts": time.time(), "channels": chans}
+        try:
+            cw._spawn_from_thread(cw.gcs.publish(CH_DAGS, report))
+        except Exception:
+            pass  # best-effort: dropped on GCS hiccup / shutdown
+
+
 @dataclass
 class _Op:
     method: str
@@ -90,6 +204,7 @@ class _ActorSchedule:
     collective_group: str | None = None
     collective_world: int = 0
     collective_rank: int = 0
+    dag_id: str = ""                  # dag_state reporting key ("" = off)
 
 
 def _dag_actor_loop(self, sched_blob: bytes):
@@ -110,16 +225,12 @@ def _dag_actor_loop(self, sched_blob: bytes):
 
 
 def _dag_loop_body(self, sched: _ActorSchedule):
-    import os
-    _trace = None
-    if os.environ.get("RAYT_DAG_TRACE"):
-        _tf = open(f"/tmp/dagtrace-{os.getpid()}.log", "a", buffering=1)
-        _trace = lambda *a: _tf.write(" ".join(map(str, a)) + "\n")  # noqa
-        _trace("loop start", type(self).__name__,
-               [op.method for op in sched.ops])
+    from ray_tpu._internal import otel
+
     ins: list = []
     outs: list = []
     group = None
+    reporter = None
     try:
         # attach incrementally so a startup failure still closes whatever
         # came up (peers then see ChannelClosed instead of a timeout)
@@ -127,6 +238,12 @@ def _dag_loop_body(self, sched: _ActorSchedule):
             ins.append(attach_channel(s))
         for s in sched.out_channels:
             outs.append(attach_channel(s))
+        if sched.dag_id:
+            reporter = _DagReporter(
+                sched.dag_id,
+                [("consumer", ch) for ch in ins]
+                + [("producer", ch) for ch in outs])
+            reporter.start()
         if sched.collective_group:
             from ray_tpu.util.collective import init_collective_group
 
@@ -136,14 +253,19 @@ def _dag_loop_body(self, sched: _ActorSchedule):
         tick_no = 0
         while True:
             reads: dict[int, Any] = {}
+            # the driver's per-tick span context, captured from the
+            # first _TraceTick envelope read this tick (tracing off ->
+            # stays None and no spans open)
+            trace_ctx: list = [None, tick_no]
 
             def read_ch(i):
                 if i not in reads:
-                    if _trace:
-                        _trace("tick", tick_no, "read ch", i)
-                    reads[i] = ins[i].read()
-                    if _trace:
-                        _trace("tick", tick_no, "read ch", i, "done")
+                    v = ins[i].read()
+                    if type(v) is _TraceTick:
+                        trace_ctx[0] = v.carrier
+                        trace_ctx[1] = v.tick
+                        v = v.value
+                    reads[i] = v
                 return reads[i]
 
             locals_: dict[int, Any] = {}
@@ -201,21 +323,32 @@ def _dag_loop_body(self, sched: _ActorSchedule):
 
                         result = _TickError(e, traceback.format_exc())
                 else:
+                    # per-tick span, remote-parented by the driver's
+                    # execute span via the carrier that rode the edge
+                    # (nullcontext when tracing is off)
+                    span = (otel.execute_span(
+                        f"dag.{op.method}", trace_ctx[0],
+                        dag_id=sched.dag_id, tick=trace_ctx[1])
+                        if trace_ctx[0] is not None
+                        else _NULL_SPAN)
                     try:
-                        result = getattr(self, op.method)(*args, **kwargs)
+                        with span:
+                            result = getattr(self, op.method)(
+                                *args, **kwargs)
                     except Exception as e:
                         import traceback
 
                         result = _TickError(e, traceback.format_exc())
                 locals_[op.pos] = result
-                if _trace:
-                    _trace("tick", tick_no, "computed", op.method,
-                           "writes", op.writes)
+                out_val = result
+                if trace_ctx[0] is not None:
+                    # forward the SAME tick carrier along every edge so
+                    # downstream spans join the driver's trace
+                    out_val = _TraceTick(trace_ctx[0], trace_ctx[1],
+                                         result)
                 try:
                     for w in op.writes:
-                        outs[w].write(result)
-                        if _trace:
-                            _trace("tick", tick_no, "wrote", w)
+                        outs[w].write(out_val)
                 except ChannelClosed:
                     stop = True   # a downstream peer tore down mid-tick
                     break
@@ -223,6 +356,10 @@ def _dag_loop_body(self, sched: _ActorSchedule):
                 break
             tick_no += 1
     finally:
+        if reporter is not None:
+            # join BEFORE closing: a snapshot racing close() would load
+            # ring seqs through an unmapped native-atomics pointer
+            reporter.stop(join=True)
         for ch in outs:   # propagate shutdown downstream
             try:
                 ch.close()
@@ -289,9 +426,13 @@ class ChannelCompiledDAG:
         if any(getattr(n, "tensor_transport", False) for n in compute):
             raise Ineligible("device edges use the device-object plane")
 
+        from ray_tpu._internal.config import get_config
         from ray_tpu.api import _core_worker
 
         self._cw = _core_worker()
+        self._cfg = get_config()
+        # identity for the GCS dag-state record (`rayt dag <id>`)
+        self.dag_id = uuid.uuid4().hex[:16]
         my_node = self._cw.node_id
         placement = self._actor_placement(compute)   # id(actor) -> node_id
 
@@ -301,10 +442,12 @@ class ChannelCompiledDAG:
         # processes, so they take one compile-time RPC per consumer actor.
         slots = max(2, max_inflight)
         plans: list[_ChanPlan] = []
+        plan_ends: list[tuple] = []   # (producer_key, consumer_key) per plan
 
         def plan_channel(consumer_key: int | None,
                          producer_key: int | None) -> int:
             """consumer/producer: id(actor handle), or None = driver."""
+            plan_ends.append((producer_key, consumer_key))
             c_node = my_node if consumer_key is None else \
                 placement[consumer_key]
             p_node = my_node if producer_key is None else \
@@ -421,6 +564,23 @@ class ChannelCompiledDAG:
         self._wire_collectives(compute, scheds, actors)
 
         # ---- materialize channels ---------------------------------------
+        # every Ineligible check has passed by here: a failure below is a
+        # hard error (e.g. a consumer actor died before its endpoint
+        # RPC), and the already-created rings were opened UNTRACKED
+        # (resource_tracker disabled by design) — close them on the way
+        # out or each failed compile leaks its /dev/shm segments
+        try:
+            self._init_channels(plans, plan_ends, actors, scheds)
+        except Exception:
+            for p in plans:
+                if p.handle is not None:
+                    try:
+                        p.handle.close()
+                    except Exception:
+                        pass
+            raise
+
+    def _init_channels(self, plans, plan_ends, actors, scheds):
         self._materialize_channels(plans, actors)
         self.channel_kinds = {"shm": sum(p.kind == "shm" for p in plans),
                               "dcn": sum(p.kind == "dcn" for p in plans)}
@@ -444,6 +604,15 @@ class ChannelCompiledDAG:
         # every driver-held handle, each closed exactly once at teardown
         self._driver_channels = [p.handle for p in plans
                                  if p.handle is not None]
+        # map driver-held channels back to their wire identity for
+        # teardown logging + timeout diagnostics
+        self._chan_kind = {_chan_key(p.spec): p.kind for p in plans}
+
+        # ---- register the DAG with the GCS ------------------------------
+        # synchronous: the record (edge topology + channel kinds) must
+        # exist before the first report/stall can reference an edge
+        report_state = bool(self._cfg.dag_state_enabled)
+        self._register_dag(plans, plan_ends, actors, report_state)
 
         # ---- launch the actor loops ------------------------------------
         self._loop_refs = []
@@ -454,12 +623,24 @@ class ChannelCompiledDAG:
                 ops=sched.ops, input_ch=sched.input_ch,
                 collective_group=sched.collective_group,
                 collective_world=sched.collective_world,
-                collective_rank=sched.collective_rank))
+                collective_rank=sched.collective_rank,
+                dag_id=self.dag_id if report_state else ""))
             handle = actors[aid]
             from ray_tpu.api import ActorMethod
 
             m = ActorMethod(handle, "__rayt_apply__")
             self._loop_refs.append(m.remote(_dag_actor_loop, blob))
+
+        # driver-side reporter: covers the edges the DRIVER is a peer of
+        # (producer on input channels, consumer on outputs)
+        self._reporter = None
+        if report_state:
+            self._reporter = _DagReporter(
+                self.dag_id,
+                [("producer", ch) for ch in self._input_channels]
+                + [("consumer", ch) for ch in self._output_channels],
+                cw=self._cw)
+            self._reporter.start()
 
     # ------------------------------------------------------------ helpers
     @staticmethod
@@ -527,6 +708,111 @@ class ChannelCompiledDAG:
             for i, spec in zip(idxs, specs):
                 plans[i].spec = spec
 
+    def _register_dag(self, plans, plan_ends, actors, enabled: bool):
+        """Publish the DAG's edge topology to the GCS dag manager."""
+        if not enabled:
+            return
+
+        def endpoint(key):
+            if key is None:
+                return {"actor": "", "label": "driver"}
+            h = actors[key]
+            hexid = h._actor_id.hex()
+            cls = getattr(h, "_class_name", "") or "actor"
+            return {"actor": hexid, "label": f"{cls}:{hexid[:8]}"}
+
+        edges = []
+        for i, (p, (prod, cons)) in enumerate(zip(plans, plan_ends)):
+            role = ("input" if prod is None
+                    else "output" if cons is None else "edge")
+            edges.append({
+                "edge": f"e{i}", "channel": _chan_key(p.spec),
+                "kind": p.kind, "n_slots": p.n_slots,
+                "slot_size": p.slot_size, "role": role,
+                "producer": endpoint(prod), "consumer": endpoint(cons),
+            })
+        reg = {"kind": "register", "dag_id": self.dag_id,
+               "job_id": self._cw.job_id.hex(),
+               "driver": self._cw.worker_info.worker_id.hex(),
+               "ts": time.time(), "edges": edges,
+               "channel_kinds": dict(self.channel_kinds)}
+        try:
+            self._cw.io.run(self._cw.gcs.publish(CH_DAGS, reg),
+                            timeout=10.0)
+        except Exception:
+            logger.debug("dag %s registration publish failed",
+                         self.dag_id, exc_info=True)
+
+    def _publish_teardown(self):
+        if self._reporter is None:
+            return
+        if getattr(self._cw, "_closing", False):
+            return  # __del__-driven teardown after rt.shutdown()
+        msg = {"kind": "teardown", "dag_id": self.dag_id,
+               "ts": time.time()}
+        try:
+            # synchronous: `rayt list dags` right after teardown() must
+            # see TORN_DOWN with every stall flag cleared
+            self._cw.io.run(self._cw.gcs.publish(CH_DAGS, msg),
+                            timeout=5.0)
+        except Exception:
+            pass
+
+    def _stall_diagnosis(self) -> str:
+        """Ask the GCS dag manager whether the watchdog has attributed a
+        stall on this DAG's edges; one line per flagged edge, naming the
+        culprit and — when the peer actor is DEAD — the dead peer."""
+        try:
+            out = self._cw.io.run(
+                self._cw.gcs.call("list_dags",
+                                  {"dag_id": self.dag_id, "limit": 1}),
+                timeout=5.0)
+            recs = (out or {}).get("dags") or []
+            if not recs:
+                return ""
+            lines = []
+            for e in recs[0]["edges"]:
+                s = e.get("stall")
+                if not s:
+                    continue
+                line = (f"stalled edge {e['edge']} "
+                        f"{e['producer']['label']}->"
+                        f"{e['consumer']['label']} "
+                        f"({s['blocked']}-blocked {s['blocked_s']:.1f}s")
+                if s.get("dead_peer"):
+                    line += (f"; peer {s['culprit']} is DEAD — actor "
+                             f"{s['dead_peer']} died and stalled the "
+                             "ring")
+                elif s.get("culprit_state"):
+                    line += (f"; culprit {s['culprit']} "
+                             f"state={s['culprit_state']}")
+                line += ")"
+                lines.append(line)
+            return "; ".join(lines)
+        except Exception:
+            return ""
+
+    def _timeout_message(self, timeout_s: float, consumed: int) -> str:
+        """The enriched _get_tick timeout: per-output-channel cursor
+        positions (mid-wave desync is diagnosable from the exception
+        alone) plus the watchdog's stall attribution when one exists."""
+        cursors = []
+        for i, ch in enumerate(self._output_channels):
+            try:
+                r, w = ch.cursor_state()
+                cursors.append(f"out{i}=read:{r}/written:{w}")
+            except Exception:
+                cursors.append(f"out{i}=?")
+        msg = (f"tick {self._next_read} output read timed out after "
+               f"{timeout_s:.1f}s ({consumed}/"
+               f"{len(self._output_channels)} outputs consumed this "
+               f"wave; cursors: {', '.join(cursors)}) "
+               f"[dag {self.dag_id}]")
+        stall = self._stall_diagnosis()
+        if stall:
+            msg += "; " + stall
+        return msg
+
     def _wire_collectives(self, compute, scheds, actors):
         for n in compute:
             gname = getattr(n, "collective_group", None)
@@ -547,15 +833,28 @@ class ChannelCompiledDAG:
             value = args[0]
         else:
             value = (args, kwargs)
+        from ray_tpu._internal import otel
         from ray_tpu._internal.serialization import (serialize,
                                                      serialized_size)
 
-        # serialize ONCE, scatter the same chunk list into every input
-        # channel (N-runner broadcasts pay one serialize, not N)
-        chunks = serialize(value)
-        total = serialized_size(chunks)
-        for ch in self._input_channels:
-            ch.write_chunks(chunks, total, timeout=300.0)
+        timeout = self._cfg.dag_tick_timeout_s
+        span = _NULL_SPAN
+        if otel.tracing_enabled():
+            # the tick's root span: its carrier rides the input edges
+            # inside a _TraceTick envelope, so every downstream compute
+            # span (and the driver's read) joins ONE distributed trace
+            span = otel.submit_span("dag.execute", dag_id=self.dag_id,
+                                    tick=self._tick)
+        with span:
+            carrier = otel.current_context_carrier()
+            if carrier is not None:
+                value = _TraceTick(carrier, self._tick, value)
+            # serialize ONCE, scatter the same chunk list into every
+            # input channel (N-runner broadcasts pay one serialize)
+            chunks = serialize(value)
+            total = serialized_size(chunks)
+            for ch in self._input_channels:
+                ch.write_chunks(chunks, total, timeout=timeout)
         ref = ChannelDagRef(self, self._tick)
         self._tick += 1
         return ref
@@ -567,24 +866,34 @@ class ChannelCompiledDAG:
     def _get_tick(self, tick: int, timeout: float | None):
         """Resolve one tick's outputs under ONE overall deadline (the
         per-channel reads share it, so the total wait is `timeout`, not
-        timeout × n_outputs). A deadline firing MID-WAVE keeps the
+        timeout × n_outputs; the default comes from
+        RAYT_DAG_TICK_TIMEOUT_S). A deadline firing MID-WAVE keeps the
         already-consumed outputs in ``self._partial``: the next get()
         resumes at the first unread channel, so the per-channel cursors
-        never desynchronize across ticks."""
+        never desynchronize across ticks. A timeout raises with the
+        per-output-channel cursor positions and — when the GCS watchdog
+        has attributed a stall — the culprit edge and dead peer."""
         import time as _time
 
-        deadline = _time.monotonic() + (300.0 if timeout is None
-                                        else timeout)
+        timeout_s = (self._cfg.dag_tick_timeout_s if timeout is None
+                     else timeout)
+        deadline = _time.monotonic() + timeout_s
         while tick not in self._buffered:
             vals = self._partial
             while len(vals) < len(self._output_channels):
                 remaining = deadline - _time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
-                        f"tick {self._next_read} output read timed out")
-                vals.append(
-                    self._output_channels[len(vals)].read(
-                        timeout=remaining))
+                        self._timeout_message(timeout_s, len(vals)))
+                try:
+                    v = self._output_channels[len(vals)].read(
+                        timeout=remaining)
+                except TimeoutError:
+                    raise TimeoutError(self._timeout_message(
+                        timeout_s, len(vals))) from None
+                if type(v) is _TraceTick:
+                    v = v.value
+                vals.append(v)
             self._buffered[self._next_read] = vals
             self._partial = []
             self._next_read += 1
@@ -598,27 +907,45 @@ class ChannelCompiledDAG:
         if self._closed:
             return
         self._closed = True
+        # stop + JOIN the driver reporter before any channel closes: a
+        # snapshot racing a close would hit the ring's native-atomics
+        # load on an unmapped address (SIGSEGV, not an exception)
+        if self._reporter is not None:
+            self._reporter.stop(join=True)
         # close inputs FIRST: actor loops drain and exit, closing their
         # own edge/output ends (shutdown cascades along graph edges)
         for ch in self._input_channels:
+            logger.debug("dag %s teardown: closing input channel %s",
+                         self.dag_id, _chan_key(ch.spec))
             try:
                 ch.close()
             except Exception:
                 pass
         import ray_tpu as rt
 
+        done = []
         try:
             # short first wait: loops exit in ms when nothing is blocked
-            rt.wait(self._loop_refs, num_returns=len(self._loop_refs),
-                    timeout=2.0)
+            done, _ = rt.wait(self._loop_refs,
+                              num_returns=len(self._loop_refs),
+                              timeout=2.0)
         except Exception:
             pass
+        if len(done) < len(self._loop_refs):
+            logger.debug(
+                "dag %s teardown: %d/%d actor loops still parked — "
+                "closing every driver-held channel to unblock them",
+                self.dag_id, len(self._loop_refs) - len(done),
+                len(self._loop_refs))
         # then every driver-held handle exactly once (close() is
         # idempotent, so handles shared with _input_channels are safe).
         # This also unblocks actor loops still parked on a FULL
         # driver-held ring (write sees the closed flag) or an un-drained
         # output channel, letting them exit cleanly below.
         for ch in self._driver_channels:
+            key = _chan_key(ch.spec)
+            logger.debug("dag %s teardown: closing %s channel %s",
+                         self.dag_id, self._chan_kind.get(key, "?"), key)
             try:
                 ch.close()
             except Exception:
@@ -628,6 +955,8 @@ class ChannelCompiledDAG:
                     timeout=25.0)
         except Exception:
             pass
+        # mark the GCS record TORN_DOWN (clears every stall flag)
+        self._publish_teardown()
 
     def __del__(self):
         try:

@@ -45,8 +45,8 @@ from collections import deque
 from dataclasses import dataclass
 
 from ray_tpu._internal.rpc import RpcError, Serialized, connect
-from ray_tpu._internal.serialization import serialize
-from ray_tpu.dag.channel import ChannelClosed
+from ray_tpu._internal.serialization import serialize, serialized_size
+from ray_tpu.dag.channel import ChannelClosed, ChannelStats
 
 
 @dataclass(frozen=True)
@@ -111,6 +111,7 @@ class _DcnSink:
         self._cv = threading.Condition()
         self._closed = False
         self._conn = None
+        self.stats = ChannelStats()
 
     # ------------------------------------------------ IO-loop callbacks
     def bind(self, conn):
@@ -132,17 +133,24 @@ class _DcnSink:
     # ------------------------------------------- consumer-thread side
     def read(self, timeout: float | None):
         deadline = None if timeout is None else time.monotonic() + timeout
+        st = self.stats
         with self._cv:
             while not self._items:
                 if self._closed:
+                    st.end_read_block()
                     raise ChannelClosed()
+                if st.read_blocked_since is None:
+                    st.read_blocked_since = time.monotonic()
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
+                    st.end_read_block()
                     raise TimeoutError("dcn channel read timed out")
                 self._cv.wait(timeout=(remaining if remaining is not None
                                        else 1.0))
+            st.end_read_block()
             value = self._items.popleft()
+        st.reads += 1
         self._grant_credit(1)
         return value
 
@@ -182,6 +190,27 @@ class DcnConsumerChannel:
     def write(self, value, timeout: float | None = None):
         raise RuntimeError("consumer side of a DCN channel cannot write")
 
+    # ---------------------------------------------------- observability
+    @property
+    def stats(self) -> ChannelStats:
+        return self._sink.stats
+
+    def occupancy(self) -> int:
+        return len(self._sink._items)
+
+    def cursor_state(self) -> tuple[int, int]:
+        """(items consumed, items received) — the DCN twin of the shm
+        ring's (read cursor, write seq) for the _get_tick timeout error."""
+        st = self._sink.stats
+        return st.reads, st.reads + len(self._sink._items)
+
+    def snapshot(self) -> dict:
+        snap = self._sink.stats.snapshot()
+        snap["occupancy"] = self.occupancy()
+        snap["pinned_slots"] = 0
+        snap["n_slots"] = self.spec.n_slots
+        return snap
+
     def close(self):
         if self._closed:
             return
@@ -198,8 +227,16 @@ class DcnProducerChannel:
         self.spec = spec
         self._io = cw.io
         self._credits = threading.Semaphore(0)
+        # mirror of the semaphore for snapshots; += / -= are LOAD/ADD/
+        # STORE sequences hit from two threads (tick thread vs IO-loop
+        # credit grants), so the mirror mutates under its own lock — a
+        # lost update would skew the credits/occupancy diagnostics
+        # permanently, not transiently
+        self._credit_avail = 0
+        self._credit_lock = threading.Lock()
         self._closed = threading.Event()
         self._item_method = f"dcn.item.{spec.token}"
+        self.stats = ChannelStats()
         self._conn = self._io.run(self._open(spec), timeout=60.0)
 
     async def _open(self, spec: DcnChannelSpec):
@@ -209,11 +246,15 @@ class DcnProducerChannel:
         window = await conn.call("dcn_open", spec.token, timeout=30.0)
         for _ in range(int(window)):
             self._credits.release()
+        with self._credit_lock:
+            self._credit_avail += int(window)
         return conn
 
     def _on_credit(self, n):
         for _ in range(int(n)):
             self._credits.release()
+        with self._credit_lock:
+            self._credit_avail += int(n)
 
     def write(self, value, timeout: float | None = None):
         self.write_chunks(serialize(value), timeout=timeout)
@@ -226,13 +267,21 @@ class DcnProducerChannel:
         reads. The chunk buffers are handed to the transport
         asynchronously — treat written values as frozen."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        st = self.stats
         while not self._credits.acquire(timeout=0.2):
             if self._closed.is_set():
+                st.end_write_block()
                 raise ChannelClosed()
+            if st.write_blocked_since is None:
+                st.write_blocked_since = time.monotonic()
             if deadline is not None and time.monotonic() > deadline:
+                st.end_write_block()
                 raise TimeoutError(
                     "dcn channel write timed out (no credits: consumer "
                     "is >n_slots ticks behind)")
+        st.end_write_block()
+        with self._credit_lock:
+            self._credit_avail -= 1
         conn = self._conn
         if conn is None or self._closed.is_set():
             raise ChannelClosed()
@@ -248,9 +297,30 @@ class DcnProducerChannel:
         except RuntimeError:
             self._closed.set()
             raise ChannelClosed()
+        # count AFTER the frame reached the transport: the ChannelClosed
+        # path above must not report a phantom tick to the dag manager
+        st.writes += 1
+        st.bytes_written += (serialized_size(chunks)
+                             if total is None else total)
 
     def read(self, timeout: float | None = None):
         raise RuntimeError("producer side of a DCN channel cannot read")
+
+    # ---------------------------------------------------- observability
+    def occupancy(self) -> int:
+        """In-flight items past the consumer's reads = window consumed."""
+        return max(0, self.spec.n_slots - self._credit_avail)
+
+    def cursor_state(self) -> tuple[int, int]:
+        return self.stats.writes, self.stats.writes
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["occupancy"] = self.occupancy()
+        snap["pinned_slots"] = 0
+        snap["n_slots"] = self.spec.n_slots
+        snap["credits"] = self._credit_avail
+        return snap
 
     def close(self):
         conn = self._conn

@@ -21,6 +21,11 @@ Endpoints:
                                   manager: size/callsite/refs/pins/leaks)
   GET  /api/objects/summary     — ?job= per-callsite + per-node memory
                                   rollups with store stats + leak flags
+  GET  /api/dags                — ?job=&stalled=&limit= compiled-DAG
+                                  records (GCS dag manager: edge
+                                  topology, per-edge tick/byte/occupancy
+                                  rollups + history, stall attribution)
+                                  with a summary rollup attached
   GET  /api/timeline            — Chrome trace JSON of the GCS task
                                   lifecycle store: nested per-phase slices
                                   (load in Perfetto / chrome://tracing)
@@ -293,6 +298,7 @@ class DashboardHead:
         app.router.add_get("/api/tasks/summary", self._tasks_summary)
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/objects/summary", self._objects_summary)
+        app.router.add_get("/api/dags", self._dags)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/jobs", self._jobs_list)
         app.router.add_post("/api/jobs", self._jobs_submit)
@@ -522,6 +528,24 @@ class DashboardHead:
 
         out = self.gcs.object_manager.summarize(
             job_id=request.query.get("job") or None)
+        return web.json_response(out)
+
+    async def _dags(self, request):
+        """Compiled-DAG records + rollup (GCS dag manager; the DAGs tab
+        feed: edge tables, occupancy/throughput sparklines from each
+        edge's history ring, stall badges)."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.dag_manager.list(
+                job_id=q.get("job") or None,
+                stalled_only=q.get("stalled", "") in ("1", "true", "yes"),
+                limit=int(q.get("limit", 50)))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        out["summary"] = self.gcs.dag_manager.summarize(
+            job_id=q.get("job") or None)
         return web.json_response(out)
 
     async def _timeline(self, request):

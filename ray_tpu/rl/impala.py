@@ -462,16 +462,25 @@ class IMPALA:
             out = self._learner.step.bind(*agg_outs)
         # slot sizing: the widest edge is agg→learner, which can carry a
         # whole tick's worth of batches (every runner's fragment,
-        # re-concatenated); input edges carry a weights broadcast. 2x
-        # headroom over raw array bytes covers serialization framing.
+        # re-concatenated) — and a RELEASED batch holds up to
+        # train_batch_size timesteps accumulated across ticks (plus one
+        # tick's overshoot), which can dwarf the per-tick intake; input
+        # edges carry a weights broadcast. 2x headroom over raw array
+        # bytes covers serialization framing.
         frag_bytes = self._sample_nbytes()
+        tick_steps = (cfg.rollout_fragment_length
+                      * cfg.num_envs_per_runner * max(1, len(runners)))
+        per_step = frag_bytes / max(
+            1, cfg.rollout_fragment_length * cfg.num_envs_per_runner)
+        batch_bytes = 2 * int(per_step * (cfg.train_batch_size
+                                          + tick_steps)) + (1 << 16)
         weights_nbytes = 2 * sum(
             int(np.asarray(w).nbytes)
             for w in _tree_leaves(rt.get(
                 self._learner.get_weights.remote(),
                 timeout=cfg.call_timeout_s))) + (1 << 16)
         buf = max(2 * frag_bytes * max(1, len(runners)) + (1 << 16),
-                  weights_nbytes, 1 << 20)
+                  batch_bytes, weights_nbytes, 1 << 20)
         self._dag = out.experimental_compile(
             buffer_size_bytes=buf,
             max_inflight=max(2, cfg.max_requests_in_flight))
@@ -481,6 +490,8 @@ class IMPALA:
         ticks pipelined through the rings, drain results until at least
         one learner update ran; weights returned by the learner ride the
         NEXT tick's input edge to every runner."""
+        from ray_tpu.util import builtin_metrics as _bm
+
         cfg = self.config
         t0 = time.perf_counter()
         aux_last: dict = {}
@@ -489,12 +500,19 @@ class IMPALA:
         deadline = time.monotonic() + 4 * cfg.call_timeout_s
         want = max(1, cfg.min_updates_per_iteration)
         soft_cap = time.monotonic() + 5.0
+        algo = "appo" if cfg.use_appo_loss else "impala"
         while updates < want and time.monotonic() < deadline:
             if updates > 0 and time.monotonic() > soft_cap:
                 break  # slow env: return what we have past the soft cap
             while len(self._dag_refs) < depth:
                 self._dag_refs.append(self._dag.execute(self._next_weights))
                 self._next_weights = None
+            # pipeline-depth staleness: the result consumed now was
+            # computed len(_dag_refs) ticks ago (the in-flight window) —
+            # exactly the weight-staleness bound the Podracer pipeline
+            # imposes; visible so the depth/throughput trade is tunable
+            _bm.rl_dag_staleness.set(len(self._dag_refs),
+                                     tags={"algo": algo})
             ref = self._dag_refs.pop(0)
             res = ref.get(timeout=4 * cfg.call_timeout_s)
             self._recent_returns.extend(res["episode_returns"])
@@ -507,6 +525,7 @@ class IMPALA:
                 # copy-on-hold: the weights arrays alias an output ring
                 # slot; held across ticks they would pin it
                 self._next_weights = _tree_copy(res["weights"])
+                _bm.rl_dag_weight_broadcasts.inc(tags={"algo": algo})
         self._iteration += 1
         return {
             "training_iteration": self._iteration,

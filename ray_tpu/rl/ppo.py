@@ -130,8 +130,14 @@ class PPO:
             # weights over the input edge; later waves pipeline through
             # the rings with the same weights (still on-policy — no
             # update happens between waves)
+            from ray_tpu.util import builtin_metrics as _bm
+
             refs = [self._dag.execute(self._weights if k == 0 else None)
                     for k in range(max(1, cfg.sample_waves))]
+            # PPO stays on-policy: staleness is bounded by the wave
+            # count (all waves sample the weights broadcast on wave 0)
+            _bm.rl_dag_staleness.set(len(refs), tags={"algo": "ppo"})
+            _bm.rl_dag_weight_broadcasts.inc(tags={"algo": "ppo"})
             samples = []
             for ref in refs:
                 vals = ref.get(timeout=600)

@@ -2,7 +2,8 @@
 util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
 
 Commands: start, stop, status, summary [tasks], list {nodes,actors,jobs,
-pgs,workers,tasks}, microbenchmark, job {submit,status,logs,stop,list}
+pgs,workers,tasks,objects,dags}, dag <id>, memory, timeline,
+microbenchmark, job {submit,status,logs,stop,list}
 (ref analog for jobs: dashboard/modules/job/cli.py).
 """
 
@@ -212,6 +213,18 @@ def cmd_list(args):
             leaked_only=bool(args.leaked), limit=args.limit, detail=True)
         print(json.dumps(out, indent=2, default=str))
         return
+    if kind == "dags":
+        out = state_api.list_dags(
+            job_id=args.job or None,
+            stalled_only=bool(getattr(args, "stalled", False)),
+            limit=args.limit, detail=True)
+        # the list view drops per-edge sparkline history (rayt dag <id>
+        # keeps it) so the JSON stays scannable
+        for rec in out.get("dags", ()):
+            for e in rec.get("edges", ()):
+                e.pop("history", None)
+        print(json.dumps(out, indent=2, default=str))
+        return
     fn = {"nodes": state_api.list_nodes, "actors": state_api.list_actors,
           "jobs": state_api.list_jobs,
           "pgs": state_api.list_placement_groups,
@@ -317,6 +330,51 @@ def _print_object_summary(summary: dict):
             print(f"  {node[:12]}  {e['objects']} objects  "
                   f"{e['total_bytes'] / 1e6:.1f} MB  "
                   f"leaked={e['leaked_count']}{extra}")
+
+
+def cmd_dag(args):
+    """One DAG's edge table (ref analog: the reference's compiled-graph
+    visualization, rendered as text): topology, per-edge throughput,
+    ring occupancy, blocked time, and stall-watchdog attribution.
+    Column glossary: README "Execution-plane observability"."""
+    from ray_tpu import state_api
+
+    _attach(args)
+    out = state_api.list_dags(dag_id=args.dag_id, limit=1, detail=True)
+    dags = out.get("dags", [])
+    if not dags:
+        # allow a hex prefix, like other id-taking commands
+        dags = [d for d in state_api.list_dags(limit=0)
+                if d["dag_id"].startswith(args.dag_id)]
+    if not dags:
+        raise SystemExit(f"no dag record matches {args.dag_id!r}")
+    _print_dag(dags[0])
+
+
+def _print_dag(rec: dict):
+    kinds = " ".join(f"{k}={v}" for k, v in
+                     sorted(rec["channel_kinds"].items()) if v)
+    print(f"dag {rec['dag_id']}  state={rec['state']}  "
+          f"job={rec['job_id'][:12]}  edges={rec['num_edges']} ({kinds})"
+          + (f"  stalled={len(rec['stalled_edges'])}"
+             if rec["stalled_edges"] else ""))
+    fmt = "{:<4} {:<7} {:<30} {:<5} {:>8} {:>12} {:>5} {:>9} {:>9}  {}"
+    print(fmt.format("edge", "role", "producer->consumer", "kind",
+                     "ticks", "bytes", "occ", "w-block", "r-block",
+                     "stall"))
+    for e in rec["edges"]:
+        pair = f"{e['producer']['label']}->{e['consumer']['label']}"
+        s = e.get("stall")
+        badge = "—"
+        if s:
+            badge = f"{s['blocked']}-blocked {s['blocked_s']:.1f}s"
+            if s.get("dead_peer"):
+                badge += f" peer {s['culprit']} DEAD"
+        print(fmt.format(
+            e["edge"], e["role"], pair[:30], e["kind"],
+            max(e["ticks"], e["reads"]), e["bytes"], e["occupancy"],
+            f"{e['write_block_s']:.1f}s", f"{e['read_block_s']:.1f}s",
+            badge))
 
 
 def cmd_timeline(args):
@@ -566,8 +624,10 @@ def main(argv=None):
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
-                                     "workers", "tasks", "objects"])
-    sp.add_argument("--job", help="tasks/objects: filter by job id (hex)")
+                                     "workers", "tasks", "objects",
+                                     "dags"])
+    sp.add_argument("--job", help="tasks/objects/dags: filter by job "
+                                  "id (hex)")
     sp.add_argument("--state", help="tasks: filter by lifecycle state")
     sp.add_argument("--task-name", help="tasks: filter by task name")
     sp.add_argument("--node", help="objects: filter by node id (hex)")
@@ -575,9 +635,18 @@ def main(argv=None):
                                        "callsite (exact)")
     sp.add_argument("--leaked", action="store_true",
                     help="objects: only leak-watchdog-flagged records")
+    sp.add_argument("--stalled", action="store_true",
+                    help="dags: only DAGs with stall-flagged edges")
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("dag",
+                        help="one compiled DAG's edge table: topology, "
+                             "throughput, occupancy, stall attribution")
+    sp.add_argument("dag_id", help="dag id (hex, prefix ok)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_dag)
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.add_argument("--duration", type=float, default=2.0)

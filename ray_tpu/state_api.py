@@ -229,6 +229,35 @@ def summarize_objects(*, job_id: Optional[str] = None) -> dict:
     return cw.io.run(cw.gcs.call("summarize_objects", filters))
 
 
+def list_dags(*, job_id: Optional[str] = None,
+              dag_id: Optional[str] = None, stalled_only: bool = False,
+              limit: int = 100, detail: bool = False) -> Any:
+    """Compiled-DAG execution-plane records from the GCS dag manager,
+    filtered SERVER-side (job / dag id / stalled-only, limit). Each
+    record carries the edge topology (producer/consumer endpoints,
+    channel kind, ring geometry), per-edge tick/byte/occupancy/
+    block-time rollups, sparkline history, and the stall watchdog's
+    attribution (culprit endpoint + dead peer when the blocked side's
+    actor is DEAD). Reports flow on the ~1s cadence, so a just-compiled
+    DAG can lag by a beat."""
+    cw = _cw()
+    filters: dict = {"limit": limit, "stalled_only": stalled_only}
+    if job_id is not None:
+        filters["job_id"] = job_id
+    if dag_id is not None:
+        filters["dag_id"] = dag_id
+    out = cw.io.run(cw.gcs.call("list_dags", filters))
+    return out if detail else out["dags"]
+
+
+def summarize_dags(*, job_id: Optional[str] = None) -> dict:
+    """DAG-plane rollup: counts by state, tick/byte/blocked-time
+    totals, and every currently-stalled edge with its attribution."""
+    cw = _cw()
+    filters = {"job_id": job_id} if job_id is not None else {}
+    return cw.io.run(cw.gcs.call("summarize_dags", filters))
+
+
 def list_node_objects() -> list[dict]:
     """LIVE per-node object-directory dump (dials every node manager —
     the pre-aggregation surface; use list_objects for the cluster-wide

@@ -102,6 +102,17 @@ object_leaks_flagged = Counter(
     "Shm segments flagged by the leak watchdog: get-pins outlived every "
     "counted ref past RAYT_OBJECT_LEAK_GRACE_S")
 
+# ---- RL on the compiled-DAG plane (rl/impala.py, rl/ppo.py) ----
+rl_dag_staleness = Gauge(
+    "rayt_rl_dag_staleness_ticks",
+    "Ticks in flight through the compiled-DAG pipeline when a result "
+    "was consumed — the weight-staleness bound the pipeline depth "
+    "imposes", tag_keys=("algo",))
+rl_dag_weight_broadcasts = Counter(
+    "rayt_rl_dag_weight_broadcasts_total",
+    "Weight broadcasts ridden over the DAG's input edge to the runner "
+    "fleet", tag_keys=("algo",))
+
 
 def node_gauge_records(node_hex: str, *, resources_total: dict,
                        resources_available: dict, num_workers: int,
@@ -164,3 +175,39 @@ def object_store_gauge_records(node_hex: str, stats: dict, *,
         g("rayt_object_store_arena_evictions_total",
           stats.get("arena_evictions_total", 0))
     return recs
+
+
+def dag_edge_metric_records(dag_hex: str, edge: str, *, ticks: int = 0,
+                            nbytes: int = 0, write_block_s: float = 0.0,
+                            read_block_s: float = 0.0,
+                            occupancy=None, ts: float = 0.0) -> list:
+    """Compiled-DAG per-edge metrics, derived by the GCS dag manager
+    from `dag_state` report deltas (the GCS process has no core worker,
+    so — like the node manager's gauges — it builds raw records and
+    feeds its own metrics store). Counter records carry DELTAS; the
+    store sums them. Tag cardinality is one series per live (dag, edge),
+    bounded by the dag manager's record cap."""
+    tags = {"dag": dag_hex, "edge": edge}
+    recs = []
+
+    def rec(name, kind, value):
+        recs.append({"name": name, "kind": kind, "value": float(value),
+                     "tags": tags, "ts": ts})
+
+    if ticks:
+        rec("rayt_dag_ticks_total", "counter", ticks)
+    if nbytes:
+        rec("rayt_dag_bytes_total", "counter", nbytes)
+    if write_block_s:
+        rec("rayt_dag_write_block_s_total", "counter", write_block_s)
+    if read_block_s:
+        rec("rayt_dag_read_block_s_total", "counter", read_block_s)
+    if occupancy is not None:
+        rec("rayt_dag_ring_occupancy", "gauge", occupancy)
+    return recs
+
+
+def dag_stalled_gauge_record(stalled_edges: int, *, ts: float) -> dict:
+    """Cluster-wide count of stall-watchdog-flagged DAG edges."""
+    return {"name": "rayt_dag_stalled_edges", "kind": "gauge",
+            "value": float(stalled_edges), "tags": {}, "ts": ts}

@@ -1,0 +1,452 @@
+"""Execution-plane observability: DAG/channel introspection, stall
+attribution, and per-tick tracing (ref analogs: the reference's
+dashboard/state-API execution visibility — gcs_task_manager.h shape
+applied to compiled graphs)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------- units
+def test_channel_stats_counters():
+    """ShmChannel instrumentation: tick/byte counters, occupancy, and
+    blocked-time accumulation on both the full-ring and empty-ring
+    parks."""
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmChannel
+
+    prod = ShmChannel.create(slot_size=1 << 16, n_slots=2)
+    cons = ShmChannel.attach(prod.spec)
+    try:
+        prod.write({"x": 1})
+        prod.write(np.arange(8))
+        assert prod.stats.writes == 2
+        assert prod.stats.bytes_written > 0
+        assert prod.occupancy() == 2
+        # ring full: a bounded write park accumulates write_block_s
+        with pytest.raises(TimeoutError):
+            prod.write_bytes(b"z", timeout=0.15)
+        assert prod.stats.write_block_s >= 0.1
+        assert prod.stats.write_blocked_since is None  # park ended
+        v = cons.read()
+        assert v == {"x": 1}
+        arr = cons.read()
+        assert list(arr[:8].tobytes()) or True  # view alive
+        assert cons.stats.reads == 2
+        assert cons.stats.bytes_read == prod.stats.bytes_written
+        assert cons.stats.pins_sealed >= 1       # numpy view aliased slot
+        assert cons.pinned_slots() >= 1
+        snap = cons.snapshot()
+        assert snap["n_slots"] == 2 and snap["reads"] == 2
+        # empty ring: a bounded read park accumulates read_block_s
+        with pytest.raises(TimeoutError):
+            cons.read_bytes(timeout=0.15)
+        assert cons.stats.read_block_s >= 0.1
+        del arr
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_gcs_dag_manager_unit():
+    """Register → report → stall attribution → teardown clears; per-job
+    eviction with dropped accounting; derived metric records."""
+    from ray_tpu.core.gcs_dag_manager import GcsDagManager
+
+    states = {"aaaa": "ALIVE", "bbbb": "DEAD"}
+    m = GcsDagManager(max_dags=2, stall_grace_s=1.0,
+                      actor_state=lambda h: states.get(h))
+    m.ingest({"kind": "register", "dag_id": "d1", "job_id": "j1",
+              "driver": "w0", "ts": 1.0,
+              "channel_kinds": {"shm": 2, "dcn": 0},
+              "edges": [
+                  {"edge": "e0", "channel": "c0", "kind": "shm",
+                   "n_slots": 4, "slot_size": 1024, "role": "edge",
+                   "producer": {"actor": "bbbb", "label": "Runner:bbbb"},
+                   "consumer": {"actor": "aaaa", "label": "Sink:aaaa"}},
+                  {"edge": "e1", "channel": "c1", "kind": "shm",
+                   "n_slots": 4, "slot_size": 1024, "role": "output",
+                   "producer": {"actor": "aaaa", "label": "Sink:aaaa"},
+                   "consumer": {"actor": "", "label": "driver"}},
+              ]})
+    # healthy report: deltas accumulate, no stall
+    m.ingest({"kind": "report", "dag_id": "d1", "ts": 2.0, "channels": {
+        "c0": {"role": "producer", "writes": 5, "bytes_written": 500,
+               "write_block_s": 0.0, "write_blocked_s_now": 0.0},
+        "c0#c": {"role": "consumer"},  # unknown channel key: ignored
+    }})
+    m.ingest({"kind": "report", "dag_id": "d1", "ts": 2.0, "channels": {
+        "c0": {"role": "consumer", "reads": 5, "read_block_s": 0.2,
+               "read_blocked_s_now": 0.0, "occupancy": 1,
+               "pinned_slots": 0, "gc_nudges": 0},
+    }})
+    rec = m.list(dag_id="d1")["dags"][0]
+    edge = next(e for e in rec["edges"] if e["edge"] == "e0")
+    assert edge["ticks"] == 5 and edge["bytes"] == 500
+    assert edge["reads"] == 5 and edge["stall"] is None
+    recs = m.drain_metric_records()
+    names = {r["name"] for r in recs}
+    assert "rayt_dag_ticks_total" in names
+    assert "rayt_dag_bytes_total" in names
+    assert "rayt_dag_ring_occupancy" in names
+    assert "rayt_dag_stalled_edges" in names
+    tick_rec = next(r for r in recs
+                    if r["name"] == "rayt_dag_ticks_total")
+    assert tick_rec["value"] == 5.0
+    assert tick_rec["tags"] == {"dag": "d1", "edge": "e0"}
+
+    # consumer parked past grace on e0: culprit is the PRODUCER, whose
+    # actor is DEAD -> dead peer named
+    m.ingest({"kind": "report", "dag_id": "d1", "ts": 3.0, "channels": {
+        "c0": {"role": "consumer", "reads": 5, "read_block_s": 1.7,
+               "read_blocked_s_now": 1.5, "occupancy": 0,
+               "pinned_slots": 0, "gc_nudges": 0},
+    }})
+    rec = m.list(dag_id="d1", stalled_only=True)["dags"][0]
+    edge = next(e for e in rec["edges"] if e["edge"] == "e0")
+    assert edge["stall"]["blocked"] == "read"
+    assert edge["stall"]["culprit"] == "Runner:bbbb"
+    assert edge["stall"]["dead_peer"] == "bbbb"
+    assert rec["stalled_edges"] == ["e0"]
+    assert m.num_stalled_edges() == 1
+    summ = m.summarize()
+    assert summ["totals"]["stalled_edges"] == 1
+    assert summ["stalls"][0]["dead_peer"] == "bbbb"
+    gauge = [r for r in m.drain_metric_records()
+             if r["name"] == "rayt_dag_stalled_edges"]
+    assert gauge and gauge[-1]["value"] == 1.0
+
+    # producer parked on a FULL ring points at the CONSUMER (alive)
+    m.ingest({"kind": "report", "dag_id": "d1", "ts": 4.0, "channels": {
+        "c1": {"role": "producer", "writes": 5, "bytes_written": 10,
+               "write_block_s": 2.0, "write_blocked_s_now": 2.0},
+    }})
+    rec = m.list(dag_id="d1")["dags"][0]
+    e1 = next(e for e in rec["edges"] if e["edge"] == "e1")
+    assert e1["stall"]["blocked"] == "write"
+    assert e1["stall"]["culprit"] == "driver"
+    assert e1["stall"]["dead_peer"] == ""
+
+    # teardown clears every stall flag and marks the record
+    m.ingest({"kind": "teardown", "dag_id": "d1", "ts": 5.0})
+    rec = m.list(dag_id="d1")["dags"][0]
+    assert rec["state"] == "TORN_DOWN"
+    assert rec["stalled_edges"] == []
+    assert m.num_stalled_edges() == 0
+    # a straggler blocked report after teardown cannot re-flag
+    m.ingest({"kind": "report", "dag_id": "d1", "ts": 6.0, "channels": {
+        "c0": {"role": "consumer", "reads": 5, "read_block_s": 9.9,
+               "read_blocked_s_now": 9.0, "occupancy": 0,
+               "pinned_slots": 0, "gc_nudges": 0},
+    }})
+    assert m.num_stalled_edges() == 0
+
+    # cap: the job with the most records evicts oldest-first, accounted
+    m.ingest({"kind": "register", "dag_id": "d2", "job_id": "j2",
+              "driver": "w0", "ts": 6.0, "edges": []})
+    m.ingest({"kind": "register", "dag_id": "d3", "job_id": "j2",
+              "driver": "w0", "ts": 7.0, "edges": []})
+    assert m.num_dags() == 2
+    assert m.dropped_counts()["j2"] == 1
+    assert [d["dag_id"] for d in m.list()["dags"]] == ["d3", "d1"]
+    m.on_job_finished("j1")
+    assert m.list(dag_id="d1")["total"] == 0
+
+
+# ----------------------------------------------------------- E2E fixture
+@pytest.fixture
+def dag_obs_cluster(monkeypatch):
+    """Single-node cluster with a fast report cadence + a short stall
+    grace so the watchdog E2E completes in seconds."""
+    monkeypatch.setenv("RAYT_DAG_STALL_GRACE_S", "1.0")
+    monkeypatch.setenv("RAYT_DAG_STATE_REPORT_INTERVAL_S", "0.25")
+    from ray_tpu._internal import config as cfg_mod
+
+    old = cfg_mod._config
+    cfg_mod.set_config(cfg_mod.load_config())
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+        cfg_mod._config = old
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = predicate()
+        if out:
+            return out
+        time.sleep(interval)
+    return None
+
+
+def test_dag_record_lifecycle(dag_obs_cluster):
+    """Compile → RUNNING record with edge topology + growing tick
+    counts; teardown → TORN_DOWN (the registration/report/teardown
+    round-trip over the dag_state channel)."""
+    rt = dag_obs_cluster
+    from ray_tpu import state_api
+    from ray_tpu.dag import InputNode
+
+    @rt.remote(num_cpus=0)
+    class Stage:
+        def apply(self, x):
+            return x + 1
+
+    a, b = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        out = b.apply.bind(a.apply.bind(inp))
+    dag = out.experimental_compile(channels=True)
+    for i in range(10):
+        assert dag.execute(i).get(timeout=30) == i + 2
+
+    def record_ready():
+        recs = state_api.list_dags(dag_id=dag.dag_id)
+        if recs and recs[0]["ticks"] >= 10:
+            return recs[0]
+        return None
+
+    rec = _wait_for(record_ready)
+    assert rec is not None, "dag record never reached 10 ticks"
+    assert rec["state"] == "RUNNING"
+    assert rec["channel_kinds"] == {"shm": 3, "dcn": 0}
+    roles = sorted(e["role"] for e in rec["edges"])
+    assert roles == ["edge", "input", "output"]
+    edge = next(e for e in rec["edges"] if e["role"] == "edge")
+    assert edge["producer"]["label"].startswith("Stage:")
+    assert edge["consumer"]["label"].startswith("Stage:")
+    assert edge["bytes"] > 0 and edge["history"]
+    summ = state_api.summarize_dags()
+    assert summ["totals"]["dags"] >= 1
+    assert summ["by_state"].get("RUNNING", 0) >= 1
+
+    dag.teardown()
+    rec = _wait_for(lambda: (state_api.list_dags(dag_id=dag.dag_id)
+                             or [None])[0], timeout=10.0)
+    assert rec and rec["state"] == "TORN_DOWN"
+    for a_ in (a, b):
+        rt.kill(a_)
+
+
+def test_stall_watchdog_e2e_dead_runner(dag_obs_cluster, capsys):
+    """THE acceptance path: kill a runner actor mid-DAG and the GCS
+    record flags the stalled edge with the dead peer named; the
+    enriched _get_tick timeout error carries the culprit + per-channel
+    cursors; `rayt list dags`/`rayt dag` render the same attribution;
+    the flag clears on teardown."""
+    rt = dag_obs_cluster
+    from ray_tpu import state_api
+    from ray_tpu.dag import InputNode
+    from ray_tpu.scripts.cli import _print_dag
+
+    @rt.remote(num_cpus=0)
+    class Runner:
+        def produce(self, x):
+            return x * 2
+
+    @rt.remote(num_cpus=0)
+    class Sink:
+        def consume(self, x):
+            return x + 1
+
+    runner, sink = Runner.remote(), Sink.remote()
+    with InputNode() as inp:
+        out = sink.consume.bind(runner.produce.bind(inp))
+    dag = out.experimental_compile(channels=True)
+    for i in range(3):
+        assert dag.execute(i).get(timeout=30) == 2 * i + 1
+    runner_hex = runner._actor_id.hex()
+
+    # kill the runner: the Runner->Sink ring goes silent, the sink's
+    # loop parks on an empty ring, its reporter keeps publishing the
+    # growing read-block, and the GCS watchdog attributes the stall
+    rt.kill(runner)
+
+    def stalled_with_dead_peer():
+        recs = state_api.list_dags(dag_id=dag.dag_id)
+        if not recs:
+            return None
+        for e in recs[0]["edges"]:
+            s = e.get("stall")
+            if s and s["dead_peer"] == runner_hex:
+                return (recs[0], e)
+        return None
+
+    hit = _wait_for(stalled_with_dead_peer, timeout=25.0)
+    assert hit is not None, "stall with dead peer never flagged"
+    rec, edge = hit
+    assert edge["stall"]["blocked"] == "read"
+    assert edge["stall"]["culprit"].startswith("Runner:")
+    assert edge["stall"]["culprit_state"] == "DEAD"
+    assert edge["edge"] in rec["stalled_edges"]
+    # stalled_only server-side filter finds it too
+    assert state_api.list_dags(stalled_only=True)
+
+    # the enriched timeout error names the culprit edge + dead peer and
+    # carries per-output-channel cursor positions
+    ref = dag.execute(99)
+    with pytest.raises(TimeoutError) as ei:
+        ref.get(timeout=2.0)
+    msg = str(ei.value)
+    assert "cursors:" in msg and "out0=" in msg
+    assert "stalled edge" in msg
+    assert runner_hex in msg and "DEAD" in msg
+
+    # `rayt dag <id>` renders the same attribution
+    _print_dag(state_api.list_dags(dag_id=dag.dag_id)[0])
+    cli_out = capsys.readouterr().out
+    assert "read-blocked" in cli_out and "DEAD" in cli_out
+
+    # the Prometheus gauge derived from the same reports is nonzero
+    cw = state_api._cw()
+    snap = cw.io.run(cw.gcs.call("metrics_snapshot"))
+    gauge = next((m for m in snap
+                  if m["name"] == "rayt_dag_stalled_edges"), None)
+    assert gauge is not None and gauge["value"] >= 1.0
+
+    # teardown clears the flag and marks the record
+    dag.teardown()
+
+    def torn_down_clean():
+        recs = state_api.list_dags(dag_id=dag.dag_id)
+        if recs and recs[0]["state"] == "TORN_DOWN" \
+                and not recs[0]["stalled_edges"]:
+            return recs[0]
+        return None
+
+    assert _wait_for(torn_down_clean, timeout=15.0) is not None
+    rt.kill(sink)
+
+
+def test_tick_timeout_env_and_partial_wave_cursors(dag_obs_cluster,
+                                                   monkeypatch):
+    """RAYT_DAG_TICK_TIMEOUT_S replaces the hardcoded 300s default for
+    BOTH _get_tick and execute's input writes, and a no-arg get() that
+    times out reports the per-output cursor positions."""
+    rt = dag_obs_cluster
+    from ray_tpu._internal.config import get_config
+    from ray_tpu.dag import InputNode
+
+    @rt.remote(num_cpus=0)
+    class Slow:
+        def apply(self, x):
+            import time as _t
+
+            _t.sleep(30.0)
+            return x
+
+    monkeypatch.setattr(get_config(), "dag_tick_timeout_s", 1.0)
+    s = Slow.remote()
+    with InputNode() as inp:
+        out = s.apply.bind(inp)
+    dag = out.experimental_compile(channels=True)
+    ref = dag.execute(1)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError) as ei:
+        ref.get()  # no explicit timeout -> config default (1s, not 300)
+    assert time.monotonic() - t0 < 10.0
+    msg = str(ei.value)
+    assert "1.0s" in msg and "cursors:" in msg
+    assert "out0=read:0/written:0" in msg
+    assert "0/1 outputs consumed" in msg
+    dag.teardown()
+    rt.kill(s)
+
+
+def test_per_tick_tracing_spans_stitch(monkeypatch, tmp_path):
+    """Per-tick distributed tracing (env-gated; replaces the old
+    RAYT_DAG_TRACE print path): one tick's spans share the driver's
+    trace id across producer/consumer PROCESSES and export into the
+    Chrome timeline."""
+    trace_dir = str(tmp_path / "spans")
+    monkeypatch.setenv("RAYT_TRACING_DIR", trace_dir)
+    from ray_tpu._internal import otel
+
+    # the driver process may have cached "tracing off": reset the gate
+    monkeypatch.setattr(otel, "_enabled", None)
+    monkeypatch.setattr(otel, "_out_path", None)
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+    try:
+        from ray_tpu.dag import InputNode
+
+        @rt.remote(num_cpus=0)
+        class Hop:
+            def fwd(self, x):
+                return x + 1
+
+        h1, h2 = Hop.remote(), Hop.remote()
+        with InputNode() as inp:
+            out = h2.fwd.bind(h1.fwd.bind(inp))
+        dag = out.experimental_compile(channels=True)
+        for i in range(3):
+            assert dag.execute(i).get(timeout=30) == i + 2
+        dag.teardown()
+    finally:
+        rt.shutdown()
+    spans = otel.read_spans(trace_dir)
+    exec_spans = [s for s in spans if s["name"] == "dag.execute"]
+    assert len(exec_spans) >= 3
+    tick0 = next(s for s in exec_spans
+                 if s["attributes"].get("tick") == 0)
+    same_trace = [s for s in spans
+                  if s["trace_id"] == tick0["trace_id"]]
+    names = {s["name"] for s in same_trace}
+    assert "execute dag.fwd" in names   # actor-side compute spans
+    # ...in at least two distinct processes besides the driver's span
+    pids = {s["pid"] for s in same_trace}
+    assert len(pids) >= 3, f"tick spans did not stitch across pids: {pids}"
+    # actor spans are REMOTE CHILDREN of the driver's execute span
+    child = next(s for s in same_trace if s["name"] == "execute dag.fwd")
+    assert child["parent_id"] == tick0["span_id"]
+    # and the existing Chrome exporter renders them
+    out_path = str(tmp_path / "dag_trace.json")
+    n = otel.export_chrome_trace(trace_dir, out_path)
+    assert n >= len(spans)
+    import json
+
+    doc = json.load(open(out_path))
+    assert any(ev["name"] == "dag.execute"
+               for ev in doc["traceEvents"])
+
+
+def test_dag_state_disabled_no_records(monkeypatch):
+    """RAYT_DAG_STATE_ENABLED=0 removes registration + reports: the
+    GCS dag store stays empty and schedules carry no dag id."""
+    monkeypatch.setenv("RAYT_DAG_STATE_ENABLED", "0")
+    from ray_tpu._internal import config as cfg_mod
+
+    old = cfg_mod._config
+    cfg_mod.set_config(cfg_mod.load_config())
+    import ray_tpu as rt
+
+    rt.init(num_cpus=2)
+    try:
+        from ray_tpu import state_api
+        from ray_tpu.dag import InputNode
+
+        @rt.remote(num_cpus=0)
+        class E:
+            def apply(self, x):
+                return x
+
+        e = E.remote()
+        with InputNode() as inp:
+            out = e.apply.bind(inp)
+        dag = out.experimental_compile(channels=True)
+        assert dag.execute(5).get(timeout=30) == 5
+        time.sleep(0.8)
+        assert state_api.list_dags(detail=True)["total"] == 0
+        dag.teardown()
+    finally:
+        rt.shutdown()
+        cfg_mod._config = old

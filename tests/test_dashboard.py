@@ -149,15 +149,18 @@ def test_index_page_serves_spa(dash_cluster):
                      "/api/serve", "/api/data", "/api/cluster_status",
                      "/api/tasks", "/api/tasks/summary",
                      "/api/objects", "/api/objects/summary",
+                     "/api/dags",
                      "/api/metrics/names", "/api/metrics/query",
                      "/api/timeline", "/metrics"):
         assert endpoint in html, endpoint
     # the SPA's interactive pieces: tab views, sparkline canvas charts,
-    # incremental log tailing, task failure drill-down, object rollups
+    # incremental log tailing, task failure drill-down, object rollups,
+    # DAG edge tables with occupancy/throughput sparklines
     for marker in ("view-metrics", "view-serve", "view-timeline",
                    "view-tasks", "task-summary", "task-err",
                    "view-objects", "object-summary", "view-data",
-                   "data-exchanges", "sparkline", "offset="):
+                   "data-exchanges", "view-dags", "dag-list",
+                   "dag-edges", "sparkline", "offset="):
         assert marker in html, marker
     # one <script> block = one top-level scope: a duplicate const/let/
     # function declaration is a parse-time SyntaxError that kills the
@@ -211,6 +214,103 @@ def test_objects_endpoint_and_summary(dash_cluster):
     miss = json.loads(_get(port, "/api/objects?callsite=no%2Fsuch.py%3A1"))
     assert miss["objects"] == [] and miss["total"] == 0
     del ref
+
+
+@pytest.fixture
+def dag_dash_cluster(monkeypatch):
+    """Dashboard cluster with a fast DAG report cadence + short stall
+    grace (the head inherits the driver's config via RAYT_CONFIG_JSON)."""
+    monkeypatch.setenv("RAYT_DAG_STALL_GRACE_S", "1.0")
+    monkeypatch.setenv("RAYT_DAG_STATE_REPORT_INTERVAL_S", "0.25")
+    from ray_tpu._internal import config as cfg_mod
+
+    old = cfg_mod._config
+    cfg_mod.set_config(cfg_mod.load_config())
+    cluster = Cluster(head_resources={"CPU": 4.0}, dashboard_port=0)
+    cluster.connect()
+    try:
+        yield cluster
+    finally:
+        cluster.shutdown()
+        cfg_mod._config = old
+
+
+def test_dags_endpoint_and_stall_badge(dag_dash_cluster):
+    """/api/dags serves compiled-DAG records (edge topology + per-edge
+    rollups + history) with a summary attached — and after an actor is
+    killed mid-DAG, the SAME surface names the stalled edge and dead
+    peer the GCS watchdog attributed (the DAGs tab badge feed)."""
+    from ray_tpu.dag import InputNode
+
+    @rt.remote(num_cpus=0)
+    class DashRunner:
+        def produce(self, x):
+            return x * 2
+
+    @rt.remote(num_cpus=0)
+    class DashSink:
+        def consume(self, x):
+            return x + 1
+
+    runner, sink = DashRunner.remote(), DashSink.remote()
+    with InputNode() as inp:
+        out = sink.consume.bind(runner.produce.bind(inp))
+    dag = out.experimental_compile(channels=True)
+    for i in range(5):
+        assert dag.execute(i).get(timeout=60) == 2 * i + 1
+
+    port = dag_dash_cluster.dashboard_port
+    deadline = time.monotonic() + 30
+    rec = None
+    while time.monotonic() < deadline:
+        body = json.loads(_get(port, "/api/dags?limit=10"))
+        rec = next((d for d in body["dags"]
+                    if d["dag_id"] == dag.dag_id), None)
+        if rec is not None and rec["ticks"] >= 5:
+            break
+        time.sleep(0.3)
+    assert rec is not None and rec["state"] == "RUNNING"
+    assert rec["num_edges"] == 3
+    edge = next(e for e in rec["edges"] if e["role"] == "edge")
+    assert edge["producer"]["label"].startswith("DashRunner:")
+    assert edge["history"], "sparkline history never populated"
+    assert body["summary"]["totals"]["dags"] >= 1
+
+    # kill the producer: /api/dags surfaces the watchdog's attribution
+    runner_hex = runner._actor_id.hex()
+    rt.kill(runner)
+    stalled = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        body = json.loads(_get(port, "/api/dags?stalled=1"))
+        for d in body["dags"]:
+            for e in d["edges"]:
+                s = e.get("stall")
+                if s and s.get("dead_peer") == runner_hex:
+                    stalled = (d, e, s)
+        if stalled:
+            break
+        time.sleep(0.3)
+    assert stalled is not None, "stall never surfaced on /api/dags"
+    d, e, s = stalled
+    assert s["blocked"] == "read"
+    assert s["culprit"].startswith("DashRunner:")
+    assert e["edge"] in d["stalled_edges"]
+    assert body["summary"]["totals"]["stalled_edges"] >= 1
+
+    dag.teardown()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        body = json.loads(_get(port, "/api/dags?limit=10"))
+        rec = next((x for x in body["dags"]
+                    if x["dag_id"] == dag.dag_id), None)
+        if rec and rec["state"] == "TORN_DOWN" \
+                and not rec["stalled_edges"]:
+            break
+        time.sleep(0.3)
+    assert rec and rec["state"] == "TORN_DOWN"
+    assert rec["stalled_edges"] == []
+    rt.kill(sink)
 
 
 def test_tasks_endpoint_and_summary(dash_cluster):

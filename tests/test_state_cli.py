@@ -95,6 +95,12 @@ def test_cli_start_status_stop(tmp_path):
         r = cli("memory", "--address", address)
         assert r.returncode == 0, r.stderr
         assert "0 objects" in r.stdout
+
+        # dag state API plumbing (empty cluster: no DAGs compiled yet)
+        r = cli("list", "dags", "--address", address)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["dags"] == [] and out["total"] == 0
     finally:
         r = cli("stop")
         assert r.returncode == 0, r.stderr
