@@ -247,6 +247,21 @@ def _attention(cfg: LlamaConfig, q, k, v):
                                  block_k=cfg.attn_block_k)
 
 
+def _proj(cfg: LlamaConfig, layer: dict, name: str, h):
+    """Frozen matmul + optional LoRA low-rank path (shared by the
+    training block and the KV-cache decode block so adapters behave
+    identically at train and serve time). The [d, out] delta is never
+    materialized."""
+    dt = cfg.dtype
+    out = h @ layer[name].astype(dt)
+    a = layer.get(name + "_a")
+    if a is not None:
+        scale = cfg.lora_alpha / a.shape[-1]
+        out = out + ((h @ a.astype(dt)) @ layer[name + "_b"].astype(dt)
+                     ) * jnp.asarray(scale, dt)
+    return out
+
+
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     """One transformer block. x: [b, s, d] (cfg.dtype).
     Returns (x, moe_aux_loss) — aux is 0 for the dense path.
@@ -261,13 +276,7 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     dt = cfg.dtype
 
     def proj(name, h):
-        out = h @ layer[name].astype(dt)
-        a = layer.get(name + "_a")
-        if a is not None:
-            scale = cfg.lora_alpha / a.shape[-1]
-            out = out + ((h @ a.astype(dt)) @ layer[name + "_b"].astype(dt)
-                         ) * jnp.asarray(scale, dt)
-        return out
+        return _proj(cfg, layer, name, h)
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = proj("wq", h).reshape(b, s, nh, hd)
@@ -410,9 +419,9 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     dt = cfg.dtype
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(b, s, nh, hd)
-    kk = (h @ layer["wk"].astype(dt)).reshape(b, s, nkv, hd)
-    vv = (h @ layer["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = _proj(cfg, layer, "wq", h).reshape(b, s, nh, hd)
+    kk = _proj(cfg, layer, "wk", h).reshape(b, s, nkv, hd)
+    vv = _proj(cfg, layer, "wv", h).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     kk = apply_rope(kk, cos, sin, positions)
     if jnp.ndim(cache_len) == 0:
@@ -442,10 +451,11 @@ def _decode_block(cfg: LlamaConfig, x, layer, k_cache, v_cache, cos, sin,
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, s, nh * hd)
-    x = x + attn @ layer["wo"].astype(dt)
+    x = x + _proj(cfg, layer, "wo", attn)
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    x = x + (jax.nn.silu(h @ layer["w_gate"].astype(dt))
-             * (h @ layer["w_up"].astype(dt))) @ layer["w_down"].astype(dt)
+    x = x + _proj(cfg, layer, "w_down",
+                  jax.nn.silu(_proj(cfg, layer, "w_gate", h))
+                  * _proj(cfg, layer, "w_up", h))
     return x, k_cache, v_cache
 
 
@@ -474,6 +484,12 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
         positions = jnp.maximum(abs_positions - start[:, None], 0)
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    scanned_layers = params["layers"]
+    if "lora" in params:
+        # serve-time adapters: stacked on the same [n_layers] axis, they
+        # ride the decode scan exactly like the training path's (the
+        # _proj low-rank branch fires per layer; models/lora.py)
+        scanned_layers = {**scanned_layers, **params["lora"]["layers"]}
 
     def step(x, inputs):
         layer, kc, vc = inputs
@@ -483,7 +499,7 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
-        step, x, (params["layers"], cache["k"], cache["v"]))
+        step, x, (scanned_layers, cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(dt)

@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from ray_tpu.serve.admission import (ReplicaOverloadedError,  # noqa: F401
+                                     is_overload_error)
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,  # noqa: F401
                                       Deployment, deployment)
 from ray_tpu.serve.handle import (DeploymentHandle,  # noqa: F401
@@ -125,9 +127,15 @@ def delete(name: str = "default"):
         rt.get(_grpc_proxy.unregister_app.remote(name), timeout=30)
 
 
-def start(*, http_host: str = "127.0.0.1", http_port: int = 0) -> int:
+def start(*, http_host: str = "127.0.0.1", http_port: int = 0,
+          request_timeout_s: Optional[float] = None,
+          admission_headroom: Optional[float] = None) -> int:
     """Start the HTTP ingress proxy; returns the bound port (ref:
-    proxy-per-node in the reference; one proxy here — single-head)."""
+    proxy-per-node in the reference; one proxy here — single-head).
+    ``request_timeout_s`` / ``admission_headroom`` override the
+    RAYT_SERVE_REQUEST_TIMEOUT_S / RAYT_SERVE_ADMISSION_HEADROOM env
+    defaults (the env is read in the PROXY process, which inherits the
+    driver's environment at cluster init)."""
     global _proxy, _proxy_port
     import ray_tpu as rt
     from ray_tpu.serve.proxy import ProxyActor
@@ -135,12 +143,15 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 0) -> int:
     _controller()
     if _proxy is None:
         _proxy = rt.remote(ProxyActor).options(
-            name="serve_proxy", num_cpus=0).remote(http_host, http_port)
+            name="serve_proxy", num_cpus=0).remote(
+            http_host, http_port, request_timeout_s, admission_headroom)
         _proxy_port = rt.get(_proxy.start.remote(), timeout=60)
     return _proxy_port
 
 
-def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0) -> int:
+def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
+               request_timeout_s: Optional[float] = None,
+               admission_headroom: Optional[float] = None) -> int:
     """Start the gRPC ingress (generic byte service /rayt.serve.Serve;
     ref analog: serve's gRPC proxy data plane)."""
     global _grpc_proxy, _grpc_port
@@ -150,8 +161,8 @@ def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0) -> int:
     controller = _controller()
     if _grpc_proxy is None:
         _grpc_proxy = rt.remote(GrpcProxyActor).options(
-            name="serve_grpc_proxy", num_cpus=0).remote(grpc_host,
-                                                        grpc_port)
+            name="serve_grpc_proxy", num_cpus=0).remote(
+            grpc_host, grpc_port, request_timeout_s, admission_headroom)
         _grpc_port = rt.get(_grpc_proxy.start.remote(), timeout=60)
         # register existing apps so a late-started ingress still routes
         for app_name in rt.get(controller.list_applications.remote(),

@@ -1,0 +1,165 @@
+"""Proxy admission control + backpressure primitives (ref analogs:
+python/ray/serve/_private/proxy.py request management and the
+max_ongoing_requests backpressure story in replica_scheduler/).
+
+The ingress proxies (HTTP + gRPC) size a per-app ADMISSION WINDOW from
+the routing table::
+
+    window = ceil(num_replicas * max_ongoing_requests * headroom)
+
+Requests beyond the window are SHED immediately (HTTP 503 +
+``Retry-After``; gRPC RESOURCE_EXHAUSTED) instead of queueing until the
+request timeout — under overload the proxy's answer latency stays flat
+and bounded while the excess is pushed back to the client. The headroom
+slice (> 1.0) lets a bounded queue absorb bursts: admitted requests
+beyond raw replica capacity wait in the ROUTER (DeploymentHandle's
+capacity gate), not in an unbounded executor pile-up.
+
+Replica-side queue-full (a replica at ``max_ongoing_requests``) raises
+``ReplicaOverloadedError`` — backpressure, not a 500: the router retries
+another replica and, if every replica is saturated past the queue
+timeout, the error surfaces to the proxy which maps it to 503 /
+RESOURCE_EXHAUSTED.
+
+Env knobs (read per request so tests and operators can tune live where
+the process inherits the env):
+
+* ``RAYT_SERVE_REQUEST_TIMEOUT_S`` — end-to-end proxy wait for one
+  request's result (default 60).
+* ``RAYT_SERVE_ADMISSION_HEADROOM`` — window multiplier (default 2.0).
+* ``RAYT_SERVE_RETRY_AFTER_S`` — Retry-After hint on shed (default 1).
+* ``RAYT_SERVE_QUEUE_TIMEOUT_S`` — router capacity-wait bound
+  (default 30; see handle.py).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+from ray_tpu.core.common import RayTpuError
+
+REQUEST_TIMEOUT_ENV = "RAYT_SERVE_REQUEST_TIMEOUT_S"
+HEADROOM_ENV = "RAYT_SERVE_ADMISSION_HEADROOM"
+RETRY_AFTER_ENV = "RAYT_SERVE_RETRY_AFTER_S"
+QUEUE_TIMEOUT_ENV = "RAYT_SERVE_QUEUE_TIMEOUT_S"
+
+
+class ReplicaOverloadedError(RayTpuError):
+    """Every candidate replica is at max_ongoing_requests (router queue
+    timeout hit), or a single replica refused a request at capacity.
+    Maps to HTTP 503 / gRPC RESOURCE_EXHAUSTED at the ingress — clients
+    should back off and retry."""
+
+
+def request_timeout_s(default: float = 60.0) -> float:
+    try:
+        return float(os.environ.get(REQUEST_TIMEOUT_ENV, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def queue_timeout_s(default: float = 30.0) -> float:
+    try:
+        return float(os.environ.get(QUEUE_TIMEOUT_ENV, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def retry_after_s() -> int:
+    try:
+        return max(1, int(float(os.environ.get(RETRY_AFTER_ENV, "1"))))
+    except (TypeError, ValueError):
+        return 1
+
+
+def is_overload_error(exc: BaseException) -> bool:
+    """True for a ReplicaOverloadedError raised directly OR travelling
+    as the ``cause`` of a TaskError (how a replica-side raise reaches
+    the caller through rt.get)."""
+    if isinstance(exc, ReplicaOverloadedError):
+        return True
+    return isinstance(getattr(exc, "cause", None), ReplicaOverloadedError)
+
+
+def count_shed(app: str, proxy: str, reason: str):
+    """Increment rayt_serve_shed_total (best-effort; shared by both
+    ingress proxies so the tag scheme can't drift)."""
+    try:
+        from ray_tpu.util import builtin_metrics as bm
+
+        bm.serve_shed.inc(tags={"app": app, "proxy": proxy,
+                                "reason": reason})
+    except Exception:
+        pass
+
+
+def count_admitted(app: str, proxy: str):
+    """Increment rayt_serve_admitted_total (best-effort)."""
+    try:
+        from ray_tpu.util import builtin_metrics as bm
+
+        bm.serve_admitted.inc(tags={"app": app, "proxy": proxy})
+    except Exception:
+        pass
+
+
+class AdmissionWindow:
+    """Per-app in-flight accounting for an ingress proxy.
+
+    Thread-safe (the gRPC proxy acquires from server threads; the HTTP
+    proxy from its event loop). ``try_acquire`` is the only decision
+    point: it recomputes the window from the CURRENT routing-table
+    capacity every call, so replica autoscaling grows/shrinks the window
+    with no extra control traffic.
+    """
+
+    def __init__(self, headroom: float | None = None):
+        if headroom is None:
+            try:
+                headroom = float(os.environ.get(HEADROOM_ENV, "2.0"))
+            except (TypeError, ValueError):
+                headroom = 2.0
+        self.headroom = max(1.0, float(headroom))
+        self._lock = threading.Lock()
+        self._admitted: dict[str, int] = {}
+        self._windows: dict[str, int] = {}
+        self._shed_total: dict[str, int] = {}
+        self._admitted_total: dict[str, int] = {}
+
+    def window_for(self, num_replicas: int, max_ongoing: int) -> int:
+        return max(1, int(math.ceil(
+            max(1, num_replicas) * max(1, max_ongoing) * self.headroom)))
+
+    def try_acquire(self, app: str, num_replicas: int,
+                    max_ongoing: int) -> bool:
+        window = self.window_for(num_replicas, max_ongoing)
+        with self._lock:
+            self._windows[app] = window
+            if self._admitted.get(app, 0) >= window:
+                self._shed_total[app] = self._shed_total.get(app, 0) + 1
+                return False
+            self._admitted[app] = self._admitted.get(app, 0) + 1
+            self._admitted_total[app] = \
+                self._admitted_total.get(app, 0) + 1
+            return True
+
+    def release(self, app: str):
+        with self._lock:
+            n = self._admitted.get(app, 0)
+            self._admitted[app] = max(0, n - 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                app: {
+                    "admitted": self._admitted.get(app, 0),
+                    "window": self._windows.get(app, 0),
+                    "admitted_total": self._admitted_total.get(app, 0),
+                    "shed_total": self._shed_total.get(app, 0),
+                }
+                for app in (set(self._admitted) | set(self._windows)
+                            | set(self._shed_total)
+                            | set(self._admitted_total))
+            }

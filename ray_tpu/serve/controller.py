@@ -11,12 +11,18 @@ long-poll analog) with a version counter so unchanged tables are cheap.
 from __future__ import annotations
 
 import asyncio
+import math
 import time
+import traceback
 from typing import Any, Optional
 
 import cloudpickle
 
 CONTROLLER_NAME = "serve_controller"
+
+# a wedged reconcile loop must be diagnosable: errors log at WARNING
+# with traceback, rate-limited so a persistent failure can't flood
+RECONCILE_ERR_LOG_INTERVAL_S = 30.0
 
 
 class ServeController:
@@ -47,6 +53,13 @@ class ServeController:
         self._loop_task = None  # started via ensure_loop (needs the
         # actor's asyncio loop, which doesn't exist during __init__)
         self._reconcile_lock: asyncio.Lock | None = None  # lazy: needs loop
+        self._last_err_log = 0.0
+        # metrics-store signal cache: key -> (signals dict, monotonic ts)
+        # (throttles GCS metrics_query RPCs to ~1/s per deployment)
+        self._signal_cache: dict[tuple, tuple[dict, float]] = {}
+        # last autoscale decision per key (introspection: tests, bench,
+        # dashboard): {"desired", "target", "live", "signals", "ts"}
+        self._autoscale_status: dict[str, dict] = {}
 
     async def ensure_loop(self) -> bool:
         if self._loop_task is None:
@@ -87,6 +100,8 @@ class ServeController:
             for handle in self.replicas.pop((app_name, dep_name), []):
                 self._draining.append((handle, deadline))
             self._abandon_update((app_name, dep_name))
+            self._signal_cache.pop((app_name, dep_name), None)
+            self._autoscale_status.pop(f"{app_name}/{dep_name}", None)
         for dep_name in replaced:
             key = (app_name, dep_name)
             # update-of-an-update: abandoned warming replicas die
@@ -130,6 +145,8 @@ class ServeController:
             for handle in self.replicas.pop((app_name, dep_name), []):
                 self._kill_quietly(handle)
             self._abandon_update((app_name, dep_name))
+            self._signal_cache.pop((app_name, dep_name), None)
+            self._autoscale_status.pop(f"{app_name}/{dep_name}", None)
         self.version += 1
         return True
 
@@ -156,10 +173,20 @@ class ServeController:
     def get_route_info(self, known_version: int, key: str) -> dict:
         """One-RPC handle refresh: routing-table delta (None when the
         version is current) + this deployment's replica load snapshot
-        (cross-handle pow-2 signal; ref: replica queue-length cache)."""
+        (cross-handle pow-2 signal; ref: replica queue-length cache) +
+        the deployment's max_ongoing_requests so routers/proxies can
+        size saturation thresholds and admission windows."""
         app, _, dep = key.partition("/")
+        spec = self.apps.get(app, {}).get(dep, {})
         return {"update": self.get_routing_table(known_version),
-                "load": self._replica_load.get((app, dep), {})}
+                "load": self._replica_load.get((app, dep), {}),
+                "max_ongoing": int(spec.get("max_ongoing_requests", 16))}
+
+    def get_autoscale_status(self) -> dict:
+        """Last autoscale decision per 'app/dep' (desired demand, the
+        post-hysteresis target actually applied, live count, and the
+        metric signals that fed the decision)."""
+        return dict(self._autoscale_status)
 
     async def wait_ready(self, app_name: str, timeout: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -178,12 +205,28 @@ class ServeController:
             try:
                 await self._reconcile()
             except Exception:
-                pass
+                self._log_reconcile_error("reconcile")
             try:
                 await self._drain_tick()
             except Exception:
-                pass
+                self._log_reconcile_error("drain")
             await asyncio.sleep(0.5)
+
+    def _log_reconcile_error(self, phase: str):
+        now = time.monotonic()
+        if now - self._last_err_log < RECONCILE_ERR_LOG_INTERVAL_S:
+            return
+        self._last_err_log = now
+        try:
+            from ray_tpu._internal.logging_utils import setup_logger
+
+            setup_logger("serve_controller").warning(
+                "serve controller %s tick failed (loop keeps running; "
+                "further errors suppressed for %.0fs):\n%s",
+                phase, RECONCILE_ERR_LOG_INTERVAL_S,
+                traceback.format_exc())
+        except Exception:
+            pass  # logging must never take the loop down with it
 
     async def _drain_tick(self):
         """Kill draining (de-routed) replicas once their in-flight requests
@@ -383,12 +426,79 @@ class ServeController:
         opts = dict(spec.get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 0)
         opts["max_concurrency"] = max(
-            spec.get("max_ongoing_requests", 16), 16)
+            spec.get("max_ongoing_requests", 16), 16) + 4  # +stats/health
         cls = rt.remote(**opts)(ReplicaActor)
         return cls.remote(spec["name"], app_name, spec["callable_blob"],
                           spec.get("init_args", ()),
                           spec.get("init_kwargs", {}),
-                          spec.get("user_config"))
+                          spec.get("user_config"),
+                          spec.get("max_ongoing_requests", 16))
+
+    # --------------------------------------------------------- autoscaling
+    def _metrics_signals(self, key: tuple, window_s: float) -> dict:
+        """Per-deployment QPS / p99 latency / router queue depth from the
+        GCS metrics store (PR-1 pipeline): the demand signals replicas
+        can't see themselves. QPS is the served-request rate
+        (rayt_serve_requests_total), latency the cross-node p99
+        (rayt_serve_request_latency_s), queue depth the merged sum of
+        every handle's capacity-gate gauge (rayt_serve_handle_queued).
+        Best-effort: an empty store or a query hiccup yields Nones and
+        the ongoing-requests signal alone drives the decision. Cached
+        ~1s so a 0.5s reconcile cadence doesn't double-query."""
+        cached = self._signal_cache.get(key)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < 1.0:
+            return cached[0]
+        app, dep = key
+        tags = {"app": app, "deployment": dep}
+        window_s = max(float(window_s or 30.0), 10.0)
+        out = {"qps": None, "p99_latency_s": None, "queued": None}
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+
+            def q(name, agg, win=window_s):
+                res = cw.io.run(cw.gcs.conn.call("metrics_query", {
+                    "name": name, "window_s": win, "agg": agg,
+                    "tags": tags, "merge": True}))
+                pts = [v for s in (res or {}).get("series", [])
+                       for _, v in s.get("points", []) if v is not None]
+                return pts
+
+            qps = q("rayt_serve_requests_total", "rate")
+            if qps:
+                # mean of the trailing points smooths bin-edge jitter
+                tail = qps[-3:]
+                out["qps"] = sum(tail) / len(tail)
+            lat = q("rayt_serve_request_latency_s", "p99")
+            if lat:
+                out["p99_latency_s"] = lat[-1]
+            # deliberately SHORT window: a client killed while parked
+            # never emits its trailing 0, so its phantom gauge must age
+            # out fast (hysteresis covers the remaining seconds)
+            queued = q("rayt_serve_handle_queued", "last", win=15.0)
+            if queued:
+                out["queued"] = queued[-1]
+        except Exception:
+            pass
+        self._signal_cache[key] = (out, now)
+        return out
+
+    def _emit_decision(self, key: tuple, target: int, desired: int,
+                       live: int, signals: dict):
+        app, dep = key
+        self._autoscale_status[f"{app}/{dep}"] = {
+            "target": int(target), "desired": int(desired),
+            "live": int(live), "signals": dict(signals),
+            "ts": time.time()}
+        try:
+            from ray_tpu.util import builtin_metrics as bm
+
+            bm.serve_autoscale_decision.set(
+                float(target), tags={"app": app, "deployment": dep})
+        except Exception:
+            pass
 
     async def _target_replicas(self, key: tuple, spec: dict,
                                live: int, stats=None) -> int:
@@ -400,30 +510,51 @@ class ServeController:
             stats = await self._collect_stats(key)
         if stats is None:
             return max(live, auto.min_replicas)
+        signals = self._metrics_signals(
+            key, getattr(auto, "metrics_window_s", 30.0))
         ongoing = sum(v for v in stats if v is not None)
-        desired = max(
-            auto.min_replicas,
-            min(auto.max_replicas,
-                -(-int(ongoing) // max(1, int(auto.target_ongoing_requests)))
-                if ongoing else auto.min_replicas))
+        # demand = max over the signals that are live; router queue depth
+        # folds into the ongoing signal (queued requests are demand the
+        # saturated replicas can't report themselves)
+        queued = signals.get("queued") or 0.0
+        load = ongoing + max(0.0, queued)
+        desired = (int(math.ceil(
+            load / max(1e-6, float(auto.target_ongoing_requests))))
+            if load > 0 else auto.min_replicas)
+        target_qps = getattr(auto, "target_qps_per_replica", None)
+        if target_qps and signals.get("qps"):
+            desired = max(desired, int(math.ceil(
+                signals["qps"] / float(target_qps))))
+        lat_target = getattr(auto, "latency_target_s", None)
+        if lat_target and (signals.get("p99_latency_s") or 0) > lat_target:
+            desired = max(desired, live + 1)  # one step per decision
+        desired = max(auto.min_replicas,
+                      min(auto.max_replicas, desired))
+        target = self._apply_hysteresis(key, auto, live, desired)
+        self._emit_decision(key, target, desired, live, signals)
+        return target
+
+    def _apply_hysteresis(self, key: tuple, auto, live: int,
+                          desired: int) -> int:
+        """The desired direction must hold continuously for the up/down
+        delay before replicas move (no flapping inside the window)."""
         now = time.monotonic()
-        mark_key = key
         if desired > live:
-            first = self._scale_marks.setdefault((mark_key, "up"), now)
-            self._scale_marks.pop((mark_key, "down"), None)
+            first = self._scale_marks.setdefault((key, "up"), now)
+            self._scale_marks.pop((key, "down"), None)
             if now - first >= auto.upscale_delay_s:
-                self._scale_marks.pop((mark_key, "up"), None)
+                self._scale_marks.pop((key, "up"), None)
                 return desired
             return live
         if desired < live:
-            first = self._scale_marks.setdefault((mark_key, "down"), now)
-            self._scale_marks.pop((mark_key, "up"), None)
+            first = self._scale_marks.setdefault((key, "down"), now)
+            self._scale_marks.pop((key, "up"), None)
             if now - first >= auto.downscale_delay_s:
-                self._scale_marks.pop((mark_key, "down"), None)
+                self._scale_marks.pop((key, "down"), None)
                 return desired
             return live
-        self._scale_marks.pop((mark_key, "up"), None)
-        self._scale_marks.pop((mark_key, "down"), None)
+        self._scale_marks.pop((key, "up"), None)
+        self._scale_marks.pop((key, "down"), None)
         return live
 
     async def _collect_stats(self, key: tuple) -> Optional[list]:

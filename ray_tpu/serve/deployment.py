@@ -15,11 +15,28 @@ from typing import Any, Callable, Optional
 
 @dataclasses.dataclass
 class AutoscalingConfig:
+    """Closed-loop replica autoscaling policy (ref analog:
+    serve/config.py AutoscalingConfig + autoscaling_state.py).
+
+    The controller combines three live signals each reconcile tick:
+    ongoing requests reported by replicas (+ router queue depth from the
+    metrics store) against ``target_ongoing_requests``, per-deployment
+    QPS from the metrics store against ``target_qps_per_replica`` (when
+    set), and p99 request latency against ``latency_target_s`` (when
+    set; adds one replica per decision while violated). The largest
+    demand wins, clamped to [min_replicas, max_replicas], then the
+    up/down delays apply hysteresis: the desired direction must hold
+    continuously for the delay before replicas actually move."""
+
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # metrics-store-driven signals (None disables the signal)
+    target_qps_per_replica: Optional[float] = None
+    latency_target_s: Optional[float] = None
+    metrics_window_s: float = 30.0
 
 
 class Deployment:

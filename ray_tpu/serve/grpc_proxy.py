@@ -11,24 +11,36 @@ Request bytes: JSON {"app": <name>, "payload": <json value>,
 "model_id": <optional>}; response bytes: JSON value per result (one per
 stream message for PredictStream). Runs inside an async actor next to
 the HTTP proxy, sharing the same DeploymentHandle routing path.
+
+Mirrors the HTTP proxy's admission control and status split (see
+serve/admission.py): shed / replica queue-full / timeout abort with
+RESOURCE_EXHAUSTED or UNAVAILABLE (retry semantics), replica user-code
+exceptions with INTERNAL.
 """
 
 from __future__ import annotations
 
-import asyncio
 import json
 from typing import Any
+
+from ray_tpu.serve.admission import (AdmissionWindow, count_admitted,
+                                     count_shed, is_overload_error,
+                                     request_timeout_s, retry_after_s)
 
 _SERVICE = "rayt.serve.Serve"
 
 
 class GrpcProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float | None = None,
+                 admission_headroom: float | None = None):
         self.host = host
         self.port = port
         self._handles: dict[str, Any] = {}
         self._ingress: dict[str, str] = {}
         self._server = None
+        self._timeout_override = request_timeout_s
+        self._admission = AdmissionWindow(admission_headroom)
 
     # ------------------------------------------------------------- control
     def register_app(self, app_name: str, ingress_deployment: str) -> bool:
@@ -40,6 +52,9 @@ class GrpcProxyActor:
         self._ingress.pop(app_name, None)
         self._handles.pop(app_name, None)
         return True
+
+    def admission_snapshot(self) -> dict:
+        return self._admission.snapshot()
 
     async def start(self) -> int:
         import grpc
@@ -87,33 +102,103 @@ class GrpcProxyActor:
             handle = DeploymentHandle(ingress, app_name)
             self._handles[app_name] = handle
         model_id = req.get("model_id") or ""
-        if model_id:
-            handle = handle.options(multiplexed_model_id=model_id)
-        return handle, req.get("payload")
+        from ray_tpu.serve.admission import queue_timeout_s
+
+        # bound the capacity-gate park by the request timeout (shed as
+        # backpressure instead of queueing into a deadline)
+        handle = handle.options(
+            multiplexed_model_id=model_id or None,
+            queue_timeout_s=min(queue_timeout_s(),
+                                self._request_timeout()))
+        return app_name, handle, req.get("payload")
+
+    def _request_timeout(self) -> float:
+        if self._timeout_override is not None:
+            return float(self._timeout_override)
+        return request_timeout_s()
+
+    def _admit(self, app_name: str, handle):
+        """Admission gate; raises _Abort(RESOURCE_EXHAUSTED) on shed.
+        Returns once this request holds a window slot."""
+        import grpc
+
+        try:
+            replicas, max_ongoing = handle.capacity()
+        except Exception:
+            replicas, max_ongoing = 1, 16
+        if not self._admission.try_acquire(app_name, replicas,
+                                           max_ongoing):
+            count_shed(app_name, "grpc", "shed")
+            raise _Abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"admission window full for app {app_name!r}; "
+                f"retry after {retry_after_s()}s")
+        count_admitted(app_name, "grpc")
+
+    def _abort_for(self, app_name: str, e: Exception) -> "_Abort":
+        """Mirror the HTTP 503/500 split onto gRPC codes."""
+        import grpc
+
+        from ray_tpu.core.common import GetTimeoutError
+
+        if isinstance(e, GetTimeoutError):
+            count_shed(app_name, "grpc", "timeout")
+            return _Abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"request exceeded {self._request_timeout():.0f}s "
+                f"(RAYT_SERVE_REQUEST_TIMEOUT_S); retry after "
+                f"{retry_after_s()}s")
+        if is_overload_error(e):
+            count_shed(app_name, "grpc", "queue_full")
+            return _Abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"replicas at capacity: {e!r}; retry after "
+                f"{retry_after_s()}s")
+        if isinstance(e, RuntimeError) and "no replicas" in str(e):
+            count_shed(app_name, "grpc", "no_replicas")
+            return _Abort(grpc.StatusCode.UNAVAILABLE, repr(e))
+        return _Abort(grpc.StatusCode.INTERNAL, repr(e))
 
     def _predict(self, request_bytes: bytes, context) -> bytes:
         try:
-            handle, payload = self._resolve(request_bytes)
-            result = handle.remote(payload).result(timeout=300)
-            return json.dumps(result, default=str).encode()
+            app_name, handle, payload = self._resolve(request_bytes)
         except _Abort as e:
             context.abort(e.code, e.detail)
+            return
+        try:
+            self._admit(app_name, handle)
+        except _Abort as e:
+            context.abort(e.code, e.detail)
+            return
+        try:
+            result = handle.remote(payload).result(
+                timeout=self._request_timeout())
+            return json.dumps(result, default=str).encode()
         except Exception as e:
-            import grpc
-
-            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            a = self._abort_for(app_name, e)
+            context.abort(a.code, a.detail)
+        finally:
+            self._admission.release(app_name)
 
     def _predict_stream(self, request_bytes: bytes, context):
         try:
-            handle, payload = self._resolve(request_bytes)
-            for item in handle.options(stream=True).remote(payload):
-                yield json.dumps(item, default=str).encode()
+            app_name, handle, payload = self._resolve(request_bytes)
         except _Abort as e:
             context.abort(e.code, e.detail)
+            return
+        try:
+            self._admit(app_name, handle)
+        except _Abort as e:
+            context.abort(e.code, e.detail)
+            return
+        try:
+            for item in handle.options(stream=True).remote(payload):
+                yield json.dumps(item, default=str).encode()
         except Exception as e:
-            import grpc
-
-            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            a = self._abort_for(app_name, e)
+            context.abort(a.code, a.detail)
+        finally:
+            self._admission.release(app_name)
 
 
 class _Abort(Exception):

@@ -2,11 +2,34 @@
 python/ray/serve/handle.py, _private/router.py:321,
 _private/replica_scheduler/pow_2_scheduler.py:52).
 
-Power-of-two-choices over the handle's LOCAL in-flight counts (the
-reference's router keeps a queue-len cache the same way): pick two random
-replicas, send to the one this handle has fewer outstanding requests on.
-Routing tables refresh from the controller on a short TTL (the long-poll
-analog), keyed by a version counter so unchanged tables cost one RPC.
+Routing is power-of-two-choices over per-replica load — the max of the
+controller-reported ongoing count (cross-handle signal, refreshed each
+reconcile tick) and this handle family's own in-flight count (exact and
+instantaneous for its traffic). Routing tables refresh from the
+controller on a short TTL (the long-poll analog), keyed by a version
+counter so unchanged tables cost one RPC.
+
+Capacity gate: a request never dispatches to a replica whose load is at
+``max_ongoing_requests``. When EVERY replica is saturated the request
+parks in the handle (bounded by ``RAYT_SERVE_QUEUE_TIMEOUT_S``) instead
+of piling into replica actor queues; the park count is exported as the
+``rayt_serve_handle_queued`` gauge — the autoscaler's queue-depth
+signal. Past the deadline, ReplicaOverloadedError surfaces (the ingress
+maps it to 503).
+
+Model multiplexing routes by AFFINITY: each model id remembers the
+replicas that served it (their multiplex LRUs hold the adapter). Repeat
+traffic prefers the least-loaded unsaturated affinity replica and only
+spills to power-of-two-choices when every affinity target is saturated
+— the spill target then joins the affinity set, so a hot adapter's
+working set grows with its load instead of thrashing replica caches.
+Affinity entries are LRU at both levels (model ids, replicas per model)
+and keyed by actor id, so a benign table refresh keeps them and a
+replica removal drops exactly the dead entries.
+
+A handle and all its ``options()`` clones share one router state
+(table, in-flight counts, affinity), so a proxy that builds a per-model
+clone per request still routes on complete local knowledge.
 """
 
 from __future__ import annotations
@@ -14,6 +37,8 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from typing import Any, Optional
 
 
@@ -24,6 +49,221 @@ def _get_controller():
     return rt.get_actor(CONTROLLER_NAME)
 
 
+class _RouterState:
+    """Routing state shared by a handle family (a DeploymentHandle and
+    every options() clone): routing table + version, controller load
+    snapshot, local in-flight counts (actor-id-keyed so they survive
+    table refreshes), and the model-affinity LRU."""
+
+    MAX_MODELS = 1024             # affinity LRU: model-id entries
+    MAX_REPLICAS_PER_MODEL = 4    # affinity LRU: replicas per model id
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.key = f"{app_name}/{deployment_name}"
+        self.lock = threading.Lock()
+        # parked pickers wait here; done()/table updates notify so a
+        # freed slot wakes a waiter immediately instead of being found
+        # by a poll (the 50ms wait cap only re-checks deadlines/TTL)
+        self.capacity_freed = threading.Condition(self.lock)
+        self.controller = None
+        self.table_version = -1
+        self.replicas: list = []
+        self.hexes: list[str] = []       # actor-id hex, aligned w/ replicas
+        self.table_ts = 0.0
+        self.load: dict[int, float] = {}  # controller-reported, index-keyed
+        self.max_ongoing = 16
+        self.inflight: dict[str, int] = {}   # actor hex -> local in-flight
+        # model id -> OrderedDict[replica hex] (most-recent last)
+        self.model_affinity: OrderedDict[str, OrderedDict[str, None]] = \
+            OrderedDict()
+        self.handle_hex = uuid.uuid4().hex[:8]
+        self.waiting = 0                  # requests parked in the gate
+
+    # ------------------------------------------------------------- refresh
+    def refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self.lock:
+            fresh = now - self.table_ts < 1.0 and self.replicas
+            if fresh and not force:
+                return
+        import ray_tpu as rt
+
+        if self.controller is None:
+            self.controller = _get_controller()
+        known = -1 if force else self.table_version
+        info = rt.get(self.controller.get_route_info.remote(known, self.key),
+                      timeout=30)
+        self.apply_route_info(info, now)
+
+    def apply_route_info(self, info: dict, now: float | None = None):
+        update = info.get("update")
+        with self.lock:
+            self.table_ts = time.monotonic() if now is None else now
+            self.load = dict(info.get("load") or {})
+            self.max_ongoing = int(info.get("max_ongoing") or 16)
+            if update is None:
+                return
+            self.table_version = update["version"]
+            self.replicas = update["table"].get(self.key, [])
+            self.hexes = [r._actor_id.hex() for r in self.replicas]
+            live = set(self.hexes)
+            # table version changed: drop state for replicas no longer
+            # routed; entries for still-routed replicas survive (a benign
+            # refresh keeps affinity, a removal clears exactly its entries)
+            self.inflight = {h: c for h, c in self.inflight.items()
+                             if h in live}
+            for mid in list(self.model_affinity):
+                reps = self.model_affinity[mid]
+                for h in [h for h in reps if h not in live]:
+                    del reps[h]
+                if not reps:
+                    del self.model_affinity[mid]
+            self.capacity_freed.notify_all()  # new table may have slots
+
+    # ------------------------------------------------------------- scoring
+    def _score(self, idx: int, hex_: str) -> float:
+        """Replica load = max(controller snapshot, local in-flight). The
+        snapshot already CONTAINS this family's dispatched requests, so
+        summing would double-count; max() is exact when this family is
+        the replica's only client (the ingress-proxy case) and stays a
+        lower bound otherwise."""
+        return max(float(self.load.get(idx, 0.0)),
+                   float(self.inflight.get(hex_, 0)))
+
+    def _record_affinity(self, model_id: str, hex_: str):
+        reps = self.model_affinity.get(model_id)
+        if reps is None:
+            reps = self.model_affinity[model_id] = OrderedDict()
+        reps[hex_] = None
+        reps.move_to_end(hex_)
+        while len(reps) > self.MAX_REPLICAS_PER_MODEL:
+            reps.popitem(last=False)
+        self.model_affinity.move_to_end(model_id)
+        while len(self.model_affinity) > self.MAX_MODELS:
+            self.model_affinity.popitem(last=False)
+
+    def _try_pick_locked(self, model_id: str):
+        """One routing attempt (callers hold the lock): returns
+        (replica, hex) or None when every candidate is saturated."""
+        n = len(self.replicas)
+        if n == 0:
+            return None
+        hex2idx = {h: i for i, h in enumerate(self.hexes)}
+        if model_id:
+            reps = self.model_affinity.get(model_id)
+            if reps:
+                best = None
+                for h in reps:
+                    i = hex2idx.get(h)
+                    if i is None:
+                        continue
+                    s = self._score(i, h)
+                    if s < self.max_ongoing and (
+                            best is None or s < best[0]):
+                        best = (s, i, h)
+                if best is not None:
+                    self.model_affinity.move_to_end(model_id)
+                    reps.move_to_end(best[2])
+                    return self.replicas[best[1]], best[2]
+                # every affinity target saturated: SPILL to pow-2 below
+                # (the spill target joins the affinity set)
+        if n == 1:
+            i = j = 0
+        else:
+            i, j = random.sample(range(n), 2)
+        si = self._score(i, self.hexes[i])
+        sj = self._score(j, self.hexes[j])
+        pick, s = (i, si) if si <= sj else (j, sj)
+        if s >= self.max_ongoing:
+            # sampled pair saturated: fall back to a full argmin scan so
+            # we only park when the WHOLE fleet is at capacity
+            pick, s = min(
+                ((k, self._score(k, self.hexes[k])) for k in range(n)),
+                key=lambda t: t[1])
+            if s >= self.max_ongoing:
+                return None
+        hex_ = self.hexes[pick]
+        if model_id:
+            self._record_affinity(model_id, hex_)
+        return self.replicas[pick], hex_
+
+    # ---------------------------------------------------------------- pick
+    def _emit_queued(self):
+        try:
+            from ray_tpu.util import builtin_metrics as bm
+
+            bm.serve_handle_queued.set(
+                float(self.waiting),
+                tags={"app": self.app_name,
+                      "deployment": self.deployment_name,
+                      "handle": self.handle_hex})
+        except Exception:
+            pass
+
+    def pick(self, model_id: str, queue_timeout: float):
+        """Pick a replica and charge the local in-flight count; returns
+        (replica, done). Parks while every replica is saturated, up to
+        ``queue_timeout`` seconds."""
+        from ray_tpu.serve.admission import ReplicaOverloadedError
+
+        empty_deadline = time.monotonic() + 30.0
+        queue_deadline = time.monotonic() + max(0.0, queue_timeout)
+        parked = False
+        last_emit = 0.0
+        try:
+            while True:
+                self.refresh()
+                with self.capacity_freed:
+                    n = len(self.replicas)
+                    got = self._try_pick_locked(model_id) if n else None
+                    if got is not None:
+                        replica, hex_ = got
+                        self.inflight[hex_] = self.inflight.get(hex_, 0) + 1
+                        return replica, self._make_done(hex_)
+                    now = time.monotonic()
+                    if n and not parked:
+                        parked = True
+                        self.waiting += 1
+                    if n and now <= queue_deadline:
+                        # all replicas saturated: park until a slot
+                        # frees (done()/table update notifies) — the
+                        # wait cap only re-checks deadlines/TTL
+                        self.capacity_freed.wait(timeout=0.05)
+                if n == 0:
+                    if now > empty_deadline:
+                        raise RuntimeError(
+                            f"no replicas for {self.key}")
+                    time.sleep(0.1)
+                    self.refresh(force=True)
+                    continue
+                if now > queue_deadline:
+                    raise ReplicaOverloadedError(
+                        f"all {n} replicas of {self.key} at "
+                        f"max_ongoing_requests={self.max_ongoing} for "
+                        f"{queue_timeout:.1f}s")
+                # export the queue depth so the autoscaler sees the
+                # unmet demand
+                if now - last_emit > 0.25:
+                    last_emit = now
+                    self._emit_queued()
+        finally:
+            if parked:
+                with self.lock:
+                    self.waiting -= 1
+                self._emit_queued()
+
+    def _make_done(self, hex_: str):
+        def done():
+            with self.capacity_freed:
+                n = self.inflight.get(hex_, 1)
+                self.inflight[hex_] = max(0, n - 1)
+                self.capacity_freed.notify_all()
+
+        return done
+
+
 class DeploymentResponse:
     """Future-like response (ref: serve handle DeploymentResponse).
 
@@ -31,9 +271,13 @@ class DeploymentResponse:
     health probe killing it) resolves to ActorDiedError — the router
     retries it on a live replica from a force-refreshed table, so
     clients never see the transient (ref: router retry of requests to
-    draining/dead replicas)."""
+    draining/dead replicas). A replica-side queue-full
+    (ReplicaOverloadedError) likewise resubmits through the router's
+    capacity gate — which waits for a free slot — before surfacing as
+    backpressure."""
 
     _MAX_DEAD_RETRIES = 3
+    _MAX_OVERLOAD_RETRIES = 3
 
     def __init__(self, ref, on_done, resubmit=None):
         self._ref = ref
@@ -48,21 +292,41 @@ class DeploymentResponse:
 
     def result(self, timeout: Optional[float] = None) -> Any:
         import ray_tpu as rt
-        from ray_tpu.core.common import ActorDiedError
+        from ray_tpu.core.common import ActorDiedError, GetTimeoutError
+        from ray_tpu.serve.admission import is_overload_error
 
-        attempts = 0
+        # ONE deadline across every retry: resubmits must not reset the
+        # clock, or a caller's 60s timeout could hold an admission slot
+        # for several multiples of that while attempts chain
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        dead = over = 0
         try:
             while True:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"request did not complete within "
+                            f"{timeout:.1f}s (including retries)")
+                else:
+                    remaining = None
                 try:
-                    return rt.get(self._ref, timeout=timeout)
+                    return rt.get(self._ref, timeout=remaining)
                 except ActorDiedError:
                     if self._resubmit is None or \
-                            attempts >= self._MAX_DEAD_RETRIES:
+                            dead >= self._MAX_DEAD_RETRIES:
                         raise
-                    attempts += 1
-                    self._finish()  # release the dead replica's slot
-                    self._ref, self._on_done = self._resubmit()
-                    self._done = False
+                    dead += 1
+                except Exception as e:
+                    if (not is_overload_error(e)
+                            or self._resubmit is None
+                            or over >= self._MAX_OVERLOAD_RETRIES):
+                        raise
+                    over += 1
+                self._finish()  # release the failed attempt's slot
+                self._ref, self._on_done = self._resubmit()
+                self._done = False
         finally:
             self._finish()
 
@@ -140,134 +404,84 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__", stream: bool = False,
                  multiplexed_model_id: str = "",
-                 retry_on_replica_death: bool = True):
+                 retry_on_replica_death: bool = True,
+                 queue_timeout_s: Optional[float] = None,
+                 _router: Optional[_RouterState] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
         self.multiplexed_model_id = multiplexed_model_id
         self.retry_on_replica_death = retry_on_replica_death
-        # model-id -> replica affinity (multiplex routing)
-        self._model_affinity: dict = {}
-        self._lock = threading.Lock()
-        self._table_version = -1
-        self._replicas: list = []
-        self._table_ts = 0.0
-        self._inflight: dict[Any, int] = {}
-        # controller-reported per-replica ongoing counts (index-aligned
-        # with _replicas): the cross-handle signal missing from a purely
-        # handle-local pow-2 (ref: replica_scheduler/common.py cache)
-        self._load: dict[int, float] = {}
-        self._controller = None
+        self.queue_timeout_s = queue_timeout_s
+        self._router = _router or _RouterState(deployment_name, app_name)
 
     # picklable: runtime state rebuilds lazily in the new process
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self.method_name,
                  self.stream, self.multiplexed_model_id,
-                 self.retry_on_replica_death))
+                 self.retry_on_replica_death, self.queue_timeout_s))
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
-                retry_on_replica_death: Optional[bool] = None
+                retry_on_replica_death: Optional[bool] = None,
+                queue_timeout_s: Optional[float] = None
                 ) -> "DeploymentHandle":
-        h = DeploymentHandle(
+        return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self.method_name,
             self.stream if stream is None else stream,
             self.multiplexed_model_id if multiplexed_model_id is None
             else multiplexed_model_id,
             self.retry_on_replica_death if retry_on_replica_death is None
-            else retry_on_replica_death)
-        h._model_affinity = self._model_affinity  # share affinity cache
-        return h
+            else retry_on_replica_death,
+            self.queue_timeout_s if queue_timeout_s is None
+            else queue_timeout_s,
+            _router=self._router)  # clones share the router state
 
-    # ------------------------------------------------------------- routing
+    # ------------------------------------------------- internals/back-compat
+    @property
+    def _model_affinity(self):
+        return self._router.model_affinity
+
+    @property
+    def _load(self):
+        return self._router.load
+
+    @property
+    def _replicas(self):
+        return self._router.replicas
+
+    @property
+    def _inflight(self):
+        return self._router.inflight
+
     def _refresh(self, force: bool = False):
-        now = time.monotonic()
-        with self._lock:
-            fresh = now - self._table_ts < 1.0 and self._replicas
-            if fresh and not force:
-                return
-        import ray_tpu as rt
+        self._router.refresh(force=force)
 
-        if self._controller is None:
-            self._controller = _get_controller()
-        known = -1 if force else self._table_version
-        key = f"{self.app_name}/{self.deployment_name}"
-        info = rt.get(self._controller.get_route_info.remote(known, key),
-                      timeout=30)
-        update = info["update"]
-        with self._lock:
-            self._table_ts = now
-            self._load = dict(info.get("load") or {})
-            if update is None:
-                return
-            self._table_version = update["version"]
-            self._replicas = update["table"].get(key, [])
-            live = set(id(r) for r in self._replicas)
-            self._inflight = {r: c for r, c in self._inflight.items()
-                              if id(r) in live}
+    def _queue_timeout(self) -> float:
+        if self.queue_timeout_s is not None:
+            return float(self.queue_timeout_s)
+        from ray_tpu.serve.admission import queue_timeout_s
 
-    def _pick_replica(self):
-        deadline = time.monotonic() + 30.0
-        while True:
-            self._refresh()
-            with self._lock:
-                replicas = list(self._replicas)
-            if replicas:
-                break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replicas for {self.app_name}/"
-                    f"{self.deployment_name}")
-            time.sleep(0.1)
-            self._refresh(force=True)
-        if len(replicas) == 1:
-            return replicas[0]
-        i, j = random.sample(range(len(replicas)), 2)
-        a, b = replicas[i], replicas[j]
-        with self._lock:
-            # pow-2 choice over reported (cross-handle) + local in-flight
-            # load — other clients' traffic is visible via the controller
-            # snapshot, so handles can't all pile onto one replica
-            sa = self._load.get(i, 0.0) + self._inflight.get(a, 0)
-            sb = self._load.get(j, 0.0) + self._inflight.get(b, 0)
-            return a if sa <= sb else b
+        return queue_timeout_s()
 
-    def _pick_replica_for_model(self, model_id: str):
-        """Model-affinity routing: repeat traffic for a model id goes to
-        the replica that last served it (its LRU likely holds the model —
-        ref: model-id-aware pow-2 scheduler), else normal pow-2 pick."""
-        if model_id:
-            preferred = self._model_affinity.get(model_id)
-            if preferred is not None:
-                self._refresh()
-                with self._lock:
-                    if any(r is preferred for r in self._replicas):
-                        return preferred
-        replica = self._pick_replica()
-        if model_id:
-            self._model_affinity[model_id] = replica
-            if len(self._model_affinity) > 1024:
-                self._model_affinity.pop(next(iter(self._model_affinity)))
-        return replica
+    def capacity(self) -> tuple[int, int]:
+        """(num_replicas, max_ongoing_requests) from the current routing
+        table — what the ingress proxies size admission windows from."""
+        self._router.refresh()
+        with self._router.lock:
+            return (max(1, len(self._router.replicas)),
+                    self._router.max_ongoing)
 
     # ---------------------------------------------------------------- call
     def _route(self):
-        """Pick a replica and charge this handle's in-flight count;
+        """Pick a replica and charge the family's in-flight count;
         returns (replica, done) where done releases the charge."""
-        replica = self._pick_replica_for_model(self.multiplexed_model_id)
-        with self._lock:
-            self._inflight[replica] = self._inflight.get(replica, 0) + 1
-
-        def done(replica=replica):
-            with self._lock:
-                n = self._inflight.get(replica, 1)
-                self._inflight[replica] = max(0, n - 1)
-
-        return replica, done
+        return self._router.pick(self.multiplexed_model_id,
+                                 self._queue_timeout())
 
     def _submit_once(self, args, kwargs):
         replica, done = self._route()

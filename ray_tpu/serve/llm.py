@@ -28,6 +28,7 @@ import numpy as np
 
 from ray_tpu.models import llama
 from ray_tpu.parallel.mesh import build_mesh, shard_params, spec_for
+from ray_tpu.serve.multiplex import multiplexed
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -109,6 +110,19 @@ class LLMEngine:
         logical = llama.param_logical_axes(cfg)
         if params is None:
             params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+        if "lora" in params:
+            # adapter-bearing params: the decode path applies the
+            # low-rank delta in-scan (models/llama.py), so the engine
+            # just needs matching shardings for the adapter subtree
+            from ray_tpu.models import lora as lora_mod
+
+            layers = params["lora"]["layers"]
+            targets = tuple(sorted({k[:-2] for k in layers}))
+            rank = layers[targets[0] + "_a"].shape[-1]
+            logical = {**logical, "lora": lora_mod.lora_logical_axes(
+                cfg, lora_mod.LoraConfig(rank=int(rank),
+                                         alpha=cfg.lora_alpha,
+                                         targets=targets))}
         shardings = shard_params(params, logical, self.mesh)
         self.params = jax.device_put(params, shardings)
         self._cache_sharding = jax.tree.map(
@@ -507,3 +521,89 @@ def llm_app(preset: str = "debug", *, num_replicas: int = 1,
         max_ongoing_requests=max_ongoing_requests,
     )
     return dep.bind(preset, **engine_kw)
+
+
+class MultiplexedLoraService:
+    """Multi-LoRA serving: one base model, many adapters time-sharing a
+    replica through the multiplex LRU (ref analog: serve's multi-app
+    multiplexing; the LoRA mechanics are repo-native, models/lora.py).
+
+    Each adapter id owns its own LLMEngine whose params are
+    ``{**base, "lora": adapter}`` — the decode scan applies the
+    low-rank delta for real, and the BASE weight arrays are shared
+    across engines (jax arrays are immutable), so an extra resident
+    adapter costs only its A/B matrices + a KV cache. The per-replica
+    adapter cache is the ``@multiplexed`` LRU: the router's affinity
+    keeps a hot adapter's traffic on replicas where it is already
+    resident, so steady state runs load-free (watch
+    rayt_serve_mux_{loads,evictions}_total for thrash).
+
+    ``_load_adapter`` seeds adapters deterministically from the adapter
+    id — the stand-in for fetching trained A/B from storage; override
+    it to load real checkpoints.
+
+    Request payload: {"tokens": [...], "max_new_tokens": int,
+    "temperature": float} with the adapter chosen by the multiplexed
+    model id (HTTP header ``serve_multiplexed_model_id`` /
+    handle.options(multiplexed_model_id=...)); streams
+    {"token": id, "adapter": model_id} dicts.
+    """
+
+    def __init__(self, preset: str = "debug", *,
+                 max_adapters_per_replica: int = 2, lora_rank: int = 4,
+                 seed: int = 0, **engine_kw):
+        self.preset = preset
+        self.engine_kw = dict(engine_kw)
+        self.lora_rank = int(lora_rank)
+        self.cfg = llama.config_for(preset)
+        self._base = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
+        # instance override consumed by the @multiplexed LRU
+        self._rayt_mux_max_models = int(max_adapters_per_replica)
+
+    def _load_adapter(self, model_id: str) -> dict:
+        from ray_tpu.models import lora as lora_mod
+
+        key = jax.random.PRNGKey(
+            int.from_bytes(model_id.encode()[:4].ljust(4, b"\0"), "big"))
+        return lora_mod.init_lora_params(
+            self.cfg, lora_mod.LoraConfig(rank=self.lora_rank,
+                                          alpha=self.cfg.lora_alpha),
+            key)
+
+    @multiplexed(max_num_models_per_replica=2)  # instance attr overrides
+    async def get_engine(self, model_id: str) -> "LLMEngine":
+        params = dict(self._base)
+        if model_id:  # empty id serves the bare base model
+            params["lora"] = self._load_adapter(model_id)
+        return LLMEngine(self.preset, params=params, **self.engine_kw)
+
+    async def __call__(self, payload: dict):
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+
+        model_id = get_multiplexed_model_id()
+        engine = await self.get_engine(model_id)
+        tokens = payload["tokens"]
+        if isinstance(tokens, str):
+            tokens = [b % self.cfg.vocab_size for b in tokens.encode()]
+        async for tok in engine.generate(
+                tokens,
+                max_new_tokens=int(payload.get("max_new_tokens", 8)),
+                temperature=float(payload.get("temperature", 0.0))):
+            yield {"token": int(tok), "adapter": model_id}
+
+
+def lora_llm_app(preset: str = "debug", *, num_replicas: int = 1,
+                 max_ongoing_requests: int = 16,
+                 max_adapters_per_replica: int = 2, **engine_kw):
+    """Serve application for multi-LoRA multiplexed serving; route
+    requests with handle.options(multiplexed_model_id=<adapter>)."""
+    from ray_tpu.serve.deployment import deployment
+
+    dep = deployment(
+        MultiplexedLoraService,
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+    )
+    return dep.bind(preset,
+                    max_adapters_per_replica=max_adapters_per_replica,
+                    **engine_kw)

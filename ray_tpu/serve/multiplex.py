@@ -47,10 +47,25 @@ def _reset_model_id(token):
     _current_model_id.reset(token)
 
 
+def _mux_metric(counter_name: str, loader: str):
+    """Best-effort load/eviction telemetry — cache-thrash visibility for
+    the LoRA-affinity story (an affinity-routed fleet shows loads ~=
+    distinct adapters; load/eviction churn at steady state means hot
+    adapters are bouncing between replicas)."""
+    try:
+        from ray_tpu.util import builtin_metrics as bm
+
+        getattr(bm, counter_name).inc(tags={"loader": loader})
+    except Exception:
+        pass
+
+
 def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
     """Decorate the model loader method; calls are LRU-cached per replica
     (evicted models are simply dropped; define __del__ on the model for
-    custom unload)."""
+    custom unload). An instance may override the cache size by setting
+    ``self._rayt_mux_max_models`` (e.g. from an init arg) before the
+    first load."""
 
     def wrap(loader: Callable) -> Callable:
         cache_attr = f"_rayt_mux_cache_{loader.__name__}"
@@ -61,16 +76,20 @@ def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
                 cache_attr, OrderedDict())
             lock: asyncio.Lock = self.__dict__.setdefault(
                 lock_attr, asyncio.Lock())
+            max_models = int(getattr(self, "_rayt_mux_max_models",
+                                     max_num_models_per_replica))
             async with lock:
                 if model_id in cache:
                     cache.move_to_end(model_id)
                     return cache[model_id]
-                while len(cache) >= max_num_models_per_replica:
+                while len(cache) >= max(1, max_models):
                     cache.popitem(last=False)  # evict LRU
+                    _mux_metric("serve_mux_evictions", loader.__name__)
                 result = loader(self, model_id)
                 if inspect.iscoroutine(result):
                     result = await result
                 cache[model_id] = result
+                _mux_metric("serve_mux_loads", loader.__name__)
                 return result
 
         inner.__name__ = loader.__name__
@@ -84,3 +103,17 @@ def loaded_model_ids(instance, loader_name: str = "get_model") -> list[str]:
     """Model ids currently cached on a replica instance (observability)."""
     cache = instance.__dict__.get(f"_rayt_mux_cache_{loader_name}", {})
     return list(cache)
+
+
+def resident_model_ids(instance) -> list[str]:
+    """Union of model ids across ALL multiplex LRUs on an instance —
+    the replica-side residency view reported through
+    ReplicaActor.get_stats (LoRA hot-adapter observability)."""
+    out: list[str] = []
+    try:
+        for attr, val in instance.__dict__.items():
+            if attr.startswith("_rayt_mux_cache_") and hasattr(val, "keys"):
+                out.extend(str(k) for k in val.keys())
+    except Exception:
+        pass
+    return out

@@ -3,31 +3,78 @@
 
 Routes: POST/GET /<app_name> (body JSON becomes the request payload) →
 app ingress handle → JSON response. Runs as an async actor; blocking
-ObjectRef gets ride the default thread executor so the event loop keeps
-accepting connections.
+ObjectRef gets ride a DEDICATED thread executor (sized by
+``RAYT_SERVE_PROXY_THREADS``) so the event loop keeps accepting — and
+shedding — connections even when every worker thread is parked on a
+result.
+
+Admission control (see serve/admission.py): each request first passes
+the per-app admission window sized from the routing table (replicas x
+max_ongoing_requests x headroom). The capacity read is CACHED (~1s) and
+refreshed off the request path on a small auxiliary executor, so the
+accept/shed decision itself never needs a thread from the (possibly
+saturated) request executor: shed requests answer 503 + ``Retry-After``
+straight from the event loop — no executor thread, no replica traffic —
+keeping a flat, fast rejection path under exactly the overload the
+window exists for. Status mapping: 503 for overload/backpressure/
+timeout (reasons ``shed`` / ``queue_full`` / ``timeout`` /
+``no_replicas`` in the JSON body and the X-Rayt-Reason header), 500
+ONLY for an exception raised by the replica's user code. Streaming
+requests route BEFORE the SSE response is prepared, so an overloaded
+stream sheds with a real 503 too (mid-stream failures degrade to an
+``event: error`` frame — the 200 is already on the wire).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
+import time
 from typing import Any
+
+from ray_tpu.serve.admission import (AdmissionWindow, count_admitted,
+                                     count_shed, is_overload_error,
+                                     request_timeout_s, retry_after_s)
+
+PROXY_THREADS_ENV = "RAYT_SERVE_PROXY_THREADS"
+
+# routing-table capacity cache TTL: admission windows follow replica
+# scaling within this bound without an RPC per request
+CAPACITY_TTL_S = 1.0
 
 
 class ProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 request_timeout_s: float | None = None,
+                 admission_headroom: float | None = None):
         self.host = host
         self.port = port
         self._handles: dict[str, Any] = {}
         self._ingress: dict[str, str] = {}
         self._runner = None
+        self._executor = None       # admitted-request result waits
+        self._aux_executor = None   # capacity refreshes (never starved
+        # by admitted requests parking on results)
+        self._timeout_override = request_timeout_s
+        self._admission = AdmissionWindow(admission_headroom)
+        self._capacity: dict[str, tuple[int, int, float]] = {}
+        self._cap_refreshing: set[str] = set()
 
     async def start(self) -> int:
+        from concurrent.futures import ThreadPoolExecutor
+
         from aiohttp import web
 
+        self._executor = ThreadPoolExecutor(
+            max_workers=int(os.environ.get(PROXY_THREADS_ENV, "128")),
+            thread_name_prefix="serve-proxy")
+        self._aux_executor = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="serve-proxy-cap")
         app = web.Application()
         app.router.add_route("*", "/-/routes", self._routes_endpoint)
         app.router.add_route("*", "/-/healthz", self._healthz)
+        app.router.add_route("*", "/-/admission", self._admission_endpoint)
         app.router.add_route("*", "/{app_name}", self._dispatch)
         app.router.add_route("*", "/{app_name}/{tail:.*}", self._dispatch)
         self._runner = web.AppRunner(app)
@@ -42,11 +89,13 @@ class ProxyActor:
     def register_app(self, app_name: str, ingress_deployment: str) -> bool:
         self._ingress[app_name] = ingress_deployment
         self._handles.pop(app_name, None)
+        self._capacity.pop(app_name, None)
         return True
 
     def unregister_app(self, app_name: str) -> bool:
         self._ingress.pop(app_name, None)
         self._handles.pop(app_name, None)
+        self._capacity.pop(app_name, None)
         return True
 
     async def _healthz(self, request):
@@ -58,6 +107,65 @@ class ProxyActor:
         from aiohttp import web
 
         return web.json_response(dict(self._ingress))
+
+    async def _admission_endpoint(self, request):
+        from aiohttp import web
+
+        return web.json_response(self._admission.snapshot())
+
+    def _request_timeout(self) -> float:
+        if self._timeout_override is not None:
+            return float(self._timeout_override)
+        return request_timeout_s()
+
+    def _unavailable(self, app_name: str, reason: str, detail: str):
+        """503 + Retry-After: overload/backpressure/timeout semantics —
+        the client should back off and retry, nothing is broken."""
+        from aiohttp import web
+
+        retry = retry_after_s()
+        count_shed(app_name, "http", reason)
+        return web.json_response(
+            {"error": detail, "reason": reason, "retry_after_s": retry},
+            status=503,
+            headers={"Retry-After": str(retry),
+                     "X-Rayt-Reason": reason})
+
+    async def _app_capacity(self, app_name: str, handle,
+                            loop) -> tuple[int, int]:
+        """(replicas, max_ongoing) from the ~1s cache. Only the COLD
+        read (first request for an app) waits on an RPC — and on the
+        aux executor, not the request executor, so a saturated proxy
+        still sheds instantly. Stale entries refresh in the background
+        while the current value keeps serving decisions."""
+        cap = self._capacity.get(app_name)
+        now = time.monotonic()
+        if cap is None:
+            try:
+                replicas, max_ongoing = await loop.run_in_executor(
+                    self._aux_executor, handle.capacity)
+            except Exception:
+                replicas, max_ongoing = 1, 16  # table warming up
+            self._capacity[app_name] = (replicas, max_ongoing,
+                                        time.monotonic())
+            return replicas, max_ongoing
+        replicas, max_ongoing, ts = cap
+        if now - ts > CAPACITY_TTL_S and \
+                app_name not in self._cap_refreshing:
+            self._cap_refreshing.add(app_name)
+
+            def _refresh():
+                try:
+                    r, m = handle.capacity()
+                    self._capacity[app_name] = (r, m, time.monotonic())
+                except Exception:
+                    self._capacity[app_name] = (replicas, max_ongoing,
+                                                time.monotonic())
+                finally:
+                    self._cap_refreshing.discard(app_name)
+
+            self._aux_executor.submit(_refresh)
+        return replicas, max_ongoing
 
     async def _dispatch(self, request):
         from aiohttp import web
@@ -86,48 +194,107 @@ class ProxyActor:
         wants_stream = (request.query.get("stream") == "1"
                         or "text/event-stream" in
                         request.headers.get("Accept", ""))
-        # model multiplexing (ref: serve proxy forwards the model-id header)
-        model_id = request.headers.get("serve_multiplexed_model_id", "")
-        if model_id:
-            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
-        if wants_stream:
-            if isinstance(payload, dict):
-                payload.pop("stream", None)
-            resp = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream",
-                         "Cache-Control": "no-cache"})
-            await resp.prepare(request)
-            gen = None
-            try:
-                gen = await loop.run_in_executor(
-                    None, lambda: handle.options(stream=True).remote(payload))
-                async for item in gen:
-                    await resp.write(
-                        f"data: {json.dumps(item, default=str)}\n\n".encode())
-            except (ConnectionResetError, ConnectionError):
-                pass  # client went away; gen.close() stops the replica
-            except Exception as e:
-                try:
-                    await resp.write(
-                        f"event: error\ndata: "
-                        f"{json.dumps(repr(e))}\n\n".encode())
-                except Exception:
-                    pass
-            finally:
-                if gen is not None:
-                    gen.close()
-            try:
-                await resp.write_eof()
-            except Exception:
-                pass
-            return resp
+        # ---- admission: window sized from the (cached) routing-table
+        # capacity; accept/shed is sync + fast on the event loop
+        replicas, max_ongoing = await self._app_capacity(app_name, handle,
+                                                         loop)
+        if not self._admission.try_acquire(app_name, replicas, max_ongoing):
+            return self._unavailable(
+                app_name, "shed",
+                f"admission window full for app {app_name!r} (window="
+                f"{self._admission.window_for(replicas, max_ongoing)})")
+        count_admitted(app_name, "http")
+        # model multiplexing (ref: serve proxy forwards the model-id
+        # header); the router's capacity-gate park is bounded by the
+        # request timeout — a request that can't find a replica slot in
+        # time is SHED (503 queue_full), never left queueing to timeout
+        from ray_tpu.serve.admission import queue_timeout_s
+
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        handle = handle.options(
+            multiplexed_model_id=model_id or None,
+            queue_timeout_s=min(queue_timeout_s(),
+                                self._request_timeout()))
+        try:
+            if wants_stream:
+                return await self._dispatch_stream(request, handle,
+                                                   app_name, payload)
+            return await self._dispatch_unary(handle, app_name, payload,
+                                              loop)
+        finally:
+            self._admission.release(app_name)
+
+    def _error_response(self, app_name: str, e: Exception):
+        """Map a routing/replica failure onto the 503/500 split."""
+        from aiohttp import web
+        from ray_tpu.core.common import GetTimeoutError
+
+        if isinstance(e, GetTimeoutError):
+            return self._unavailable(
+                app_name, "timeout",
+                f"request exceeded {self._request_timeout():.0f}s "
+                "(RAYT_SERVE_REQUEST_TIMEOUT_S)")
+        if is_overload_error(e):
+            return self._unavailable(app_name, "queue_full", repr(e))
+        if isinstance(e, RuntimeError) and "no replicas" in str(e):
+            return self._unavailable(app_name, "no_replicas", repr(e))
+        # a replica-raised user exception: a real 500
+        return web.json_response({"error": repr(e)}, status=500)
+
+    async def _dispatch_unary(self, handle, app_name, payload, loop):
+        from aiohttp import web
+
+        timeout = self._request_timeout()
         try:
             response = await loop.run_in_executor(
-                None, lambda: handle.remote(payload).result(timeout=60))
+                self._executor,
+                lambda: handle.remote(payload).result(timeout=timeout))
         except Exception as e:
-            return web.json_response({"error": repr(e)}, status=500)
+            return self._error_response(app_name, e)
         if isinstance(response, (dict, list, str, int, float, bool,
                                  type(None))):
             return web.json_response({"result": response})
         return web.Response(body=str(response).encode())
+
+    async def _dispatch_stream(self, request, handle, app_name, payload):
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        if isinstance(payload, dict):
+            payload.pop("stream", None)
+        # route BEFORE preparing the SSE response: an overloaded /
+        # replica-less stream must shed with a real 503, not a 200
+        # carrying an error frame
+        try:
+            gen = await loop.run_in_executor(
+                self._executor,
+                lambda: handle.options(stream=True).remote(payload))
+        except Exception as e:
+            return self._error_response(app_name, e)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        try:
+            async for item in gen:
+                await resp.write(
+                    f"data: {json.dumps(item, default=str)}\n\n".encode())
+        except (ConnectionResetError, ConnectionError):
+            pass  # client went away; gen.close() stops the replica
+        except Exception as e:
+            # mid-stream failure: the 200 is already on the wire — an
+            # error frame is the only channel left
+            try:
+                await resp.write(
+                    f"event: error\ndata: "
+                    f"{json.dumps(repr(e))}\n\n".encode())
+            except Exception:
+                pass
+        finally:
+            gen.close()
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp

@@ -28,11 +28,13 @@ class _HandleMarker:
 class ReplicaActor:
     def __init__(self, deployment_name: str, app_name: str,
                  callable_blob: bytes, init_args: tuple, init_kwargs: dict,
-                 user_config: Any = None):
+                 user_config: Any = None, max_ongoing_requests: int = 16):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._ongoing = 0
         self._total = 0
+        self._overloaded_rejects = 0
+        self._max_ongoing = max(1, int(max_ongoing_requests))
         target = cloudpickle.loads(callable_blob)
         args = tuple(self._resolve(a) for a in init_args)
         kwargs = {k: self._resolve(v) for k, v in init_kwargs.items()}
@@ -53,6 +55,20 @@ class ReplicaActor:
             return DeploymentHandle(arg.deployment_name, arg.app_name)
         return arg
 
+    def _check_capacity(self):
+        """Queue-full backpressure (ref analog: replica max_ongoing_requests
+        enforcement): a replica at capacity REFUSES instead of queueing
+        invisibly in the actor scheduler — the router retries another
+        replica or waits for a slot, and the ingress maps an
+        all-saturated timeout to 503, never a 500."""
+        if self._ongoing >= self._max_ongoing:
+            from ray_tpu.serve.admission import ReplicaOverloadedError
+
+            self._overloaded_rejects += 1
+            raise ReplicaOverloadedError(
+                f"replica {self.app_name}/{self.deployment_name} at "
+                f"capacity ({self._ongoing}/{self._max_ongoing} ongoing)")
+
     def _record_request(self, t0: float):
         """QPS + latency telemetry (ref analog: serve's
         serve_deployment_request_counter / processing_latency_ms);
@@ -72,6 +88,7 @@ class ReplicaActor:
                              kwargs: dict, model_id: str = "") -> Any:
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
+        self._check_capacity()
         self._ongoing += 1
         self._total += 1
         t0 = time.perf_counter()
@@ -102,6 +119,7 @@ class ReplicaActor:
         (ref: serve response streaming over ObjectRefGenerator)."""
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
+        self._check_capacity()
         self._ongoing += 1
         self._total += 1
         t0 = time.perf_counter()
@@ -134,7 +152,12 @@ class ReplicaActor:
             self._record_request(t0)
 
     def get_stats(self) -> dict:
-        return {"ongoing": self._ongoing, "total": self._total}
+        from ray_tpu.serve.multiplex import resident_model_ids
+
+        return {"ongoing": self._ongoing, "total": self._total,
+                "max_ongoing": self._max_ongoing,
+                "overloaded_rejects": self._overloaded_rejects,
+                "models": resident_model_ids(self._callable)}
 
     def reconfigure(self, user_config: Any):
         fn = getattr(self._callable, "reconfigure", None)

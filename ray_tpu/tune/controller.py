@@ -61,6 +61,12 @@ class TuneController:
         running: list[Trial] = []
         while pending or running:
             while pending and len(running) < self.max_concurrent:
+                # reap finished trials BEFORE launching: _launch blocks
+                # in WorkerGroup.start, and a launch waiting on
+                # resources held by finished-but-unreaped trials would
+                # stall the loop for the whole 120s setup timeout (then
+                # count as a spurious trial failure)
+                self._reap_finished(running, pending, timeout=0.0)
                 trial = pending.pop(0)
                 try:
                     self._launch(trial)
@@ -78,19 +84,27 @@ class TuneController:
                 running.append(trial)
             if not running:
                 break
-            done_refs, _ = rt.wait([t.run_ref for t in running],
-                                   num_returns=len(running), timeout=0.2)
-            self._drain(running, pending)
-            for trial in list(running):
-                if trial.run_ref in done_refs and trial.status == \
-                        TrialStatus.RUNNING:
-                    self._finish(trial, pending)
-                if trial.status != TrialStatus.RUNNING:
-                    running.remove(trial)
+            self._reap_finished(running, pending, timeout=0.2)
             if self._dirty:
                 self._save_state()
         self._save_state()
         return self.trials
+
+    def _reap_finished(self, running: list[Trial], pending: list[Trial],
+                       *, timeout: float):
+        """Drain reports and finish (stop + release resources of) every
+        trial whose run ref completed."""
+        if not running:
+            return
+        done_refs, _ = rt.wait([t.run_ref for t in running],
+                               num_returns=len(running), timeout=timeout)
+        self._drain(running, pending)
+        for trial in list(running):
+            if trial.run_ref in done_refs and trial.status == \
+                    TrialStatus.RUNNING:
+                self._finish(trial, pending)
+            if trial.status != TrialStatus.RUNNING:
+                running.remove(trial)
 
     # ------------------------------------------------------------ internals
     def _trial_dir(self, trial: Trial) -> str:

@@ -65,7 +65,8 @@ class Tuner:
                 self.param_space, tc.num_samples, tc.seed).variants()
             trials = [Trial(trial_id=f"{i:05d}_{new_trial_id()}", config=v)
                       for i, v in enumerate(variants)]
-        max_concurrent = tc.max_concurrent_trials or min(len(trials), 8) or 1
+        max_concurrent = tc.max_concurrent_trials or min(
+            len(trials), 8, self._capacity_trials()) or 1
         controller = TuneController(
             self.trainable, trials,
             metric=tc.metric, mode=tc.mode, scheduler=tc.scheduler,
@@ -78,6 +79,31 @@ class Tuner:
         controller.run()
         return ResultGrid(trials, metric=tc.metric, mode=tc.mode,
                           experiment_path=experiment_path)
+
+    def _capacity_trials(self) -> int:
+        """How many trials the cluster can PLACE at once. The default
+        concurrency must not exceed this: TuneController._launch blocks
+        inside WorkerGroup.start, so a trial waiting on resources that
+        only finished-but-unreaped trials hold would stall the whole
+        loop for the 120s setup timeout and then count as a trial
+        FAILURE (observed: a 4-CPU cluster with 6 one-CPU trials)."""
+        import ray_tpu as rt
+
+        try:
+            total = rt.cluster_resources()
+        except Exception:
+            return 8  # clusterless/unknown: keep the old default cap
+        if self.scaling_config is not None:
+            per = dict(self.scaling_config.resources_per_worker or {})
+            workers = self.scaling_config.num_workers
+        else:
+            per = dict(self.resources_per_trial or {"CPU": 1})
+            workers = 1
+        fits = []
+        for res, amt in per.items():
+            if amt and amt > 0:
+                fits.append(int(total.get(res, 0.0) // (amt * workers)))
+        return max(1, min(fits)) if fits else 8
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,

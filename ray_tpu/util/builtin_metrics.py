@@ -55,6 +55,34 @@ serve_requests = Counter(
 serve_request_latency = Histogram(
     "rayt_serve_request_latency_s", "Replica request handling latency",
     boundaries=LATENCY_BOUNDS, tag_keys=("app", "deployment"))
+serve_admitted = Counter(
+    "rayt_serve_admitted_total",
+    "Requests admitted through an ingress proxy's admission window",
+    tag_keys=("app", "proxy"))
+serve_shed = Counter(
+    "rayt_serve_shed_total",
+    "Requests shed at an ingress proxy (admission window full, router "
+    "queue timeout, or request timeout) — 503/RESOURCE_EXHAUSTED, "
+    "never a 500", tag_keys=("app", "proxy", "reason"))
+serve_autoscale_decision = Gauge(
+    "rayt_serve_autoscale_decision",
+    "Target replica count the controller's autoscaler decided on its "
+    "last reconcile tick (post-hysteresis)",
+    tag_keys=("app", "deployment"))
+serve_handle_queued = Gauge(
+    "rayt_serve_handle_queued",
+    "Requests parked in a DeploymentHandle's capacity gate (every "
+    "replica at max_ongoing_requests); per-handle series — the "
+    "controller sums them (merge) as the autoscaler's queue-depth "
+    "signal", tag_keys=("app", "deployment", "handle"))
+serve_mux_loads = Counter(
+    "rayt_serve_mux_loads_total",
+    "Multiplex LRU model loads (a cold adapter entering a replica's "
+    "cache)", tag_keys=("loader",))
+serve_mux_evictions = Counter(
+    "rayt_serve_mux_evictions_total",
+    "Multiplex LRU evictions (steady-state growth = hot adapters "
+    "thrashing the per-replica cache)", tag_keys=("loader",))
 
 # ---- train ----
 train_tokens_per_s = Gauge(

@@ -429,6 +429,396 @@ def test_rolling_update_zero_dropped_requests(serve_cluster):
     assert set(results) <= {"v1", "v2"}
 
 
+# ------------------------------------------- serve data plane (ISSUE 10)
+class _FakeActorId:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class _FakeReplica:
+    def __init__(self, h):
+        self._actor_id = _FakeActorId(h)
+
+
+def _route_info(key, version, reps, load=None, max_ongoing=4):
+    return {"update": {"version": version, "table": {key: reps}},
+            "load": load or {}, "max_ongoing": max_ongoing}
+
+
+def test_affinity_survives_refresh_clears_on_removal():
+    """Satellite: model affinity is keyed by actor id — a benign
+    routing-table refresh keeps entries, removing the replica drops
+    exactly its entries."""
+    from ray_tpu.serve.handle import _RouterState
+
+    r1, r2 = _FakeReplica("aa"), _FakeReplica("bb")
+    st = _RouterState("dep", "app")
+    st.apply_route_info(_route_info(st.key, 1, [r1, r2]))
+    with st.lock:
+        _, hx = st._try_pick_locked("m1")
+    assert list(st.model_affinity["m1"]) == [hx]
+    # version-unchanged refresh (update None): affinity survives
+    st.apply_route_info({"update": None, "load": {}, "max_ongoing": 4})
+    assert "m1" in st.model_affinity
+    # version bump, same replicas: affinity survives
+    st.apply_route_info(_route_info(st.key, 2, [r1, r2]))
+    assert list(st.model_affinity["m1"]) == [hx]
+    # the affinity replica is removed: its entry clears
+    keep = r2 if hx == "aa" else r1
+    st.apply_route_info(_route_info(st.key, 3, [keep]))
+    assert "m1" not in st.model_affinity
+    # other models keyed to the surviving replica would have stayed
+    with st.lock:
+        _, hx2 = st._try_pick_locked("m2")
+    assert hx2 == keep._actor_id.hex()
+    st.apply_route_info(_route_info(st.key, 4, [keep]))
+    assert "m2" in st.model_affinity
+
+
+def test_affinity_eviction_is_lru_not_fifo():
+    """Satellite regression: the old dict.pop(next(iter(...))) evicted
+    FIFO; a re-touched hot model must NOT be the eviction victim."""
+    from ray_tpu.serve.handle import _RouterState
+
+    st = _RouterState("dep", "app")
+    st.MAX_MODELS = 2  # instance override shrinks the LRU for the test
+    st.apply_route_info(_route_info(st.key, 1, [_FakeReplica("aa")]))
+    with st.lock:
+        st._try_pick_locked("hot")
+        st._try_pick_locked("cold")
+        st._try_pick_locked("hot")   # re-touch: hot is now most-recent
+        st._try_pick_locked("new")   # evicts ONE entry
+    assert "hot" in st.model_affinity, "LRU evicted the re-touched model"
+    assert "cold" not in st.model_affinity
+    assert "new" in st.model_affinity
+
+
+def test_affinity_spills_on_saturation_and_grows_set():
+    """Tentpole: repeat traffic sticks to the resident replica while it
+    has capacity; a saturated affinity target spills to pow-2 and the
+    spill target joins the model's affinity set."""
+    from ray_tpu.serve.handle import _RouterState
+
+    r1, r2 = _FakeReplica("aa"), _FakeReplica("bb")
+    st = _RouterState("dep", "app")
+    st.apply_route_info(_route_info(st.key, 1, [r1, r2], max_ongoing=2))
+    with st.lock:
+        _, hx = st._try_pick_locked("m1")
+        # sticky while unsaturated, even under some load
+        st.inflight[hx] = 1
+        _, hx_b = st._try_pick_locked("m1")
+        assert hx_b == hx
+        # saturate the affinity target: the pick spills to the OTHER
+        # replica and records it in the affinity set
+        st.inflight[hx] = 2
+        _, hx2 = st._try_pick_locked("m1")
+        assert hx2 != hx
+        assert list(st.model_affinity["m1"]) == [hx, hx2]
+        # both saturated -> no pick (the gate parks the request)
+        st.inflight[hx2] = 2
+        assert st._try_pick_locked("m1") is None
+
+
+def test_multiplex_lru_instance_override_and_residency():
+    """Satellite: @multiplexed cache size can be overridden per
+    instance; resident_model_ids reports the union of mux caches."""
+    import asyncio
+
+    from ray_tpu.serve.multiplex import multiplexed, resident_model_ids
+
+    class Host:
+        def __init__(self):
+            self.loads = []
+            self._rayt_mux_max_models = 1
+
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id):
+            self.loads.append(model_id)
+            return f"m-{model_id}"
+
+    h = Host()
+
+    async def drive():
+        await h.get_model("a")
+        await h.get_model("b")  # override=1: evicts "a"
+
+    asyncio.run(drive())
+    assert h.loads == ["a", "b"]
+    assert resident_model_ids(h) == ["b"]
+
+
+def test_multiplex_affinity_e2e_single_load(serve_cluster):
+    """Hot-adapter affinity on a live 2-replica pool: repeat traffic for
+    one model id stays on the replica that loaded it (one load total,
+    one serving pid)."""
+    import os as _os
+
+    @serve.deployment(num_replicas=2)
+    class ModelHost:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        async def __call__(self, payload):
+            import os
+
+            mid = serve.get_multiplexed_model_id()
+            await self.get_model(mid)
+            return {"pid": os.getpid(), "loads": list(self.loads)}
+
+    h = serve.run(ModelHost.bind(), name="affin")
+    hm = h.options(multiplexed_model_id="hot")
+    results = [hm.remote(i).result(timeout=30) for i in range(6)]
+    pids = {r["pid"] for r in results}
+    assert len(pids) == 1, f"affinity bounced across replicas: {pids}"
+    assert results[-1]["loads"] == ["hot"], results[-1]["loads"]
+
+
+def test_proxy_sheds_with_503_and_retry_after(serve_cluster):
+    """Admission window full -> immediate 503 + Retry-After; admitted
+    requests complete; nothing surfaces as a 500."""
+    import threading
+
+    port = serve.start(http_port=0)
+
+    @serve.deployment(max_ongoing_requests=1)
+    class Slow:
+        async def __call__(self, _):
+            import asyncio
+
+            await asyncio.sleep(1.5)
+            return "ok"
+
+    serve.run(Slow.bind(), name="shed")
+    statuses, retry_after = [], []
+
+    def fire():
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/shed",
+                                     data=b"{}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                statuses.append(resp.status)
+        except urllib.error.HTTPError as e:
+            statuses.append(e.code)
+            if e.code == 503:
+                retry_after.append(e.headers.get("Retry-After"))
+
+    threads = [threading.Thread(target=fire) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # window = 1 replica x 1 max_ongoing x 2.0 headroom = 2 admitted
+    assert statuses.count(200) == 2, statuses
+    assert statuses.count(503) == 4, statuses
+    assert all(r is not None and int(r) >= 1 for r in retry_after)
+    assert 500 not in statuses
+    # the admission snapshot surfaces the accounting
+    snap = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/-/admission", timeout=10).read())
+    assert snap["shed"]["shed_total"] == 4
+    assert snap["shed"]["admitted_total"] == 2
+
+
+def test_proxy_timeout_is_503_and_app_error_is_500(serve_cluster):
+    """Satellite: configurable request timeout maps to 503 (overload
+    semantics), replica user-code exceptions keep the 500."""
+    import urllib.error
+
+    port = serve.start(http_port=0, request_timeout_s=0.5)
+
+    @serve.deployment
+    class App:
+        async def __call__(self, payload):
+            import asyncio
+
+            if payload.get("boom"):
+                raise ValueError("user bug")
+            await asyncio.sleep(2.0)
+            return "late"
+
+    serve.run(App.bind(), name="tmo")
+
+    def code_of(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/tmo", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    code, headers = code_of({})
+    assert code == 503
+    assert headers.get("X-Rayt-Reason") == "timeout"
+    assert headers.get("Retry-After") is not None
+    code, headers = code_of({"boom": 1})
+    assert code == 500
+
+
+def test_proxy_stream_overload_is_real_503(serve_cluster):
+    """A stream that can't route (all replicas saturated past the queue
+    timeout) sheds with a REAL 503 before any SSE bytes — not a 200
+    carrying an error frame."""
+    import threading
+    import urllib.error
+
+    port = serve.start(http_port=0, request_timeout_s=0.8)
+
+    @serve.deployment(max_ongoing_requests=1)
+    class S:
+        async def __call__(self, payload):
+            import asyncio
+
+            await asyncio.sleep(float(payload.get("t", 0)))
+            yield {"done": True}
+
+    serve.run(S.bind(), name="sshed")
+
+    def long_stream():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/sshed?stream=1&t=2.0", method="GET")
+        try:
+            urllib.request.urlopen(req, timeout=30).read()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=long_stream)
+    t.start()
+    time.sleep(0.4)  # the long stream holds the only replica slot
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/sshed?stream=1&t=0", method="GET")
+    try:
+        resp = urllib.request.urlopen(req, timeout=30)
+        raise AssertionError(
+            f"expected 503, got {resp.status}: {resp.read()[:80]}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert e.headers.get("X-Rayt-Reason") == "queue_full"
+        assert e.headers.get("Retry-After") is not None
+    t.join(timeout=30)
+
+
+def test_handle_capacity_gate_queues_then_overloads(serve_cluster):
+    """Backpressure at the router: beyond-capacity requests park in the
+    handle's capacity gate (all succeed, bounded concurrency); with a
+    zero queue timeout the park surfaces as ReplicaOverloadedError."""
+    import threading
+
+    @serve.deployment(max_ongoing_requests=2)
+    class Slow:
+        async def __call__(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return "ok"
+
+    h = serve.run(Slow.bind(), name="gate")
+    results, errors = [], []
+
+    def fire():
+        try:
+            results.append(h.remote(0.4).result(timeout=30))
+        except Exception as e:
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=fire) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert results == ["ok"] * 5 and not errors, (results, errors)
+
+    # saturate, then a zero-queue-timeout clone must fail FAST with the
+    # overload error instead of queueing
+    pending = [h.remote(1.5) for _ in range(2)]
+    time.sleep(0.3)
+    h0 = h.options(queue_timeout_s=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(serve.ReplicaOverloadedError):
+        h0.remote(0.1)
+    assert time.monotonic() - t0 < 2.0
+    assert all(p.result(timeout=30) == "ok" for p in pending)
+
+
+def test_replica_side_queue_full_is_overload_not_500(serve_cluster):
+    """A request reaching a replica at max_ongoing_requests raises
+    ReplicaOverloadedError (backpressure), which is_overload_error
+    recognizes through the TaskError wrapper."""
+    from ray_tpu.serve.admission import is_overload_error
+
+    @serve.deployment(max_ongoing_requests=1)
+    class Slow:
+        async def __call__(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return "ok"
+
+    h = serve.run(Slow.bind(), name="rqf")
+    pending = h.remote(1.5)
+    time.sleep(0.3)
+    h._refresh(force=True)
+    replica = h._replicas[0]
+    try:
+        rt.get(replica.handle_request.remote("__call__", (0.1,), {}, ""))
+        raise AssertionError("expected replica-side overload")
+    except Exception as e:
+        assert is_overload_error(e), repr(e)
+    assert pending.result(timeout=30) == "ok"
+
+
+def test_grpc_overload_is_resource_exhausted(serve_cluster):
+    """gRPC mirror of the shed path: admission window full aborts with
+    RESOURCE_EXHAUSTED, not INTERNAL."""
+    import threading
+
+    import grpc
+
+    port = serve.start_grpc(grpc_port=0)
+
+    @serve.deployment(max_ongoing_requests=1)
+    class Slow:
+        async def __call__(self, _):
+            import asyncio
+
+            await asyncio.sleep(1.5)
+            return "ok"
+
+    serve.run(Slow.bind(), name="gshed")
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = chan.unary_unary(
+        "/rayt.serve.Serve/Predict",
+        request_serializer=lambda b: b, response_deserializer=lambda b: b)
+    codes = []
+
+    def fire():
+        try:
+            predict(json.dumps({"app": "gshed", "payload": 1}).encode(),
+                    timeout=30)
+            codes.append("OK")
+        except grpc.RpcError as e:
+            codes.append(e.code())
+
+    threads = [threading.Thread(target=fire) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert codes.count("OK") == 2, codes  # window = 1 x 1 x 2.0
+    assert codes.count(grpc.StatusCode.RESOURCE_EXHAUSTED) == 4, codes
+    assert grpc.StatusCode.INTERNAL not in codes
+    chan.close()
+
+
 def test_replica_health_probe_replaces_unhealthy(serve_cluster):
     """A replica whose check_health starts failing is killed and replaced
     by the reconcile loop; requests keep succeeding (ref:

@@ -1,0 +1,187 @@
+"""Autoscaling-reconcile tests (ISSUE 10: metrics-driven replica
+autoscaling): decision-level units against ServeController's
+_target_replicas (synthetic load, no cluster) plus an E2E scale-up /
+drain-and-scale-down pass on a live cluster.
+
+Ref analogs: python/ray/serve/tests/test_autoscaling_policy.py and
+autoscaling_state.py decision windows.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import AutoscalingConfig
+
+KEY = ("app", "dep")
+
+
+def _spec(**auto_kw):
+    auto = AutoscalingConfig(**auto_kw)
+    return {"name": "dep", "num_replicas": 1, "autoscaling_config": auto,
+            "max_ongoing_requests": 16}
+
+
+def _target(c, spec, live, stats):
+    return asyncio.run(c._target_replicas(KEY, spec, live, stats))
+
+
+@pytest.fixture
+def controller(monkeypatch):
+    c = ServeController()
+    # no cluster: the metrics store is unreachable; default to "no
+    # metric signals" unless a test patches real values in
+    monkeypatch.setattr(
+        c, "_metrics_signals",
+        lambda key, w: {"qps": None, "p99_latency_s": None,
+                        "queued": None})
+    return c
+
+
+def test_scale_up_under_load_respects_max(controller):
+    spec = _spec(min_replicas=1, max_replicas=3,
+                 target_ongoing_requests=1.0, upscale_delay_s=0.1,
+                 downscale_delay_s=5.0)
+    # 5 ongoing on 1 replica: desired ceil(5/1)=5 -> clamped to max 3,
+    # but the upscale delay holds the first decision at live
+    assert _target(controller, spec, 1, [5.0]) == 1
+    time.sleep(0.15)
+    assert _target(controller, spec, 1, [5.0]) == 3
+
+
+def test_scale_down_to_min_after_down_delay(controller):
+    spec = _spec(min_replicas=1, max_replicas=4,
+                 target_ongoing_requests=1.0, upscale_delay_s=5.0,
+                 downscale_delay_s=0.2)
+    assert _target(controller, spec, 3, [0.0, 0.0, 0.0]) == 3  # marked
+    time.sleep(0.25)
+    assert _target(controller, spec, 3, [0.0, 0.0, 0.0]) == 1
+
+
+def test_no_flapping_within_hysteresis_window(controller):
+    spec = _spec(min_replicas=1, max_replicas=4,
+                 target_ongoing_requests=1.0, upscale_delay_s=10.0,
+                 downscale_delay_s=10.0)
+    # oscillating demand inside the window never moves the target
+    for stats in ([6.0], [0.0], [6.0], [0.0]):
+        assert _target(controller, spec, 2, [s / 2 for s in stats] * 2) == 2
+    # and a direction flip resets the opposite mark: the up-mark set by
+    # high load must not survive a low-load tick
+    _target(controller, spec, 2, [8.0, 8.0])
+    assert (KEY, "up") in controller._scale_marks
+    _target(controller, spec, 2, [0.0, 0.0])
+    assert (KEY, "up") not in controller._scale_marks
+    assert (KEY, "down") in controller._scale_marks
+
+
+def test_qps_signal_drives_scale_up(controller, monkeypatch):
+    spec = _spec(min_replicas=1, max_replicas=8,
+                 target_ongoing_requests=100.0,  # ongoing signal quiet
+                 target_qps_per_replica=10.0,
+                 upscale_delay_s=0.0, downscale_delay_s=5.0)
+    monkeypatch.setattr(
+        controller, "_metrics_signals",
+        lambda key, w: {"qps": 35.0, "p99_latency_s": None,
+                        "queued": None})
+    assert _target(controller, spec, 1, [1.0]) == 4  # ceil(35/10)
+
+
+def test_queue_depth_folds_into_ongoing_signal(controller, monkeypatch):
+    spec = _spec(min_replicas=1, max_replicas=8,
+                 target_ongoing_requests=2.0,
+                 upscale_delay_s=0.0, downscale_delay_s=5.0)
+    monkeypatch.setattr(
+        controller, "_metrics_signals",
+        lambda key, w: {"qps": None, "p99_latency_s": None,
+                        "queued": 6.0})
+    # (2 ongoing + 6 parked in handle gates) / 2 per replica = 4
+    assert _target(controller, spec, 1, [2.0]) == 4
+
+
+def test_latency_signal_adds_one_replica(controller, monkeypatch):
+    spec = _spec(min_replicas=1, max_replicas=8,
+                 target_ongoing_requests=100.0,
+                 latency_target_s=0.5,
+                 upscale_delay_s=0.0, downscale_delay_s=5.0)
+    monkeypatch.setattr(
+        controller, "_metrics_signals",
+        lambda key, w: {"qps": None, "p99_latency_s": 2.0,
+                        "queued": None})
+    assert _target(controller, spec, 2, [1.0, 1.0]) == 3
+
+
+def test_decision_recorded_for_introspection(controller):
+    spec = _spec(min_replicas=1, max_replicas=3,
+                 target_ongoing_requests=1.0, upscale_delay_s=0.0,
+                 downscale_delay_s=5.0)
+    assert _target(controller, spec, 1, [4.0]) == 3
+    st = controller.get_autoscale_status()["app/dep"]
+    assert st["target"] == 3 and st["desired"] == 3 and st["live"] == 1
+    assert "signals" in st
+
+
+def test_bytes_pickled_autoscaling_config_still_decodes(controller):
+    import cloudpickle
+
+    spec = _spec(min_replicas=2, max_replicas=4)
+    spec["autoscaling_config"] = cloudpickle.dumps(
+        spec["autoscaling_config"])
+    assert _target(controller, spec, 2, [0.0, 0.0]) == 2
+
+
+# --------------------------------------------------------------------- E2E
+@pytest.fixture
+def serve_cluster(local_cluster):
+    yield local_cluster
+    serve.shutdown()
+
+
+def test_autoscale_up_then_drain_down_e2e(serve_cluster):
+    """Burst -> replicas scale past min; drain -> back to min after the
+    down delay (the closed loop end to end on live stats)."""
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1, "upscale_delay_s": 0.3,
+        "downscale_delay_s": 1.0})
+    class Slow:
+        async def __call__(self, _):
+            import asyncio
+
+            await asyncio.sleep(1.5)
+            return "done"
+
+    handle = serve.run(Slow.bind(), name="asdrain")
+    controller = serve._controller(create=False)
+
+    responses = [handle.remote(None) for _ in range(8)]
+    deadline = time.monotonic() + 30
+    peak = 1
+    while time.monotonic() < deadline:
+        deps = rt.get(controller.get_deployments.remote("asdrain"),
+                      timeout=10)
+        peak = max(peak, deps[0]["num_replicas"])
+        if peak >= 2:
+            break
+        time.sleep(0.3)
+    assert peak >= 2, "autoscaler never scaled up under the burst"
+    for r in responses:
+        assert r.result(timeout=60) == "done"
+    # drain: ongoing drops to 0 -> desired=min; after downscale_delay_s
+    # the controller retires the extras
+    deadline = time.monotonic() + 30
+    final = peak
+    while time.monotonic() < deadline:
+        deps = rt.get(controller.get_deployments.remote("asdrain"),
+                      timeout=10)
+        final = deps[0]["num_replicas"]
+        if final == 1:
+            break
+        time.sleep(0.5)
+    assert final == 1, f"never drained back to min (stuck at {final})"
+    st = rt.get(controller.get_autoscale_status.remote(), timeout=10)
+    assert st["asdrain/Slow"]["target"] == 1

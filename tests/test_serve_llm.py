@@ -125,6 +125,71 @@ def test_llm_serve_app_streams_tokens(local_cluster):
         serve.shutdown()
 
 
+@pytest.mark.slow  # 3 engine builds (~35s of traces); tier-1 keeps the
+# base-decode parity test, the LoRA-specific path gates in the slow lane
+def test_engine_applies_lora_adapter():
+    """An engine whose params carry a "lora" subtree shards and applies
+    the adapter for real: a zero-init adapter (B=0) matches the base
+    decode bit-for-bit, a nonzero adapter changes the stream."""
+    from ray_tpu.models import lora as lora_mod
+
+    base_eng = LLMEngine("debug", tp=2, max_batch=2, seed=0)
+    base = jax.device_get(base_eng.params)
+    cfg = base_eng.cfg
+    adapter = lora_mod.init_lora_params(
+        cfg, lora_mod.LoraConfig(rank=4, alpha=cfg.lora_alpha),
+        jax.random.PRNGKey(7))
+    eng = LLMEngine("debug", tp=2, max_batch=2,
+                    params={**base, "lora": adapter}, seed=0)
+    prompt = [5, 9, 11, 42, 7]
+    want = _collect(base_eng, prompt, max_new_tokens=8)
+    got = _collect(eng, prompt, max_new_tokens=8)
+    assert got == want  # B=0: adapter is an exact no-op
+    # a trained (nonzero-B) adapter must change the decode
+    adapter2 = jax.tree.map(
+        lambda a: a + 0.5 if a.ndim and a.shape[-1] != 4 else a, adapter)
+    eng2 = LLMEngine("debug", tp=2, max_batch=2,
+                     params={**base, "lora": adapter2}, seed=0)
+    assert _collect(eng2, prompt, max_new_tokens=8) != want
+
+
+@pytest.mark.slow  # cluster + three per-adapter engine builds
+def test_multiplexed_lora_service_e2e(local_cluster):
+    """lora_llm_app: adapters route by multiplexed model id, stream
+    adapter-tagged tokens, and the per-replica LRU bounds residents
+    (third adapter evicts the LRU one)."""
+    try:
+        from ray_tpu.serve.llm import lora_llm_app
+
+        app = lora_llm_app("debug", tp=2, max_batch=2,
+                           max_adapters_per_replica=2)
+        h = serve.run(app, name="lora")
+
+        def gen(adapter):
+            return list(h.options(
+                multiplexed_model_id=adapter, stream=True).remote(
+                {"tokens": [4, 8, 15], "max_new_tokens": 4}))
+
+        a = gen("ad-a")
+        assert len(a) == 4 and all(d["adapter"] == "ad-a" for d in a)
+        b = gen("ad-b")
+        assert len(b) == 4 and all(d["adapter"] == "ad-b" for d in b)
+        # different adapters may produce different streams; repeat
+        # traffic for one adapter is deterministic (cached engine)
+        assert gen("ad-a") == a
+        # residency reported through replica stats; 2-adapter LRU means
+        # a third adapter evicts one
+        h._refresh(force=True)
+        replica = h._replicas[0]
+        models = rt.get(replica.get_stats.remote(), timeout=30)["models"]
+        assert sorted(models) == ["ad-a", "ad-b"]
+        gen("ad-c")
+        models = rt.get(replica.get_stats.remote(), timeout=30)["models"]
+        assert len(models) == 2 and "ad-c" in models
+    finally:
+        serve.shutdown()
+
+
 def test_chunked_prefill_interleaves_with_decode():
     """A long-prompt admission must not stall active decode streams for
     the whole prompt: prefill advances one CHUNK per engine round, with

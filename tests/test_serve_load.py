@@ -1,0 +1,84 @@
+"""Sustained-load serve data-plane floor gate (slow-marked so tier-1
+stays fast; ISSUE 10 acceptance leg).
+
+Runs the serve_bench ``sustained`` leg — open-loop arrival through the
+HTTP ingress with a >=30s steady state and a burst at ~2x min-replica
+capacity — and floors:
+
+* max-QPS: admitted throughput at steady state and under the burst,
+* admitted-request p99 latency in both phases,
+* shed behavior: the burst MUST shed (503 + Retry-After), MUST NOT
+  time out an admitted request, and MUST NOT 500,
+* the closed loop E2E: the autoscaler scales replicas up under the
+  burst and back to min after the drain,
+* Prometheus counters: rayt_serve_{shed,admitted}_total and the
+  autoscale decision gauge are emitting cluster-wide.
+
+CLI twin refreshing SERVE_BENCH.json:
+``python tools/serve_bench.py --leg sustained``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+# committed SERVE_BENCH.json sustained_load leg on this class of box:
+# steady 13.4 qps / p99 ~160ms, burst 44 qps admitted / p99 ~1.4s with
+# shed_rate ~0.17 and peak_replicas 3. Floors sit 2-4x below committed,
+# clearing loaded-suite noise while still failing a reintroduced
+# unbounded-queueing or broken-autoscaler regression by an order of
+# magnitude.
+STEADY_QPS_FLOOR = 8.0
+STEADY_P99_MS_CEIL = 1500.0
+BURST_QPS_FLOOR = 20.0
+BURST_P99_MS_CEIL = 4000.0
+BURST_SHED_RATE_CEIL = 0.9
+
+
+def test_sustained_load_floors_and_closed_loop():
+    signal.alarm(600)  # tier-1 SIGALRM budget is sized for fast tests
+    from serve_bench import run_sustained
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=4)
+    try:
+        res = run_sustained(steady_s=30.0, burst_s=10.0)
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+
+    steady, burst, drain = res["steady"], res["burst"], res["drain"]
+    # steady state: everything admitted, latency flat
+    assert steady["achieved_qps"] >= STEADY_QPS_FLOOR, steady
+    assert steady["timeouts"] == 0 and steady["errors"] == 0, steady
+    assert steady["latency_p99_ms"] <= STEADY_P99_MS_CEIL, steady
+
+    # burst at 2x min-capacity: excess SHEDS, admitted requests never
+    # time out, nothing turns into a 500/transport error
+    assert burst["shed"] > 0, burst
+    assert burst["shed_rate"] <= BURST_SHED_RATE_CEIL, burst
+    assert burst["timeouts"] == 0, burst
+    assert burst["errors"] == 0, burst
+    assert burst["achieved_qps"] >= BURST_QPS_FLOOR, burst
+    assert burst["latency_p99_ms"] <= BURST_P99_MS_CEIL, burst
+
+    # the closed loop E2E: scale-up under the burst, back to min after
+    assert burst["peak_replicas"] >= 2, burst
+    assert drain["final_replicas"] == 1, drain
+
+    # Prometheus family emitted cluster-wide (GCS metrics store)
+    metrics = res["metrics"]
+    assert metrics.get("rayt_serve_shed_total", 0) > 0, metrics
+    assert metrics.get("rayt_serve_admitted_total", 0) > 0, metrics
+    assert "rayt_serve_autoscale_decision" in metrics, metrics

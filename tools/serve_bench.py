@@ -1,22 +1,33 @@
-"""Serve-LLM engine benchmark (BASELINE config #5 artifact).
+"""Serve benchmarks (BASELINE config #5 artifact + the ISSUE-10
+sustained-load data-plane leg).
 
-Drives `ray_tpu.serve.llm.LLMEngine` directly (in-process, no HTTP hop)
-with N concurrent closed-loop streams and reports:
+Leg ``engine`` drives `ray_tpu.serve.llm.LLMEngine` directly
+(in-process, no HTTP hop) with N concurrent closed-loop streams and
+reports generated tokens/s, TTFT p50/p99, inter-token latency p50/p99,
+and late-join TTFT (the continuous-batching headline).
 
-  - generated tokens/s (aggregate decode throughput)
-  - TTFT p50/p99 (request submit -> first token)
-  - inter-token latency p50/p99
-  - late-join latency: a request injected while the batch is saturated,
-    measured submit -> first token (the continuous-batching headline)
+Leg ``sustained`` exercises the FULL serve data plane end to end:
+cluster + controller + autoscaled replicas + HTTP ingress proxy, driven
+OPEN-LOOP (arrivals fire on a fixed schedule regardless of completions
+— the only honest way to measure an admission-controlled system):
+
+  1. steady state (>=30s) below capacity — p50/p99 admitted latency and
+     achieved QPS,
+  2. a burst at ~2x min-replica capacity — excess requests must SHED
+     with 503 (zero admitted-request timeouts) while the autoscaler
+     scales replicas up,
+  3. drain — replicas must return to min_replicas.
 
 Ref analog: release/benchmarks/README.md throughput/latency tables +
 serve benchmarks in release/serve_tests; the engine design itself is
 TPU-native (static slots, per-row KV depths) with no reference
 equivalent.
 
-Writes SERVE_BENCH.json at the repo root. Platform: runs on whatever
-backend jax resolves (the tunneled TPU when up, else host CPU with
-"platform" recorded so the judge can tell the legs apart).
+Writes SERVE_BENCH.json at the repo root ({"engine": ..,
+"sustained_load": ..}; --leg selects, existing legs are preserved on a
+partial refresh). Platform: runs on whatever backend jax resolves (the
+tunneled TPU when up, else host CPU with "platform" recorded so the
+judge can tell the legs apart).
 """
 
 from __future__ import annotations
@@ -126,28 +137,247 @@ async def _run_bench(preset: str, concurrency: int, requests: int,
     }
 
 
+# --------------------------------------------------------- sustained leg
+def run_sustained(*, service_time_s: float = 0.15, max_ongoing: int = 4,
+                  min_replicas: int = 1, max_replicas: int = 3,
+                  steady_s: float = 30.0, burst_s: float = 10.0,
+                  steady_util: float = 0.5, burst_factor: float = 2.0,
+                  request_timeout_s: float = 5.0,
+                  drain_wait_s: float = 20.0,
+                  app_name: str = "sustained") -> dict:
+    """Sustained-load serve data-plane leg (call inside a started
+    cluster; deploys its own app + HTTP proxy and deletes the app when
+    done). Returns the result dict (see module docstring)."""
+    import asyncio as aio
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    port = serve.start(http_port=0, request_timeout_s=request_timeout_s)
+
+    @serve.deployment(max_ongoing_requests=max_ongoing,
+                      autoscaling_config={
+                          "min_replicas": min_replicas,
+                          "max_replicas": max_replicas,
+                          "target_ongoing_requests":
+                              max(1, int(max_ongoing * 0.75)),
+                          "upscale_delay_s": 0.5,
+                          "downscale_delay_s": 2.0})
+    class SustainedTarget:
+        async def __call__(self, payload):
+            import asyncio
+
+            await asyncio.sleep(service_time_s)
+            return "ok"
+
+    serve.run(SustainedTarget.bind(), name=app_name)
+    controller = serve._controller(create=False)
+    url = f"http://127.0.0.1:{port}/{app_name}"
+
+    capacity_at_min = min_replicas * max_ongoing / service_time_s
+    steady_rate = steady_util * capacity_at_min
+    burst_rate = burst_factor * capacity_at_min
+
+    replica_samples: list[int] = []
+
+    async def _sample_replicas(stop: "aio.Event"):
+        loop = aio.get_running_loop()
+        while not stop.is_set():
+            try:
+                deps = await loop.run_in_executor(
+                    None, lambda: rt.get(
+                        controller.get_deployments.remote(app_name),
+                        timeout=10))
+                replica_samples.append(deps[0]["num_replicas"])
+            except Exception:
+                pass
+            try:
+                await aio.wait_for(stop.wait(), 0.5)
+            except aio.TimeoutError:
+                pass
+
+    async def _drive(session, rate: float, duration: float) -> list:
+        """Open-loop: one request per 1/rate seconds on the wall clock,
+        never gated on completions."""
+        loop = aio.get_running_loop()
+        results: list = []
+
+        async def one():
+            t0 = time.perf_counter()
+            try:
+                async with session.post(url, json={}) as resp:
+                    await resp.read()
+                    results.append((resp.status,
+                                    time.perf_counter() - t0,
+                                    resp.headers.get("X-Rayt-Reason", "")))
+            except Exception as e:
+                results.append((-1, time.perf_counter() - t0, repr(e)))
+
+        interval = 1.0 / rate
+        t_end = loop.time() + duration
+        next_t = loop.time()
+        tasks = []
+        while loop.time() < t_end:
+            tasks.append(aio.ensure_future(one()))
+            next_t += interval
+            delay = next_t - loop.time()
+            if delay > 0:
+                await aio.sleep(delay)
+        await aio.gather(*tasks)
+        return results
+
+    def _phase_stats(results: list, duration: float) -> dict:
+        admitted = [r for r in results if r[0] == 200]
+        shed = [r for r in results if r[0] == 503
+                and r[2] in ("shed", "queue_full", "no_replicas")]
+        timeouts = [r for r in results if r[0] == 503
+                    and r[2] == "timeout"]
+        errors = [r for r in results
+                  if r[0] not in (200, 503)]
+        lats = sorted(r[1] for r in admitted)
+        total = max(1, len(results))
+        return {
+            "offered": len(results),
+            "admitted": len(admitted),
+            "achieved_qps": round(len(admitted) / duration, 1),
+            "shed": len(shed),
+            "shed_rate": round(len(shed) / total, 3),
+            "timeouts": len(timeouts),
+            "errors": len(errors),
+            "latency_p50_ms": round(1e3 * _pct(lats, 50), 1) if lats
+            else None,
+            "latency_p99_ms": round(1e3 * _pct(lats, 99), 1) if lats
+            else None,
+        }
+
+    async def _run() -> dict:
+        import aiohttp
+
+        stop = aio.Event()
+        sampler = aio.ensure_future(_sample_replicas(stop))
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            steady = await _drive(session, steady_rate, steady_s)
+            burst_start = len(replica_samples)
+            burst = await _drive(session, burst_rate, burst_s)
+            peak = max(replica_samples[burst_start:] or [min_replicas])
+            # drain: no traffic; wait for scale-down to min
+            t0 = time.perf_counter()
+            final = peak
+            while time.perf_counter() - t0 < drain_wait_s:
+                deps = await aio.get_running_loop().run_in_executor(
+                    None, lambda: rt.get(
+                        controller.get_deployments.remote(app_name),
+                        timeout=10))
+                final = deps[0]["num_replicas"]
+                if final <= min_replicas:
+                    break
+                await aio.sleep(0.5)
+            drain_s = time.perf_counter() - t0
+        stop.set()
+        await sampler
+        return {
+            "metric": "serve_sustained_load",
+            "config": {
+                "service_time_s": service_time_s,
+                "max_ongoing_requests": max_ongoing,
+                "min_replicas": min_replicas,
+                "max_replicas": max_replicas,
+                "steady_rate_qps": round(steady_rate, 1),
+                "burst_rate_qps": round(burst_rate, 1),
+                "steady_s": steady_s, "burst_s": burst_s,
+                "request_timeout_s": request_timeout_s,
+            },
+            "steady": _phase_stats(steady, steady_s),
+            "burst": {**_phase_stats(burst, burst_s),
+                      "peak_replicas": peak},
+            "drain": {"final_replicas": final,
+                      "seconds": round(drain_s, 1)},
+            "metrics": _serve_metric_totals(),
+        }
+
+    try:
+        return asyncio.run(_run())
+    finally:
+        try:
+            serve.delete(app_name)
+        except Exception:
+            pass
+
+
+def _serve_metric_totals() -> dict:
+    """Cluster-wide serve counters from the GCS metrics store (proves
+    the Prometheus family is emitting: rayt_serve_{shed,admitted}_total
+    + the autoscale decision gauge)."""
+    out: dict = {}
+    try:
+        from ray_tpu.core.object_ref import get_core_worker
+
+        cw = get_core_worker()
+        snap = cw.io.run(cw.gcs.conn.call("metrics_snapshot"))
+        for rec in snap:
+            name = rec.get("name", "")
+            if name in ("rayt_serve_shed_total",
+                        "rayt_serve_admitted_total"):
+                out[name] = out.get(name, 0.0) + float(
+                    rec.get("value", 0.0))
+            elif name == "rayt_serve_autoscale_decision":
+                out[name] = float(rec.get("value", 0.0))
+    except Exception:
+        pass
+    return out
+
+
+def _load_existing(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except Exception:
+        return {}
+    if "metric" in data:  # pre-ISSUE-10 single-leg layout
+        return {"engine": data}
+    return data if isinstance(data, dict) else {}
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser()
+    ap.add_argument("--leg", choices=("engine", "sustained", "all"),
+                    default="all")
     ap.add_argument("--preset", default="debug")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--steady-s", type=float, default=30.0)
+    ap.add_argument("--burst-s", type=float, default=10.0)
     ap.add_argument("--out", default=os.path.join(ROOT, "SERVE_BENCH.json"))
     ap.add_argument("--no-write", action="store_true")
     args = ap.parse_args()
 
-    result = asyncio.run(_run_bench(
-        args.preset, args.concurrency, args.requests, args.max_new,
-        args.prompt_len))
-    result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                          time.gmtime())
-    print(json.dumps(result))
+    out = _load_existing(args.out)
+    if args.leg in ("engine", "all"):
+        out["engine"] = asyncio.run(_run_bench(
+            args.preset, args.concurrency, args.requests, args.max_new,
+            args.prompt_len))
+    if args.leg in ("sustained", "all"):
+        import ray_tpu as rt
+        from ray_tpu import serve
+
+        rt.init(num_cpus=4)
+        try:
+            out["sustained_load"] = run_sustained(
+                steady_s=args.steady_s, burst_s=args.burst_s)
+        finally:
+            serve.shutdown()
+            rt.shutdown()
+    out["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    print(json.dumps(out, indent=1))
     if not args.no_write:
         with open(args.out, "w") as f:
-            json.dump(result, f, indent=1)
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":
