@@ -172,6 +172,18 @@ class Config:
     # job holding the most records evicts oldest-first with per-job
     # dropped accounting (same contract as task/object managers).
     dag_state_max_dags: int = 500
+    # ---- scheduling-plane observability (cluster events + traces) ----
+    # Gates the cluster event log AND the lease decision tracer: node
+    # managers record per-demand-shape request_lease verdicts and emit
+    # structured events (worker crash/OOM-reap, node/actor lifecycle,
+    # autoscaler decisions, DAG stalls) onto the `cluster_events`
+    # channel; the GCS event manager stores + serves them. Disabling
+    # removes the per-decision recording cost and all report traffic.
+    cluster_events_enabled: bool = True
+    # GCS event-manager memory bound: max events kept; beyond it the
+    # job holding the most events evicts oldest-first with per-job
+    # dropped accounting (same contract as the task/object/DAG stores).
+    cluster_events_max: int = 10000
 
     # ---- logging ----
     log_level: str = "INFO"

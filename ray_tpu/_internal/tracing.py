@@ -53,10 +53,14 @@ def make_transition(*, task_id: str, name: str, kind: str, state: str,
                     job_id: str = "", actor_id: str = "", attempt: int = 0,
                     worker: str = "", node: str = "",
                     error: dict | None = None,
+                    resources: dict | None = None,
                     ts: float | None = None) -> dict:
     """The one wire schema for a lifecycle transition event — every
     emitter (worker buffer, node manager, GCS-side actor-creation flow)
-    builds events here so the coalescer never sees divergent shapes."""
+    builds events here so the coalescer never sees divergent shapes.
+    ``resources`` (the demand shape, carried on the submit-side
+    PENDING_ARGS) is the join key `rayt why-pending` uses against the
+    scheduling decision traces."""
     ev = {
         "type": "transition", "task_id": task_id, "name": name,
         "kind": kind, "state": state, "job_id": job_id,
@@ -66,6 +70,8 @@ def make_transition(*, task_id: str, name: str, kind: str, state: str,
     }
     if error is not None:
         ev["error"] = error
+    if resources is not None:
+        ev["resources"] = resources
     return ev
 
 
@@ -94,17 +100,19 @@ class TaskEventBuffer:
     def record_transition(self, *, task_id: str, name: str, kind: str,
                           state: str, job_id: str = "", actor_id: str = "",
                           attempt: int = 0, error: dict | None = None,
+                          resources: dict | None = None,
                           ts: float | None = None):
         """One lifecycle state transition (ref: TaskEventBuffer::
         RecordTaskStatusEvent). Near-free when task events are disabled —
         the hot submit path pays one attribute check. Enabled, it
         appends a COMPACT tuple; the wire dict materializes at drain
         time (the 1s flush), keeping the per-submit cost to a deque
-        append."""
+        append (``resources`` rides as a dict REFERENCE, not a copy)."""
         if not self.enabled:
             return
         self._append(("t", task_id, name, kind, state, job_id, actor_id,
-                      attempt, error, time.time() if ts is None else ts))
+                      attempt, error, time.time() if ts is None else ts,
+                      resources))
 
     def drain(self) -> list[dict]:
         with self._lock:
@@ -114,7 +122,8 @@ class TaskEventBuffer:
                 task_id=e[1], name=e[2], kind=e[3], state=e[4],
                 job_id=e[5], actor_id=e[6], attempt=e[7],
                 worker=self.worker, node=self.node, error=e[8],
-                ts=e[9]) if isinstance(e, tuple) else e
+                ts=e[9], resources=e[10] if len(e) > 10 else None)
+                if isinstance(e, tuple) else e
                 for e in raw]
             if self._dropped:
                 out.append({

@@ -46,6 +46,15 @@ class Autoscaler:
         self.num_scale_ups = 0
         self.num_scale_downs = 0
 
+    def _event(self, kind: str, message: str, severity: str = "INFO",
+               **data):
+        """Scaling decisions land in the GCS cluster event log
+        (monitor-in-head: the event manager is in-process)."""
+        record = getattr(self.gcs, "record_event", None)
+        if record is not None:
+            record(source="autoscaler", kind=kind, message=message,
+                   severity=severity, **data)
+
     def start(self):
         self._task = asyncio.ensure_future(self._loop())
 
@@ -205,9 +214,17 @@ class Autoscaler:
             except Exception as e:
                 im.transition(inst.instance_id, InstanceStatus.FAILED,
                               f"create_slice failed: {e}")
+                self._event("autoscaler_launch_failed",
+                            f"launch of {t.name} failed: {e}",
+                            severity="WARNING", node_type=t.name)
                 continue
             inst.slice_id = slice_id
             self.num_scale_ups += 1
+            self._event("autoscaler_scale_up",
+                        f"scale-up: launched slice {slice_id} "
+                        f"({t.name}, {t.hosts} host(s)) for unmet "
+                        f"demand", node_type=t.name, slice_id=slice_id,
+                        hosts=t.hosts)
 
     def _unmet_demand(self) -> list[dict]:
         """Bundle-shaped demands not satisfiable by current ALIVE nodes.
@@ -300,6 +317,11 @@ class Autoscaler:
                         "idle past timeout")
                 self.provider.terminate_slice(slice_id)
                 self.num_scale_downs += 1
+                self._event("autoscaler_scale_down",
+                            f"scale-down: terminating slice {slice_id} "
+                            f"(idle > {self.idle_timeout_s:g}s)",
+                            slice_id=slice_id,
+                            idle_timeout_s=self.idle_timeout_s)
 
     def stats(self) -> dict:
         # served from the last reconcile snapshot: callable from the GCS

@@ -559,7 +559,11 @@ class CoreWorker:
                 name=spec.name or spec.method_name or "task",
                 kind=kind, state=state, job_id=spec.job_id.hex(),
                 actor_id=spec.actor_id.hex() if spec.actor_id else "",
-                attempt=getattr(spec, "attempt", 0), error=error)
+                attempt=getattr(spec, "attempt", 0), error=error,
+                # demand shape on the submit-side transition only: the
+                # why-pending join key (a dict ref, not a copy)
+                resources=(spec.resources
+                           if state == "PENDING_ARGS" else None))
         except Exception:
             pass
 
@@ -2136,6 +2140,38 @@ class CoreWorker:
                     entry[0], entry[1], entry[2], reusable=False)
         self._spawn(_expire())
 
+    @staticmethod
+    def _infeasible_error(demand: dict, res) -> RuntimeError:
+        """Enriched submitter-side infeasible error: names the demand
+        shape, the nearest-fit node's view (from the deciding node's
+        candidate snapshot riding the reply), and points at the
+        scheduling-observability surfaces — the reason string alone
+        told the user nothing actionable."""
+        reason = res[1]
+        detail = (res[2] if len(res) > 2 and isinstance(res[2], dict)
+                  else {})
+        shape = detail.get("shape") or ",".join(
+            f"{k}:{demand[k]:g}" for k in sorted(demand)) or "(none)"
+        cands = detail.get("candidates") or {}
+        nearest = ""
+        if cands:
+            # nearest fit: a node that could EVER fit beats one that
+            # can't; among those, the most demanded-resource headroom
+            def score(item):
+                view = item[1]
+                return (view.get("fits_ever", False),
+                        view.get("fits_now", False),
+                        sum(view.get("available", {}).values()))
+            nid, view = max(cands.items(), key=score)
+            fit = (" (could fit when idle)" if view.get("fits_ever")
+                   else " (can NEVER fit this shape)")
+            nearest = (f" Nearest fit: node {nid[:12]} "
+                       f"available={view.get('available')}{fit}.")
+        return RuntimeError(
+            f"infeasible task: {reason} (demand shape: {shape})."
+            f"{nearest} Run `rayt why-pending <task_id>` for the live "
+            f"verdict or `rayt status` for cluster-wide pending demand.")
+
     async def _request_cluster_lease(self, demand: dict[str, float],
                                      strategy=None, count: int = 1):
         """-> list of (winfo, token, nm_addr) grants (1..count)."""
@@ -2143,6 +2179,10 @@ class CoreWorker:
         allow_spill = True
         infeasible_deadline: float | None = None
         hop = 0
+        # spillback hop count: rides the request so each node's
+        # decision trace records its position in the chain, and rides
+        # the spillback reply back so the chain reassembles in the GCS
+        spill_hop = 0
         while hop < 1000:
             hop += 1
             try:
@@ -2152,7 +2192,7 @@ class CoreWorker:
                 self.lease_rpcs_sent += 1
                 res = await conn.call("request_lease",
                                       (demand, allow_spill, strategy,
-                                       count),
+                                       count, spill_hop),
                                       timeout=_TASK_PUSH_TIMEOUT)
             except (ConnectionLost, RpcError, OSError):
                 if nm_addr.key() == self.node_address.key():
@@ -2163,13 +2203,25 @@ class CoreWorker:
                 nm_addr = Address(self.node_address.host,
                                   self.node_address.port)
                 allow_spill = True
+                spill_hop = 0
                 await asyncio.sleep(0.3)
                 continue
             if res[0] == "granted":
                 return [(w, t, nm_addr) for w, t in res[1]]
             if res[0] == "spillback":
                 nm_addr = res[1]
+                spill_hop = (int(res[2]) if len(res) > 2
+                             else spill_hop + 1)
                 allow_spill = False
+                continue
+            if res[0] == "cancelled":
+                # the node believed this caller gone (e.g. a reconnect
+                # race): retry from the local manager
+                nm_addr = Address(self.node_address.host,
+                                  self.node_address.port)
+                allow_spill = True
+                spill_hop = 0
+                await asyncio.sleep(0.2)
                 continue
             # infeasible NOW: publish the unmet demand so an autoscaler can
             # act on it (ref: raylets feeding resource_demands to the
@@ -2179,7 +2231,7 @@ class CoreWorker:
                 infeasible_deadline = (time.monotonic()
                                        + get_config().lease_timeout_s)
             if time.monotonic() >= infeasible_deadline:
-                raise RuntimeError(f"infeasible task: {res[1]}")
+                raise self._infeasible_error(demand, res)
             try:
                 autoscaler_listening = await self.gcs.call(
                     "report_task_demand", demand)
@@ -2187,9 +2239,10 @@ class CoreWorker:
                 autoscaler_listening = False
             if not autoscaler_listening:
                 # nothing will ever grow the cluster — fail fast
-                raise RuntimeError(f"infeasible task: {res[1]}")
+                raise self._infeasible_error(demand, res)
             nm_addr = Address(self.node_address.host, self.node_address.port)
             allow_spill = True
+            spill_hop = 0
             await asyncio.sleep(0.5)
         raise RuntimeError("lease spillback loop exceeded")
 

@@ -31,9 +31,11 @@ logger = setup_logger("gcs")
 
 # Pubsub channel names (CH_METRICS is canonical in util/metrics.py,
 # CH_OBJECTS in core/gcs_object_manager.py, CH_DAGS in
-# core/gcs_dag_manager.py — the owning side defines them; re-exported
-# here next to their siblings)
+# core/gcs_dag_manager.py, CH_EVENTS in core/gcs_event_manager.py —
+# the owning side defines them; re-exported here next to their siblings)
 from ray_tpu.core.gcs_dag_manager import CH_DAGS, GcsDagManager  # noqa: E402
+from ray_tpu.core.gcs_event_manager import (CH_EVENTS,  # noqa: E402
+                                            GcsEventManager, shape_key)
 from ray_tpu.core.gcs_object_manager import (CH_OBJECTS,  # noqa: E402
                                              GcsObjectManager)
 
@@ -109,13 +111,23 @@ class GcsServer:
         # channel (ref: gcs_object_manager.h / `ray memory` aggregation)
         self.object_manager = GcsObjectManager(
             max_objects=cfg0.object_state_max_objects)
+        # cluster event log + scheduling decision-trace store fed by
+        # the `cluster_events` channel (and by in-process GCS flows:
+        # node/actor/job lifecycle, autoscaler). Built BEFORE the dag
+        # manager (whose stall watchdog emits events through it) and
+        # before any snapshot load (which records gcs_restarted).
+        self.event_manager = GcsEventManager(
+            max_events=cfg0.cluster_events_max)
+        self._cluster_events_enabled = cfg0.cluster_events_enabled
         # compiled-DAG execution-plane state store fed by the
         # `dag_state` channel; the stall watchdog cross-references the
-        # actor table for dead-peer attribution
+        # actor table for dead-peer attribution and names stall
+        # flag/clear transitions in the cluster event log
         self.dag_manager = GcsDagManager(
             max_dags=cfg0.dag_state_max_dags,
             stall_grace_s=cfg0.dag_stall_grace_s,
-            actor_state=self._actor_state_by_hex)
+            actor_state=self._actor_state_by_hex,
+            event_cb=self._dag_stall_event)
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -255,6 +267,13 @@ class GcsServer:
             self._mark_resource_change(nid)
         logger.info("GCS snapshot loaded: %d nodes, %d actors, %d jobs",
                     len(self.nodes), len(self.actors), len(self.jobs))
+        self.record_event(
+            source="gcs", kind="gcs_restarted", severity="WARNING",
+            message=(f"GCS restarted from snapshot: {len(self.nodes)} "
+                     f"nodes, {len(self.actors)} actors, "
+                     f"{len(self.jobs)} jobs await re-registration"),
+            nodes=len(self.nodes), actors=len(self.actors),
+            jobs=len(self.jobs))
 
     async def _flush_off_loop(self):
         """Pickle on the loop thread (consistent table view — handlers
@@ -292,7 +311,8 @@ class GcsServer:
             for nid, info in list(self.nodes.items()):
                 if info.alive and nid not in self.node_conns and \
                         t - self.node_last_heartbeat.get(nid, t) > timeout:
-                    await self._on_node_lost(nid)
+                    await self._on_node_lost(
+                        nid, cause=f"heartbeat lost for >{timeout:g}s")
 
     async def _metrics_prune_loop(self):
         """Drop metric series idle past 2x retention so the name
@@ -308,6 +328,7 @@ class GcsServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         port = await self.server.start(host, port)
         self._bg.append(asyncio.ensure_future(self._metrics_prune_loop()))
+        self._bg.append(asyncio.ensure_future(self._heartbeat_gap_loop()))
         if self._backend is not None:
             self._bg.append(asyncio.ensure_future(self._flush_loop()))
             self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
@@ -332,6 +353,48 @@ class GcsServer:
             self._backend.close()
         await self.server.stop()
 
+    # ------------------------------------------------------ cluster events
+    def record_event(self, *, source: str, kind: str, message: str,
+                     severity: str = "INFO", job_id: str = "",
+                     node_id: str = "", **data):
+        """In-process cluster-event emission for flows the GCS itself
+        drives (node/actor/job lifecycle, autoscaler decisions, DAG
+        stalls). Never raises — events are telemetry."""
+        if not self._cluster_events_enabled:
+            return
+        try:
+            self.event_manager.record(
+                source=source, kind=kind, message=message,
+                severity=severity, job_id=job_id, node_id=node_id,
+                data=data)
+        except Exception:
+            pass
+
+    def _dag_stall_event(self, kind: str, message: str, severity: str,
+                         job_id: str, data: dict):
+        self.record_event(source="dag", kind=kind, message=message,
+                          severity=severity, job_id=job_id, **data)
+
+    async def _heartbeat_gap_loop(self):
+        """Per-node heartbeat-gap gauges (rayt_node_heartbeat_gap_s):
+        the staleness signal `rayt status` + the Cluster tab sparklines
+        render. Covers DEAD nodes too — a lost node's gap keeps growing
+        instead of freezing at its last report."""
+        from ray_tpu.util.builtin_metrics import heartbeat_gap_records
+
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                t = now()
+                gaps = {nid.hex(): round(
+                    t - self.node_last_heartbeat.get(nid, t), 3)
+                    for nid in self.nodes}
+                recs = heartbeat_gap_records(gaps, ts=time.time())
+                if recs:
+                    self.metrics_store.ingest_many(recs)
+            except Exception:
+                pass
+
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, message: Any):
         if channel == CH_ACTOR:
@@ -344,6 +407,12 @@ class GcsServer:
                 self.metrics_store.ingest(message)
         elif channel == CH_OBJECTS:
             self.object_manager.ingest(message)
+        elif channel == CH_EVENTS:
+            self.event_manager.ingest(message)
+            # sched-report deltas derive the rayt_sched_* family
+            recs = self.event_manager.drain_metric_records()
+            if recs:
+                self.metrics_store.ingest_many(recs)
         elif channel == CH_DAGS:
             self.dag_manager.ingest(message)
             # report deltas derive the rayt_dag_* Prometheus family
@@ -517,11 +586,19 @@ class GcsServer:
         conn.on_close.append(lambda c: asyncio.ensure_future(
             self._on_node_lost(info.node_id)))
         self.mark_dirty()
+        self.record_event(
+            source="gcs", kind="node_registered",
+            message=(f"node {info.node_id.hex()[:12]} registered "
+                     f"({info.resources_total})"),
+            node_id=info.node_id.hex(),
+            resources=dict(info.resources_total),
+            labels=dict(info.labels or {}))
         await self.publish(CH_NODE, {"event": "added", "node": info})
         logger.info("node %s registered (%s)", info.node_id, info.resources_total)
         return True
 
-    async def _on_node_lost(self, node_id: NodeID):
+    async def _on_node_lost(self, node_id: NodeID,
+                            cause: str | None = None):
         info = self.nodes.get(node_id)
         if info is None or not info.alive:
             return
@@ -532,9 +609,20 @@ class GcsServer:
         # the dead node's object directory + its workers' ref reports
         # will never send removal deltas: purge them now
         self.object_manager.on_node_dead(node_id.hex())
+        # ...and its pending-lease report (phantom demand otherwise)
+        self.event_manager.drop_node(node_id.hex())
         self.mark_dirty()
-        logger.warning("node %s lost (conn: %s)", node_id,
-                       getattr(conn, "close_reason", "") or "untracked")
+        cause = cause or (
+            f"connection lost "
+            f"({getattr(conn, 'close_reason', '') or 'untracked'})")
+        gap = now() - self.node_last_heartbeat.get(node_id, now())
+        logger.warning("node %s lost (%s)", node_id, cause)
+        self.record_event(
+            source="gcs", kind="node_dead", severity="ERROR",
+            message=f"node {node_id.hex()[:12]} dead: {cause} "
+                    f"(last heartbeat {gap:.1f}s ago)",
+            node_id=node_id.hex(), cause=cause,
+            heartbeat_gap_s=round(gap, 3))
         await self.publish(CH_NODE, {"event": "removed", "node": info})
         # Fail over actors on this node (restart if budget remains).
         for actor in list(self.actors.values()):
@@ -634,6 +722,10 @@ class GcsServer:
         self.jobs[job_id] = {"metadata": metadata, "start_time": now(),
                              "status": "RUNNING"}
         self.mark_dirty()
+        job_hex = job_id.hex() if job_id is not None else ""
+        self.record_event(source="gcs", kind="job_started",
+                          message=f"job {job_hex[:12]} started",
+                          job_id=job_hex)
         return True
 
     async def rpc_finish_job(self, conn, job_id: JobID):
@@ -643,6 +735,12 @@ class GcsServer:
             self.mark_dirty()
         # the exiting driver owns the job's objects: drop their records
         self.object_manager.on_job_finished(job_id.hex())
+        # ...and its event-log entries (purge FIRST so the finish event
+        # itself survives as the job's one remaining record)
+        self.event_manager.on_job_finished(job_id.hex())
+        self.record_event(source="gcs", kind="job_finished",
+                          message=f"job {job_id.hex()[:12]} finished",
+                          job_id=job_id.hex())
         # ...and its compiled DAGs (their loops die with the driver);
         # drain the gauge update this may emit (no report will follow
         # to carry it — a dead job's stall must not read as live)
@@ -691,6 +789,12 @@ class GcsServer:
         self.actors[spec.actor_id] = info
         self.actor_specs[spec.actor_id] = spec
         self._record_task_transition(spec, "PENDING_ARGS")
+        self.record_event(
+            source="gcs", kind="actor_created",
+            message=(f"actor {spec.actor_id.hex()[:12]} "
+                     f"({spec.name or 'Actor'}) registered, scheduling"),
+            job_id=spec.job_id.hex(), actor_id=spec.actor_id.hex(),
+            class_name=spec.name or "")
         self.mark_dirty()
         await self.publish(CH_ACTOR, info)
         asyncio.ensure_future(self._schedule_actor(spec.actor_id))
@@ -769,6 +873,14 @@ class GcsServer:
                 # creation task raised: actor is DEAD with cause
                 info.state = ActorState.DEAD
                 info.death_cause = err
+                self.record_event(
+                    source="gcs", kind="actor_dead", severity="ERROR",
+                    message=(f"actor {actor_id.hex()[:12]} "
+                             f"({info.class_name or 'Actor'}) creation "
+                             f"failed: {err}"),
+                    job_id=spec.job_id.hex(),
+                    node_id=node_id.hex(),
+                    actor_id=actor_id.hex(), cause=err)
                 await self.publish(CH_ACTOR, info)
                 return
             if worker_info.worker_id in self._dead_actor_workers:
@@ -793,7 +905,18 @@ class GcsServer:
             return
         info.state = ActorState.DEAD
         info.death_cause = "scheduling timed out (unsatisfiable resources?)"
+        self.record_event(
+            source="gcs", kind="actor_dead", severity="ERROR",
+            message=(f"actor {actor_id.hex()[:12]} "
+                     f"({info.class_name or 'Actor'}) scheduling timed "
+                     f"out: demand {demand} unplaceable"),
+            job_id=spec.job_id.hex(), actor_id=actor_id.hex(),
+            cause=info.death_cause, demand=demand)
         await self.publish(CH_ACTOR, info)
+
+    def _actor_job_hex(self, actor_id: ActorID) -> str:
+        spec = self.actor_specs.get(actor_id)
+        return spec.job_id.hex() if spec is not None else ""
 
     async def _handle_actor_failure(self, info: ActorInfo, cause: str):
         if info.max_restarts != 0 and (
@@ -801,12 +924,28 @@ class GcsServer:
             info.num_restarts += 1
             info.state = ActorState.RESTARTING
             info.address = None
+            self.record_event(
+                source="gcs", kind="actor_restarting", severity="WARNING",
+                message=(f"actor {info.actor_id.hex()[:12]} "
+                         f"({info.class_name or 'Actor'}) restarting "
+                         f"(attempt {info.num_restarts}): {cause}"),
+                job_id=self._actor_job_hex(info.actor_id),
+                node_id=info.node_id.hex() if info.node_id else "",
+                actor_id=info.actor_id.hex(), cause=cause,
+                num_restarts=info.num_restarts)
             await self.publish(CH_ACTOR, info)
             asyncio.ensure_future(self._schedule_actor(info.actor_id))
         else:
             info.state = ActorState.DEAD
             info.death_cause = cause
             info.address = None
+            self.record_event(
+                source="gcs", kind="actor_dead", severity="ERROR",
+                message=(f"actor {info.actor_id.hex()[:12]} "
+                         f"({info.class_name or 'Actor'}) dead: {cause}"),
+                job_id=self._actor_job_hex(info.actor_id),
+                node_id=info.node_id.hex() if info.node_id else "",
+                actor_id=info.actor_id.hex(), cause=cause)
             await self.publish(CH_ACTOR, info)
 
     async def rpc_report_actor_failure(self, conn, arg):
@@ -1024,7 +1163,9 @@ class GcsServer:
         self.task_manager.ingest([make_transition(
             task_id=spec.task_id.hex(), name=spec.name or "Actor",
             kind=kind, state=state, job_id=spec.job_id.hex(),
-            actor_id=spec.actor_id.hex() if spec.actor_id else "")])
+            actor_id=spec.actor_id.hex() if spec.actor_id else "",
+            resources=(dict(spec.resources)
+                       if state == "PENDING_ARGS" else None))])
 
     def rpc_add_task_events(self, conn, events: list):
         """Ingest flushed worker/node-manager event batches into the
@@ -1084,6 +1225,112 @@ class GcsServer:
         tick/byte/blocked-time totals, and current stalls."""
         return self.dag_manager.summarize(**dict(arg or {}))
 
+    def rpc_list_cluster_events(self, conn, arg=None):
+        """State API `list_cluster_events` backend: filtered event-log
+        query (job / node prefix / min-severity / source / kind / time
+        window / limit) — server-side, no full-log dump to the client."""
+        return self.event_manager.list(**dict(arg or {}))
+
+    def rpc_summarize_scheduling(self, conn, arg=None):
+        """State API `summarize_scheduling` backend: per-demand-shape
+        lease decision rollups (grant/spill/queue/infeasible/cancelled
+        counts, queue-wait totals, spillback hops) + per-node pending
+        queue state from the heartbeat-cadence reports."""
+        return self.event_manager.summarize_scheduling()
+
+    def rpc_why_pending(self, conn, task_id: str):
+        """`rayt why-pending <task_id>` backend: join the task-events
+        record with the live resource view + decision traces to say
+        what a pending task is waiting for — feasible-but-busy (and on
+        which nodes, behind how deep a queue) vs infeasible
+        cluster-wide (and which resource is short)."""
+        from ray_tpu._internal.tracing import TERMINAL_STATES
+
+        rec = self.task_manager.get(task_id or "")
+        if rec is None:
+            return {"found": False,
+                    "explanation": f"no task record matches "
+                                   f"{task_id!r} (events flush on a "
+                                   f"~1s cadence; evicted records are "
+                                   f"gone)"}
+        out = {
+            "found": True, "task_id": rec["task_id"],
+            "name": rec["name"], "state": rec["state"],
+            "attempt": rec["attempt"], "job_id": rec["job_id"],
+        }
+        if rec["state"] == "RUNNING" or rec["state"] in TERMINAL_STATES:
+            out["pending"] = False
+            out["verdict"] = "not_pending"
+            out["explanation"] = (
+                f"task is {rec['state']}"
+                + (f" on node {rec['node'][:12]}" if rec.get("node")
+                   else "") + " — not waiting on the scheduler")
+            return out
+        out["pending"] = True
+        demand = dict(rec.get("resources") or {}) or {"CPU": 1.0}
+        sk = shape_key(demand)
+        out["demand"] = demand
+        out["shape"] = sk
+        # live feasibility over the GCS resource view
+        fit_now, fit_ever, node_views = [], [], {}
+        short = {r: 0.0 for r in demand}
+        for nid, info in self.nodes.items():
+            if not info.alive:
+                continue
+            h = nid.hex()
+            avail = self.node_resources_available.get(nid, {})
+            total = info.resources_total
+            fits_now = all(avail.get(r, 0.0) >= amt - 1e-9
+                           for r, amt in demand.items())
+            fits_ever = all(total.get(r, 0.0) >= amt - 1e-9
+                            for r, amt in demand.items())
+            if fits_now:
+                fit_now.append(h)
+            if fits_ever:
+                fit_ever.append(h)
+            for r in demand:
+                short[r] = max(short[r], total.get(r, 0.0))
+            node_views[h] = {
+                "available": {r: avail.get(r, 0.0) for r in demand},
+                "total": {r: total.get(r, 0.0) for r in demand},
+                "fits_now": fits_now, "fits_ever": fits_ever,
+                "pending_leases":
+                    self.event_manager.node_sched(h)["pending"],
+            }
+        out["nodes"] = node_views
+        out["trace"] = self.event_manager.shape_stats(sk)
+        if not fit_ever:
+            missing = {r: {"need": demand[r],
+                           "cluster_max": short[r]}
+                       for r, amt in demand.items()
+                       if short[r] < amt - 1e-9}
+            out["verdict"] = "infeasible"
+            out["short_resources"] = missing
+            out["explanation"] = (
+                f"INFEASIBLE cluster-wide: no alive node can ever "
+                f"satisfy {sk}; short on "
+                + ", ".join(f"{r} (need {v['need']:g}, largest node "
+                            f"has {v['cluster_max']:g})"
+                            for r, v in missing.items()))
+        elif not fit_now:
+            depth = sum(v["pending_leases"]
+                        for h, v in node_views.items() if h in fit_ever)
+            out["verdict"] = "feasible_but_busy"
+            out["explanation"] = (
+                f"FEASIBLE BUT BUSY: {len(fit_ever)} node(s) "
+                f"({', '.join(h[:12] for h in fit_ever[:4])}"
+                + ("…" if len(fit_ever) > 4 else "")
+                + f") fit {sk} by capacity but none has room now; "
+                  f"{depth} lease(s) queued on those nodes — the task "
+                  f"waits for running work to release resources")
+        else:
+            out["verdict"] = "schedulable"
+            out["explanation"] = (
+                f"{len(fit_now)} node(s) have room for {sk} right now; "
+                f"the task is likely mid-dispatch (lease RPC / worker "
+                f"startup) or its record lags the ~1s event flush")
+        return out
+
     def rpc_metrics_snapshot(self, conn, arg=None):
         return self.metrics_store.snapshot()
 
@@ -1142,12 +1389,42 @@ class GcsServer:
 
     # ---------------------------------------------------------- debugging
     def rpc_cluster_status(self, conn, arg=None):
+        """`rayt status` / dashboard `/api/cluster` backend: the summary
+        counters plus a per-node table (resources, pending leases,
+        heartbeat age), aggregate pending lease demand by shape, the
+        scheduling decision rollup, and recent WARNING+ events (the
+        `ray status` analog, enriched with the decision-trace feed)."""
+        t = now()
+        node_rows = []
+        for nid, info in self.nodes.items():
+            h = nid.hex()
+            hb = self.node_last_heartbeat.get(nid)
+            node_rows.append({
+                "node_id": h,
+                "alive": info.alive,
+                "address": (f"{info.address.host}:{info.address.port}"
+                            if info.address else ""),
+                "labels": dict(info.labels or {}),
+                "resources_total": dict(info.resources_total),
+                "resources_available": dict(
+                    self.node_resources_available.get(nid, {})),
+                "heartbeat_age_s": (round(t - hb, 3)
+                                    if hb is not None else None),
+                "pending_leases":
+                    self.event_manager.node_sched(h)["pending"],
+            })
         out = {
-            "uptime_s": now() - self._started,
+            "uptime_s": t - self._started,
             "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
             "num_actors": len(self.actors),
             "num_jobs": len(self.jobs),
             "num_placement_groups": len(self.placement_groups),
+            "nodes": node_rows,
+            "pending_demand": self.event_manager.pending_demand(),
+            "scheduling":
+                self.event_manager.summarize_scheduling()["totals"],
+            "recent_events": self.event_manager.list(
+                severity="WARNING", limit=20)["events"],
             "placement_groups": [
                 {"placement_group_id": pg_id.hex(),
                  "bundles": pg.get("bundles"),
@@ -1249,6 +1526,7 @@ class GcsClient:
         "get_task_events", "list_tasks", "summarize_tasks",
         "list_objects_state", "summarize_objects",
         "list_dags", "summarize_dags",
+        "list_cluster_events", "summarize_scheduling", "why_pending",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU

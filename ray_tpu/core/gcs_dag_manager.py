@@ -41,12 +41,18 @@ def _endpoint(raw) -> dict:
 
 class GcsDagManager:
     def __init__(self, max_dags: int = 500, stall_grace_s: float = 5.0,
-                 actor_state: Optional[Callable[[str], Optional[str]]] = None):
+                 actor_state: Optional[Callable[[str], Optional[str]]] = None,
+                 event_cb: Optional[Callable] = None):
         self.max_dags = max_dags
         self.stall_grace_s = stall_grace_s
         # actor hex -> lifecycle state string ("ALIVE"/"DEAD"/...), or
         # None when unknown; the GCS server wires its actor table in
         self._actor_state = actor_state or (lambda _hex: None)
+        # cluster-event emitter for stall flag/clear TRANSITIONS (the
+        # GCS server wires its event manager in): cb(kind, message,
+        # severity, job_id, data) — called only when the flag CHANGES,
+        # never per report
+        self._event_cb = event_cb
         # dag_id -> record; insertion-ordered so per-job eviction finds
         # a job's oldest record cheaply via the index
         self._dags: dict[str, dict] = {}
@@ -189,21 +195,45 @@ class GcsDagManager:
         rec["updated_at"] = max(rec["updated_at"], ts)
         # a torn-down DAG's parked loops are expected, not stalled
         for edge in rec["edges"].values():
-            self._set_stall(edge, None)
+            self._set_stall(rec, edge, None)
             edge["write_blocked_s"] = 0.0
             edge["read_blocked_s"] = 0.0
         self._emit_stalled_gauge(ts)
 
     # ----------------------------------------------------- stall watchdog
-    def _set_stall(self, edge: dict, stall):
+    def _set_stall(self, rec: dict, edge: dict, stall):
         """Every stall set/clear routes here so _num_stalled stays an
-        O(1) incrementally-maintained count."""
+        O(1) incrementally-maintained count — and so flag TRANSITIONS
+        (not per-report re-flags) land in the cluster event log with
+        the watchdog's attribution."""
         had = edge["stall"] is not None
         edge["stall"] = stall
         if stall is not None and not had:
             self._num_stalled += 1
+            self._emit_event(
+                "dag_stall", "WARNING", rec, edge,
+                f"dag {rec['dag_id'][:12]} edge {edge['edge']} "
+                f"{stall['blocked']}-blocked {stall['blocked_s']:.1f}s; "
+                f"culprit {stall['culprit']}"
+                + (" (peer DEAD)" if stall.get("dead_peer") else ""),
+                stall)
         elif stall is None and had:
             self._num_stalled -= 1
+            self._emit_event(
+                "dag_stall_cleared", "INFO", rec, edge,
+                f"dag {rec['dag_id'][:12]} edge {edge['edge']} "
+                f"stall cleared", None)
+
+    def _emit_event(self, kind, severity, rec, edge, message, stall):
+        if self._event_cb is None:
+            return
+        try:
+            self._event_cb(kind, message, severity, rec["job_id"],
+                           {"dag_id": rec["dag_id"],
+                            "edge": edge["edge"],
+                            **(dict(stall) if stall else {})})
+        except Exception:
+            pass
 
     def _check_stall(self, rec: dict, edge: dict, ts: float):
         """Attribution: a consumer parked on an EMPTY ring points at the
@@ -212,7 +242,7 @@ class GcsDagManager:
         liveness comes from the GCS actor table — a DEAD peer turns an
         opaque stall into a one-line diagnosis."""
         if rec["state"] != "RUNNING":
-            self._set_stall(edge, None)  # straggler after teardown
+            self._set_stall(rec, edge, None)  # straggler after teardown
             return
         blocked_kind = None
         blocked_s = 0.0
@@ -223,11 +253,11 @@ class GcsDagManager:
             blocked_kind, blocked_s = "write", edge["write_blocked_s"]
             culprit = edge["consumer"]
         else:
-            self._set_stall(edge, None)
+            self._set_stall(rec, edge, None)
             return
         peer_state = (self._actor_state(culprit["actor"])
                       if culprit["actor"] else None)
-        self._set_stall(edge, {
+        self._set_stall(rec, edge, {
             "blocked": blocked_kind,
             "blocked_s": round(blocked_s, 3),
             "culprit": culprit["label"],
@@ -297,7 +327,7 @@ class GcsDagManager:
         if rec is None:
             return
         for edge in rec["edges"].values():
-            self._set_stall(edge, None)  # keep _num_stalled exact
+            self._set_stall(rec, edge, None)  # keep _num_stalled exact
             self._chan_edge.pop((dag_id, edge["channel"]), None)
 
     def on_job_finished(self, job_hex: str):

@@ -100,6 +100,10 @@ class GcsTaskManager:
             rec["worker"] = ev["worker"]
         if ev.get("actor_id"):
             rec["actor_id"] = ev["actor_id"]
+        if ev.get("resources"):
+            # demand shape (submit-side PENDING_ARGS carries it): the
+            # join key `rayt why-pending` uses against decision traces
+            rec["resources"] = ev["resources"]
         if ev.get("error") and not rec.get("error"):
             rec["error"] = ev["error"]
 
@@ -114,6 +118,7 @@ class GcsTaskManager:
             "node": ev.get("node", ""),
             "worker": ev.get("worker", ""),
             "attempt": int(ev.get("attempt", 0)),
+            "resources": ev.get("resources") or {},
             "state": "",
             "states": {},
             "error": None,
@@ -136,6 +141,17 @@ class GcsTaskManager:
             self._dropped_per_job[victim_job] += 1
 
     # ------------------------------------------------------------ queries
+    def get(self, task_id: str) -> Optional[dict]:
+        """One record by task id (hex prefix accepted, like the other
+        id-taking CLI surfaces) — the `rayt why-pending` lookup."""
+        rec = self._tasks.get(task_id)
+        if rec is None and task_id:
+            rec = next((r for tid, r in self._tasks.items()
+                        if tid.startswith(task_id)), None)
+        if rec is None:
+            return None
+        return dict(rec, states=dict(rec["states"]))
+
     def _iter_filtered(self, job_id=None, state=None, name=None,
                        actor_id=None, start_us=None, end_us=None):
         if job_id is not None:

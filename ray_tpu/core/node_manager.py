@@ -28,6 +28,8 @@ from ray_tpu._internal.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu._internal.rpc import Connection, RawView, RpcServer, connect
 from ray_tpu.core.common import Address, NodeInfo, TaskSpec, WorkerInfo
+from ray_tpu.core.gcs_event_manager import (CH_EVENTS, make_event,
+                                            shape_key)
 from ray_tpu.core.gcs_object_manager import CH_OBJECTS
 from ray_tpu.core.object_store import make_shm_store
 
@@ -44,6 +46,10 @@ class _Worker:
         self.actor_id: ActorID | None = None
         self.lease_resources: dict[str, float] | None = None
         self.last_idle = time.monotonic()
+        # set by the memory monitor before it terminates the worker:
+        # (mem_fraction, rss_bytes) — the reap path turns it into a
+        # caused worker_oom_reaped cluster event
+        self.oom_reap: tuple | None = None
 
 
 class _PullManager:
@@ -285,6 +291,15 @@ class NodeManager:
         # + diffs the directory view when something actually changed
         # (an idle tick stays O(1) instead of O(objects))
         self._objects_dirty = True
+        # scheduling-plane observability: per-demand-shape lease
+        # decision deltas (coalesced locally, shipped to the GCS event
+        # manager on the heartbeat cadence) + the structured cluster
+        # event buffer (worker crash/OOM-reap etc.)
+        self._cluster_events_enabled = get_config().cluster_events_enabled
+        self._sched_decisions: dict[str, dict] = {}
+        self._sched_dirty = False
+        self._sched_pending_published: dict | None = None
+        self._event_buf: list[dict] = []
 
     # ------------------------------------------------------------ lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
@@ -351,6 +366,8 @@ class NodeManager:
                 await self._refresh_view()
                 await self._publish_node_metrics()
                 await self._publish_object_state()
+                await self._publish_sched_state()
+                await self._flush_events()
                 await self._flush_task_events()
             except Exception:
                 if self.gcs_conn is not None and self.gcs_conn.closed \
@@ -488,6 +505,158 @@ class NodeManager:
         except Exception:
             pass  # best-effort: lifecycle events are telemetry
 
+    # ------------------------------------- cluster events + sched traces
+    def _emit_event(self, kind: str, message: str,
+                    severity: str = "INFO", job_id: str = "", **data):
+        """Buffer a structured cluster event for the GCS event manager.
+        INFO rides the next heartbeat tick; WARNING+ schedules an
+        immediate flush so chaos (worker crash, OOM reap) shows up as a
+        caused, named event without waiting out the cadence."""
+        if not self._cluster_events_enabled:
+            return
+        self._event_buf.append(make_event(
+            source="node_manager", kind=kind, message=message,
+            severity=severity, job_id=job_id,
+            node_id=self.node_id.hex(), data=data))
+        if len(self._event_buf) > 1000:  # bound a disconnected burst
+            del self._event_buf[:len(self._event_buf) - 1000]
+        if severity in ("WARNING", "ERROR"):
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            asyncio.ensure_future(self._flush_events())
+
+    async def _flush_events(self):
+        if not self._event_buf:
+            return
+        buf, self._event_buf = self._event_buf, []
+        try:
+            await self.gcs_conn.call("publish", (CH_EVENTS, buf))
+        except Exception:
+            # not delivered: put the batch back in front for the next
+            # tick (order preserved; the 1000-event bound still holds)
+            self._event_buf = buf + self._event_buf
+
+    def _record_decision(self, demand: dict, strategy, verdict: str, *,
+                         reason: str = "", hop: int = 0,
+                         queue_wait_s: float = 0.0, candidates=None):
+        """Coalesce one request_lease verdict into the per-demand-shape
+        delta record the heartbeat report ships. Hot-path cost is a
+        dict update; the wire dict materializes at publish time."""
+        if not self._cluster_events_enabled:
+            return
+        sk = shape_key(demand)
+        d = self._sched_decisions.get(sk)
+        if d is None:
+            if len(self._sched_decisions) >= 256:
+                return  # shape-cardinality bound (pathological demands)
+            d = self._sched_decisions[sk] = {
+                "demand": dict(demand),
+                "granted": 0, "queued": 0, "spillback": 0,
+                "infeasible": 0, "cancelled": 0,
+                "queue_wait_s": 0.0, "queue_wait_max_s": 0.0,
+                "max_spill_hops": 0, "last_reason": "",
+                "last_candidates": None, "recent": [],
+            }
+        d[verdict] = d.get(verdict, 0) + 1
+        if queue_wait_s > 0.0:
+            d["queued"] += 1
+            d["queue_wait_s"] += queue_wait_s
+            d["queue_wait_max_s"] = max(d["queue_wait_max_s"],
+                                        queue_wait_s)
+        if verdict == "spillback":
+            d["max_spill_hops"] = max(d["max_spill_hops"], hop + 1)
+        if reason:
+            d["last_reason"] = reason
+        if candidates is not None:
+            d["last_candidates"] = candidates
+        if len(d["recent"]) < 32:
+            d["recent"].append({
+                "ts": time.time(), "node": self.node_id.hex(),
+                "verdict": verdict, "strategy": str(strategy or ""),
+                "hop": hop, "queue_wait_s": round(queue_wait_s, 4),
+                "reason": reason})
+        self._sched_dirty = True
+
+    def _candidate_views(self, demand: dict, max_nodes: int = 8) -> dict:
+        """Per-node feasibility snapshot recorded on non-grant verdicts
+        (what this node SAW when it decided): demanded-resource
+        availability, fits-now, fits-ever. Bounded — a trace entry, not
+        a cluster dump."""
+        def fits(avail):
+            return all(avail.get(r, 0.0) >= amt - 1e-9
+                       for r, amt in demand.items())
+
+        out = {self.node_id.hex(): {
+            "local": True,
+            "available": {r: round(self.resources_available.get(r, 0.0),
+                          3) for r in demand},
+            "fits_now": fits(self.resources_available),
+            "fits_ever": self._can_ever_satisfy(demand),
+        }}
+        for nid_hex, view in self._cluster_view.items():
+            if len(out) >= max_nodes:
+                break
+            if nid_hex == self.node_id.hex() or not view.get("alive"):
+                continue
+            avail = view.get("available") or {}
+            total = view.get("total") or {}
+            out[nid_hex] = {
+                "available": {r: round(avail.get(r, 0.0), 3)
+                              for r in demand},
+                "fits_now": fits(avail),
+                "fits_ever": fits(total),
+            }
+        return out
+
+    async def _publish_sched_state(self):
+        """Ship the coalesced decision deltas + live pending-lease
+        queue state to the GCS event manager on the heartbeat cadence.
+        An idle scheduler with an unchanged queue publishes nothing."""
+        if not self._cluster_events_enabled:
+            return
+        pending_shapes: dict[str, dict] = {}
+        n_pending = 0
+        for demand, fut in self._pending_leases:
+            if fut.done():
+                continue
+            n_pending += 1
+            sk = shape_key(demand)
+            entry = pending_shapes.setdefault(
+                sk, {"count": 0, "demand": dict(demand)})
+            entry["count"] += 1
+        pend = {"pending": n_pending, "pending_shapes": pending_shapes}
+        if not self._sched_dirty \
+                and pend == self._sched_pending_published:
+            return
+        decisions, self._sched_decisions = self._sched_decisions, {}
+        self._sched_dirty = False
+        msg = {"type": "sched_report", "node": self.node_id.hex(),
+               "ts": time.time(), "decisions": decisions, **pend}
+        try:
+            await self.gcs_conn.call("publish", (CH_EVENTS, msg))
+        except Exception:
+            # deltas not delivered: merge back and retry next tick
+            for sk, d in decisions.items():
+                cur = self._sched_decisions.get(sk)
+                if cur is None:
+                    self._sched_decisions[sk] = d
+                    continue
+                for c in ("granted", "queued", "spillback",
+                          "infeasible", "cancelled"):
+                    cur[c] += d[c]
+                cur["queue_wait_s"] += d["queue_wait_s"]
+                cur["queue_wait_max_s"] = max(cur["queue_wait_max_s"],
+                                              d["queue_wait_max_s"])
+                cur["max_spill_hops"] = max(cur["max_spill_hops"],
+                                            d["max_spill_hops"])
+                cur["recent"] = (d["recent"]
+                                 + cur["recent"])[:32]
+            self._sched_dirty = True
+            raise
+        self._sched_pending_published = pend
+
     async def _refresh_view(self):
         resp = await self.gcs_conn.call("get_cluster_resources_delta",
                                         self._view_version)
@@ -528,6 +697,9 @@ class NodeManager:
             # full directory on the next heartbeat, not just deltas
             self._objects_published = {}
             self._store_stats_published = None
+            # ...and its event manager lost this node's pending-lease
+            # report: republish even if the queue state is unchanged
+            self._sched_pending_published = None
             logger.info("re-registered with restarted GCS")
         except Exception:
             pass
@@ -591,6 +763,32 @@ class NodeManager:
                         "worker": w.info.worker_id.hex()}))
             except Exception:
                 pass
+        wid = w.info.worker_id.hex() if w.info else ""
+        if w.oom_reap is not None:
+            # the same reap path PR 6 instruments for object cleanup —
+            # chaos runs need the CAUSE, with the RSS measured at reap
+            # time, not just the cleanup
+            frac, rss = w.oom_reap
+            self._emit_event(
+                "worker_oom_reaped",
+                f"worker {wid[:12]} (pid {w.proc.pid}) OOM-reaped at "
+                f"{frac * 100:.0f}% node memory, rss "
+                f"{rss / 1e6:.1f} MB (task will retry)",
+                severity="WARNING", worker_id=wid, pid=w.proc.pid,
+                rss_bytes=rss, memory_fraction=round(frac, 4),
+                exit_code=w.proc.returncode,
+                actor_id=w.actor_id.hex() if w.actor_id else "")
+        else:
+            self._emit_event(
+                "worker_died",
+                f"worker {wid[:12]} (pid {w.proc.pid}) died with exit "
+                f"code {w.proc.returncode}"
+                + (f" while running actor {w.actor_id.hex()[:12]}"
+                   if w.actor_id else (" while leased" if w.busy
+                                       else "")),
+                severity="WARNING", worker_id=wid, pid=w.proc.pid,
+                exit_code=w.proc.returncode,
+                actor_id=w.actor_id.hex() if w.actor_id else "")
         logger.warning("worker %s died (code %s)",
                        w.info.worker_id if w.info else "?", w.proc.returncode)
 
@@ -647,6 +845,11 @@ class NodeManager:
             self._unregistered.remove(w)
         self.workers[info.worker_id] = w
         w.registered.set()
+        self._emit_event(
+            "worker_started",
+            f"worker {info.worker_id.hex()[:12]} (pid {w.proc.pid}) "
+            f"registered", worker_id=info.worker_id.hex(),
+            pid=w.proc.pid)
         self._maybe_grant_pending()
         return True
 
@@ -761,18 +964,30 @@ class NodeManager:
     async def rpc_request_lease(self, conn, arg):
         """Grant leased worker(s) for `demand`, spill, or queue.
 
-        Batched form (4-tuple arg ending in `count`) returns
+        Batched form (4/5-tuple arg) returns
         ("granted", [(WorkerInfo, lease_token), ...]) with 1..count
         grants: the first lease takes the full queue-wait path, the rest
         are granted only as long as resources are immediately acquirable
         — a partial batch is a backpressure signal the client answers
         with its next (queued) request. Legacy 2/3-tuple args keep the
         ("granted", WorkerInfo, lease_token) shape.
-        Other replies: ("spillback", Address) | ("infeasible", reason).
+        Other replies: ("spillback", Address, next_hop) |
+        ("infeasible", reason, detail) | ("cancelled", reason).
+
+        The 5-tuple form carries the spillback HOP COUNT the caller
+        accumulated; it rides the spillback reply back out so chains
+        reassemble in the GCS decision traces. Every outcome is
+        recorded as a per-demand-shape DECISION TRACE (verdict, reason,
+        queue-wait, hop, candidate views) shipped on the heartbeat
+        cadence — see _record_decision / gcs_event_manager.py.
         """
-        count = 1
-        batched = False
-        if len(arg) == 4:
+        count, batched, hop = 1, False, 0
+        if len(arg) == 5:
+            demand, allow_spill, strategy, count, hop = arg
+            batched = True
+            count = max(1, int(count))
+            hop = max(0, int(hop))
+        elif len(arg) == 4:
             demand, allow_spill, strategy, count = arg
             batched = True
             count = max(1, int(count))
@@ -780,8 +995,40 @@ class NodeManager:
             demand, allow_spill, strategy = arg
         else:
             (demand, allow_spill), strategy = arg, None
+        trace = {"reason": "", "queue_wait_s": 0.0, "candidates": None}
+        try:
+            res = await self._request_lease(
+                conn, demand, allow_spill, strategy, count, batched,
+                hop, trace)
+        except asyncio.CancelledError:
+            self._record_decision(demand, strategy, "cancelled",
+                                  reason="lease handler cancelled",
+                                  hop=hop)
+            raise
+        self._record_decision(
+            demand, strategy, res[0], reason=trace["reason"], hop=hop,
+            queue_wait_s=trace["queue_wait_s"],
+            candidates=trace["candidates"])
+        return res
+
+    async def _request_lease(self, conn, demand, allow_spill, strategy,
+                             count, batched, hop, trace):
         from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
                                          NodeLabelSchedulingStrategy)
+
+        def spill(target):
+            trace["reason"] = (
+                f"spilled to {target.host}:{target.port}"
+                if target is not None else "")
+            return ("spillback", target, hop + 1)
+
+        def infeasible(reason):
+            trace["reason"] = reason
+            trace["candidates"] = self._candidate_views(demand)
+            return ("infeasible", reason,
+                    {"shape": shape_key(demand),
+                     "node": self.node_id.hex(),
+                     "candidates": trace["candidates"]})
 
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             # affinity to ANOTHER node: redirect the caller there
@@ -796,10 +1043,10 @@ class NodeManager:
                         pass
                     view = self._cluster_view.get(strategy.node_id.hex())
                 if view is not None and view.get("alive"):
-                    return ("spillback", view.get("address"))
+                    return spill(view.get("address"))
                 if not strategy.soft:
-                    return ("infeasible",
-                            f"affinity node {strategy.node_id} not alive")
+                    return infeasible(
+                        f"affinity node {strategy.node_id} not alive")
             strategy = None  # landed on (or soft-fell-back to) this node
         elif isinstance(strategy, NodeLabelSchedulingStrategy) and \
                 strategy.hard and not all(
@@ -818,9 +1065,9 @@ class NodeManager:
                 if nid_hex is not None:
                     target = self._cluster_view[nid_hex].get("address")
             if target is not None:
-                return ("spillback", target)
-            return ("infeasible",
-                    f"no alive node matches hard labels {strategy.hard}")
+                return spill(target)
+            return infeasible(
+                f"no alive node matches hard labels {strategy.hard}")
         elif strategy == "SPREAD" and allow_spill:
             # round-robin over ALL feasible nodes incl. this one; only
             # execute locally when it's this node's turn
@@ -837,24 +1084,60 @@ class NodeManager:
                                       self._spread_counter,
                                       by_capacity=True)
             if nid_hex is not None and nid_hex != self.node_id.hex():
-                return ("spillback",
-                        self._cluster_view[nid_hex].get("address"))
+                return spill(self._cluster_view[nid_hex].get("address"))
         # PG-bundle demands translate to reserved-resource keys upstream.
         if not self._can_ever_satisfy(demand):
             if allow_spill:
                 target = await self._pick_spillback_fresh(demand, strategy)
                 if target is not None:
-                    return ("spillback", target)
-            return ("infeasible",
-                    f"node cannot ever satisfy {demand} (total={self.resources_total})")
+                    return spill(target)
+            return infeasible(
+                f"node cannot ever satisfy {demand} (total={self.resources_total})")
         if not self._try_acquire(demand):
             if allow_spill:
                 target = await self._pick_spillback_fresh(demand, strategy)
                 if target is not None:
-                    return ("spillback", target)
+                    return spill(target)
+            # park in the pending-lease queue. A caller that goes away
+            # (connection closed, e.g. its driver died or cancelled)
+            # must release its queue slot and record a `cancelled`
+            # verdict instead of eventually granting to nobody — a
+            # grant whose reply can't be delivered would leak the
+            # worker + resources forever.
             fut = asyncio.get_running_loop().create_future()
             self._pending_leases.append((demand, fut))
-            await fut
+            trace["candidates"] = self._candidate_views(demand)
+            t_park = time.monotonic()
+
+            def _caller_gone(_c, fut=fut):
+                if not fut.done():
+                    fut.set_result("cancelled")
+
+            conn.on_close.append(_caller_gone)
+            try:
+                outcome = await fut
+            finally:
+                try:
+                    conn.on_close.remove(_caller_gone)
+                except ValueError:
+                    pass
+            trace["queue_wait_s"] = time.monotonic() - t_park
+            if outcome == "cancelled":
+                # still parked: _maybe_grant_pending drops done futures,
+                # but sweep explicitly so the slot releases NOW
+                self._pending_leases = [
+                    (d, f) for d, f in self._pending_leases
+                    if f is not fut]
+                trace["reason"] = "caller gone while queued"
+                return ("cancelled", trace["reason"])
+            if conn.closed:
+                # granted (resources acquired by _maybe_grant_pending)
+                # but the caller died before we resumed: hand the
+                # acquisition back instead of leasing to nobody
+                self._release_resources(demand)
+                self._maybe_grant_pending()
+                trace["reason"] = "caller gone as queued lease granted"
+                return ("cancelled", trace["reason"])
         granted: list = []
         while True:
             try:
@@ -864,7 +1147,7 @@ class NodeManager:
                 self._maybe_grant_pending()
                 if granted:
                     break  # partial batch beats failing granted leases
-                return ("infeasible", f"worker startup failed: {e}")
+                return infeasible(f"worker startup failed: {e}")
             w.busy = True
             w.lease_resources = dict(demand)
             granted.append((w.info, w.info.worker_id.hex()))
@@ -1298,6 +1581,13 @@ class NodeManager:
             if victim is None:
                 continue
             self._oom_kills += 1
+            # RSS measured BEFORE the kill: the reap path turns this
+            # into a caused worker_oom_reaped cluster event
+            try:
+                rss = psutil.Process(victim.proc.pid).memory_info().rss
+            except Exception:
+                rss = 0
+            victim.oom_reap = (frac, rss)
             logger.warning(
                 "memory pressure %.0f%% >= %.0f%%: killing worker %s "
                 "(task will retry)", frac * 100,

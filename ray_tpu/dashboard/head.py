@@ -26,6 +26,16 @@ Endpoints:
                                   topology, per-edge tick/byte/occupancy
                                   rollups + history, stall attribution)
                                   with a summary rollup attached
+  GET  /api/events              — ?job=&node=&severity=&source=&limit=
+                                  cluster event log (GCS event manager:
+                                  node/worker/actor lifecycle, OOM
+                                  reaps, autoscaler decisions, DAG
+                                  stalls, serve shed episodes)
+  GET  /api/cluster             — enriched cluster status: node table
+                                  (resources, pending leases, heartbeat
+                                  age), pending lease demand by shape,
+                                  scheduling decision rollup, recent
+                                  WARNING+ events (the Cluster tab feed)
   GET  /api/timeline            — Chrome trace JSON of the GCS task
                                   lifecycle store: nested per-phase slices
                                   (load in Perfetto / chrome://tracing)
@@ -299,6 +309,8 @@ class DashboardHead:
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/objects/summary", self._objects_summary)
         app.router.add_get("/api/dags", self._dags)
+        app.router.add_get("/api/events", self._events)
+        app.router.add_get("/api/cluster", self._cluster)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/jobs", self._jobs_list)
         app.router.add_post("/api/jobs", self._jobs_submit)
@@ -547,6 +559,34 @@ class DashboardHead:
         out["summary"] = self.gcs.dag_manager.summarize(
             job_id=q.get("job") or None)
         return web.json_response(out)
+
+    async def _events(self, request):
+        """Filtered cluster event log (GCS event manager; the Cluster
+        tab's event stream + `rayt list events` twin)."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.event_manager.list(
+                job_id=q.get("job") or None,
+                node_id=q.get("node") or None,
+                severity=q.get("severity") or None,
+                source=q.get("source") or None,
+                kind=q.get("kind") or None,
+                limit=int(q.get("limit", 100)))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
+    async def _cluster(self, request):
+        """Enriched cluster status: node table with heartbeat age +
+        pending-lease depth, per-shape pending demand, the scheduling
+        decision rollup, and recent WARNING+ events."""
+        from aiohttp import web
+
+        out = self.gcs.rpc_cluster_status(None)
+        return web.json_response(json.loads(json.dumps(out,
+                                                       default=str)))
 
     async def _timeline(self, request):
         from aiohttp import web

@@ -2,8 +2,8 @@
 util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
 
 Commands: start, stop, status, summary [tasks], list {nodes,actors,jobs,
-pgs,workers,tasks,objects,dags}, dag <id>, memory, timeline,
-microbenchmark, job {submit,status,logs,stop,list}
+pgs,workers,tasks,objects,dags,events}, dag <id>, why-pending <task_id>,
+memory, timeline, microbenchmark, job {submit,status,logs,stop,list}
 (ref analog for jobs: dashboard/modules/job/cli.py).
 """
 
@@ -142,6 +142,9 @@ def _attach(args):
 
 
 def cmd_status(args):
+    """`ray status` analog: cluster summary + node table (resources,
+    pending leases, heartbeat age), aggregate pending lease demand by
+    shape, and recent WARNING+ cluster events."""
     from ray_tpu import state_api
 
     _attach(args)
@@ -158,6 +161,62 @@ def cmd_status(args):
             print(f"  {k}: {avail / 1e9:.1f}/{total / 1e9:.1f} GB available")
         else:
             print(f"  {k}: {avail:g}/{total:g} available")
+    _print_cluster_status(status)
+
+
+def _fmt_shape(demand: dict) -> str:
+    return ",".join(f"{k}:{demand[k]:g}" for k in sorted(demand)) \
+        or "(none)"
+
+
+def _print_cluster_status(status: dict):
+    """Node table + pending demand + recent events from the enriched
+    cluster_status reply (older servers lack the keys: degrade to the
+    summary lines alone)."""
+    nodes = status.get("nodes")
+    if nodes:
+        fmt = "{:<14} {:<6} {:>8} {:>8}  {}"
+        print("nodes:")
+        print(fmt.format("node", "state", "hb-age", "pending",
+                         "resources (avail/total)"))
+        for n in nodes:
+            res = " ".join(
+                f"{k}={n['resources_available'].get(k, 0):g}/"
+                f"{v:g}"
+                for k, v in sorted(n["resources_total"].items())
+                if k != "memory")
+            hb = n.get("heartbeat_age_s")
+            print(fmt.format(
+                n["node_id"][:14],
+                "ALIVE" if n["alive"] else "DEAD",
+                "—" if hb is None else f"{hb:.1f}s",
+                str(n.get("pending_leases", 0)), res))
+    pending = status.get("pending_demand") or {}
+    if pending:
+        print("pending lease demand by shape:")
+        for sk, e in sorted(pending.items()):
+            print(f"  {{{sk}}}: {e['count']} queued on "
+                  f"{len(e['nodes'])} node(s)")
+    sched = status.get("scheduling") or {}
+    if sched.get("spillback") or sched.get("infeasible") \
+            or sched.get("queued"):
+        print(f"scheduling: {sched.get('granted', 0)} granted, "
+              f"{sched.get('queued', 0)} queued "
+              f"({sched.get('queue_wait_s_total', 0.0):.2f}s total "
+              f"wait), {sched.get('spillback', 0)} spillbacks "
+              f"(max {sched.get('max_spill_hops', 0)} hops), "
+              f"{sched.get('infeasible', 0)} infeasible, "
+              f"{sched.get('cancelled', 0)} cancelled")
+    events = status.get("recent_events")
+    if events:
+        import datetime
+
+        print("recent events (warning+):")
+        for e in events[:10]:
+            ts = datetime.datetime.fromtimestamp(
+                e["ts"]).strftime("%H:%M:%S")
+            print(f"  {ts}  {e['severity']:<7} {e['source']:<12} "
+                  f"{e['kind']:<20} {e['message']}")
 
 
 def cmd_summary(args):
@@ -211,6 +270,14 @@ def cmd_list(args):
             job_id=args.job or None, node_id=args.node or None,
             callsite=args.callsite or None,
             leaked_only=bool(args.leaked), limit=args.limit, detail=True)
+        print(json.dumps(out, indent=2, default=str))
+        return
+    if kind == "events":
+        out = state_api.list_cluster_events(
+            job_id=args.job or None, node_id=args.node or None,
+            severity=args.severity or None,
+            source=getattr(args, "source", None) or None,
+            limit=args.limit, detail=True)
         print(json.dumps(out, indent=2, default=str))
         return
     if kind == "dags":
@@ -375,6 +442,52 @@ def _print_dag(rec: dict):
             max(e["ticks"], e["reads"]), e["bytes"], e["occupancy"],
             f"{e['write_block_s']:.1f}s", f"{e['read_block_s']:.1f}s",
             badge))
+
+
+def cmd_why_pending(args):
+    """Explain what a pending task is waiting for: joins the GCS task
+    record with the live resource view + lease decision traces —
+    feasible-but-busy (which nodes fit, behind how deep a queue) vs
+    infeasible cluster-wide (which resource is short)."""
+    from ray_tpu import state_api
+
+    _attach(args)
+    _print_why_pending(state_api.why_pending(args.task_id))
+
+
+def _print_why_pending(out: dict):
+    if not out.get("found"):
+        print(out.get("explanation", "task not found"))
+        return
+    head = (f"task {out['task_id'][:16]} ({out['name']}) "
+            f"state={out['state']} attempt={out['attempt']}")
+    print(head)
+    print(f"verdict: {out.get('verdict', '—')}")
+    print(out.get("explanation", ""))
+    if out.get("pending"):
+        nodes = out.get("nodes") or {}
+        if nodes:
+            fmt = "  {:<14} {:>9} {:>10} {:>8}  {}"
+            print(fmt.format("node", "fits-now", "fits-ever", "pending",
+                             "available (of demand)"))
+            for nid, v in nodes.items():
+                avail = " ".join(f"{k}={a:g}"
+                                 for k, a in v["available"].items())
+                print(fmt.format(nid[:14],
+                                 "yes" if v["fits_now"] else "no",
+                                 "yes" if v["fits_ever"] else "no",
+                                 str(v.get("pending_leases", 0)),
+                                 avail))
+        trace = out.get("trace")
+        if trace:
+            print(f"shape {out.get('shape')}: "
+                  f"{trace.get('granted', 0)} granted, "
+                  f"{trace.get('queued', 0)} queued "
+                  f"(max wait {trace.get('queue_wait_max_s', 0):.2f}s), "
+                  f"{trace.get('spillback', 0)} spillbacks, "
+                  f"{trace.get('infeasible', 0)} infeasible"
+                  + (f"; last reason: {trace['last_reason']}"
+                     if trace.get("last_reason") else ""))
 
 
 def cmd_timeline(args):
@@ -625,21 +738,36 @@ def main(argv=None):
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
                                      "workers", "tasks", "objects",
-                                     "dags"])
-    sp.add_argument("--job", help="tasks/objects/dags: filter by job "
-                                  "id (hex)")
+                                     "dags", "events"])
+    sp.add_argument("--job", help="tasks/objects/dags/events: filter "
+                                  "by job id (hex)")
     sp.add_argument("--state", help="tasks: filter by lifecycle state")
     sp.add_argument("--task-name", help="tasks: filter by task name")
-    sp.add_argument("--node", help="objects: filter by node id (hex)")
+    sp.add_argument("--node", help="objects/events: filter by node id "
+                                   "(hex; prefix ok for events)")
     sp.add_argument("--callsite", help="objects: filter by creation "
                                        "callsite (exact)")
     sp.add_argument("--leaked", action="store_true",
                     help="objects: only leak-watchdog-flagged records")
     sp.add_argument("--stalled", action="store_true",
                     help="dags: only DAGs with stall-flagged edges")
+    sp.add_argument("--severity",
+                    help="events: minimum severity (DEBUG/INFO/"
+                         "WARNING/ERROR)")
+    sp.add_argument("--source",
+                    help="events: filter by emitting plane (gcs/"
+                         "node_manager/autoscaler/serve/dag)")
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser(
+        "why-pending",
+        help="explain what a pending task waits for: feasible-but-busy "
+             "(which nodes, queue depth) vs infeasible (short resource)")
+    sp.add_argument("task_id", help="task id (hex, prefix ok)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_why_pending)
 
     sp = sub.add_parser("dag",
                         help="one compiled DAG's edge table: topology, "

@@ -83,14 +83,48 @@ def is_overload_error(exc: BaseException) -> bool:
     return isinstance(getattr(exc, "cause", None), ReplicaOverloadedError)
 
 
+# shed-EPISODE tracking: a shed after >= _EPISODE_GAP_S of none starts
+# a new episode and lands ONE cluster event (the scheduling-plane log
+# wants "the proxy started shedding app X at T because Y", not one
+# event per 503 — the per-request count stays in rayt_serve_shed_total)
+_EPISODE_GAP_S = 10.0
+_episode_lock = threading.Lock()
+_episodes: dict = {}
+
+
+def _note_shed_episode(app: str, proxy: str, reason: str):
+    import time as _time
+
+    t = _time.monotonic()
+    with _episode_lock:
+        e = _episodes.get((app, proxy))
+        if e is not None and t - e["last"] < _EPISODE_GAP_S:
+            e["last"] = t
+            e["count"] += 1
+            return
+        _episodes[(app, proxy)] = {"last": t, "count": 1}
+    from ray_tpu.core.gcs_event_manager import emit_cluster_event
+
+    emit_cluster_event(
+        source="serve", kind="serve_shed_episode", severity="WARNING",
+        message=(f"proxy {proxy} started shedding app {app!r} "
+                 f"({reason}) — overload episode"),
+        app=app, proxy=proxy, reason=reason)
+
+
 def count_shed(app: str, proxy: str, reason: str):
     """Increment rayt_serve_shed_total (best-effort; shared by both
-    ingress proxies so the tag scheme can't drift)."""
+    ingress proxies so the tag scheme can't drift). The first shed of
+    an episode also lands a WARNING cluster event."""
     try:
         from ray_tpu.util import builtin_metrics as bm
 
         bm.serve_shed.inc(tags={"app": app, "proxy": proxy,
                                 "reason": reason})
+    except Exception:
+        pass
+    try:
+        _note_shed_episode(app, proxy, reason)
     except Exception:
         pass
 

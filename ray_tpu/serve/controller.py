@@ -499,6 +499,23 @@ class ServeController:
                 float(target), tags={"app": app, "deployment": dep})
         except Exception:
             pass
+        if target != live:
+            # a replica-count CHANGE is a scheduling-plane event (the
+            # same inputs as rayt_serve_autoscale_decision, made
+            # queryable next to node/worker lifecycle in the log);
+            # unchanged decisions stay metric-only — no per-tick spam
+            from ray_tpu.core.gcs_event_manager import emit_cluster_event
+
+            emit_cluster_event(
+                source="serve", kind="serve_autoscale",
+                message=(f"{app}/{dep}: replicas {live} -> {target} "
+                         f"(desired {desired}; qps="
+                         f"{signals.get('qps')}, queued="
+                         f"{signals.get('queued')}, p99="
+                         f"{signals.get('p99_latency_s')})"),
+                app=app, deployment=dep, live=int(live),
+                target=int(target), desired=int(desired),
+                **{f"signal_{k}": v for k, v in signals.items()})
 
     async def _target_replicas(self, key: tuple, spec: dict,
                                live: int, stats=None) -> int:

@@ -258,6 +258,55 @@ def summarize_dags(*, job_id: Optional[str] = None) -> dict:
     return cw.io.run(cw.gcs.call("summarize_dags", filters))
 
 
+def list_cluster_events(*, job_id: Optional[str] = None,
+                        node_id: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        kind: Optional[str] = None,
+                        start_s: Optional[float] = None,
+                        end_s: Optional[float] = None,
+                        limit: int = 100, detail: bool = False) -> Any:
+    """Cluster event log (GCS event manager; the `ray status` events /
+    cluster-events analog): structured, timestamped, severity-tagged
+    events from every plane — node register/heartbeat-lost/dead, worker
+    start/crash/OOM-reap, actor lifecycle with cause, job start/finish,
+    GCS restart, autoscaler decisions, DAG stall flag/clear, serve shed
+    episodes. Filters run SERVER-side; ``severity`` is a minimum
+    (``"WARNING"`` returns WARNING and ERROR), ``node_id`` matches by
+    hex prefix. Newest first."""
+    cw = _cw()
+    filters: dict = {"limit": limit}
+    for key, val in (("job_id", job_id), ("node_id", node_id),
+                     ("severity", severity), ("source", source),
+                     ("kind", kind), ("start_s", start_s),
+                     ("end_s", end_s)):
+        if val is not None:
+            filters[key] = val
+    out = cw.io.run(cw.gcs.call("list_cluster_events", filters))
+    return out if detail else out["events"]
+
+
+def summarize_scheduling() -> dict:
+    """Scheduling decision-trace rollup (GCS event manager): per-demand-
+    shape lease verdict counts (granted / queued / spillback /
+    infeasible / cancelled) with queue-wait totals and max spillback
+    hops, plus per-node pending-lease queue depth and the per-shape
+    aggregate pending demand reported on each heartbeat."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.call("summarize_scheduling"))
+
+
+def why_pending(task_id: str) -> dict:
+    """`rayt why-pending` backend: join the task-events record (PR 2)
+    with the scheduling decision traces and the live resource view to
+    say WHAT a pending task is waiting for — ``feasible_but_busy``
+    (names the nodes that fit by capacity and the queue depth in front
+    of the task) vs ``infeasible`` (names the short resource and the
+    largest node's capacity). ``task_id`` may be a hex prefix."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.call("why_pending", task_id))
+
+
 def list_node_objects() -> list[dict]:
     """LIVE per-node object-directory dump (dials every node manager —
     the pre-aggregation surface; use list_objects for the cluster-wide

@@ -239,3 +239,39 @@ def dag_stalled_gauge_record(stalled_edges: int, *, ts: float) -> dict:
     """Cluster-wide count of stall-watchdog-flagged DAG edges."""
     return {"name": "rayt_dag_stalled_edges", "kind": "gauge",
             "value": float(stalled_edges), "tags": {}, "ts": ts}
+
+
+def sched_metric_records(node_hex: str, *, spillbacks: int = 0,
+                         infeasible: int = 0, queue_wait_s: float = 0.0,
+                         pending=None, ts: float = 0.0) -> list:
+    """Scheduling-plane metrics, derived by the GCS event manager from
+    node managers' coalesced decision-trace reports (the GCS process
+    has no core worker, so — like the dag manager — it builds raw
+    records and feeds its own metrics store). Counter records carry
+    DELTAS; the store sums them. One series per node."""
+    tags = {"node": node_hex}
+    recs = []
+
+    def rec(name, kind, value):
+        recs.append({"name": name, "kind": kind, "value": float(value),
+                     "tags": tags, "ts": ts})
+
+    if spillbacks:
+        rec("rayt_sched_spillbacks_total", "counter", spillbacks)
+    if infeasible:
+        rec("rayt_sched_infeasible_total", "counter", infeasible)
+    if queue_wait_s:
+        rec("rayt_sched_queue_wait_s_total", "counter", queue_wait_s)
+    if pending is not None:
+        rec("rayt_sched_pending_leases", "gauge", pending)
+    return recs
+
+
+def heartbeat_gap_records(gaps: dict, *, ts: float) -> list:
+    """Per-node heartbeat-gap gauges (seconds since the node's last
+    heartbeat reached the GCS) — the liveness staleness `rayt status`
+    renders, graphable from Prometheus. Emitted by the GCS's own gap
+    loop (raw records; no core worker in that process)."""
+    return [{"name": "rayt_node_heartbeat_gap_s", "kind": "gauge",
+             "value": float(gap), "tags": {"node": node_hex}, "ts": ts}
+            for node_hex, gap in gaps.items()]

@@ -1,0 +1,501 @@
+"""Scheduling-plane observability tests: the cluster event log, lease
+decision traces, `rayt why-pending`, the cancelled-pending-lease fix,
+and the chaos-lite E2E (kill a worker and a node mid-load; ref analogs:
+`ray status`, Ray cluster events, autoscaler demand summaries)."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+
+def _wait_for(fn, timeout=30.0, interval=0.25, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+# --------------------------------------------------------------- units
+def test_event_manager_filters_and_ordering():
+    from ray_tpu.core.gcs_event_manager import GcsEventManager, make_event
+
+    m = GcsEventManager(max_events=100)
+    t0 = time.time()
+    m.ingest(make_event(source="gcs", kind="node_registered",
+                        message="n1 up", node_id="aaaa11", ts=t0))
+    m.ingest([make_event(source="node_manager", kind="worker_died",
+                         severity="WARNING", message="w died",
+                         node_id="aaaa11", job_id="j1", ts=t0 + 1),
+              make_event(source="gcs", kind="node_dead",
+                         severity="ERROR", message="n2 dead",
+                         node_id="bbbb22", ts=t0 + 2)])
+    out = m.list()
+    assert out["total"] == 3
+    # newest first
+    assert [e["kind"] for e in out["events"]] == \
+        ["node_dead", "worker_died", "node_registered"]
+    # severity filter is a MINIMUM
+    warn = m.list(severity="WARNING")["events"]
+    assert {e["kind"] for e in warn} == {"node_dead", "worker_died"}
+    assert m.list(severity="ERROR")["total"] == 1
+    # node prefix, source, kind, job, window, limit
+    assert m.list(node_id="aaaa")["total"] == 2
+    assert m.list(source="node_manager")["total"] == 1
+    assert m.list(kind="node_dead")["total"] == 1
+    assert m.list(job_id="j1")["total"] == 1
+    assert m.list(start_s=t0 + 1.5)["total"] == 1
+    assert m.list(end_s=t0 + 0.5)["total"] == 1
+    limited = m.list(limit=2)
+    assert len(limited["events"]) == 2 and limited["truncated"] == 1
+
+
+def test_event_manager_eviction_and_purge_contract():
+    """Per-job oldest-first eviction + dropped accounting, purge on job
+    finish — the same contract as the task/object/DAG managers."""
+    from ray_tpu.core.gcs_event_manager import GcsEventManager, make_event
+
+    m = GcsEventManager(max_events=10)
+    for i in range(4):
+        m.ingest(make_event(source="gcs", kind="quiet",
+                            message=f"other {i}", job_id="quiet_job"))
+    for i in range(20):  # flood job
+        m.ingest(make_event(source="gcs", kind="flood",
+                            message=f"flood {i}", job_id="flood_job"))
+    assert m.num_events() == 10
+    # the flood job lost its OLDEST records; the quiet job's survive
+    assert m.list(job_id="quiet_job")["total"] == 4
+    flood = m.list(job_id="flood_job", limit=0)
+    assert flood["total"] == 6
+    assert flood["dropped"] == {"flood_job": 14}
+    assert flood["events"][-1]["message"] == "flood 14"  # oldest kept
+    assert m.dropped_counts().get("quiet_job", 0) == 0
+    # purge on job finish: records go away, NOT counted as eviction
+    m.on_job_finished("flood_job")
+    assert m.list(job_id="flood_job")["total"] == 0
+    assert m.dropped_counts()["flood_job"] == 14  # unchanged
+    assert m.list(job_id="quiet_job")["total"] == 4
+
+
+def test_sched_report_ingest_and_rollup():
+    from ray_tpu.core.gcs_event_manager import GcsEventManager
+
+    m = GcsEventManager()
+    report = {
+        "type": "sched_report", "node": "n1", "ts": time.time(),
+        "pending": 3,
+        "pending_shapes": {"CPU:1": {"count": 3,
+                                     "demand": {"CPU": 1.0}}},
+        "decisions": {"CPU:1": {
+            "demand": {"CPU": 1.0}, "granted": 5, "queued": 2,
+            "spillback": 1, "infeasible": 0, "cancelled": 0,
+            "queue_wait_s": 0.8, "queue_wait_max_s": 0.5,
+            "max_spill_hops": 2, "last_reason": "spilled to x",
+            "last_candidates": None,
+            "recent": [{"verdict": "granted", "queue_wait_s": 0.3}],
+        }},
+    }
+    m.ingest(report)
+    m.ingest(dict(report, pending=1,
+                  pending_shapes={"CPU:1": {"count": 1,
+                                            "demand": {"CPU": 1.0}}}))
+    s = m.summarize_scheduling()
+    shape = s["shapes"]["CPU:1"]
+    assert shape["granted"] == 10 and shape["spillback"] == 2
+    assert shape["queued"] == 4
+    assert abs(shape["queue_wait_s_total"] - 1.6) < 1e-9
+    assert shape["max_spill_hops"] == 2
+    assert shape["queue_wait_mean_s"] == pytest.approx(0.4)
+    assert len(shape["recent"]) == 2
+    assert s["nodes"]["n1"]["pending"] == 1  # latest report wins
+    assert s["pending_total"] == 1
+    assert m.pending_demand()["CPU:1"]["count"] == 1
+    # metric records derived from the deltas
+    recs = m.drain_metric_records()
+    names = {r["name"] for r in recs}
+    assert "rayt_sched_spillbacks_total" in names
+    assert "rayt_sched_queue_wait_s_total" in names
+    assert "rayt_sched_pending_leases" in names
+    assert m.drain_metric_records() == []
+    # dead node's pending report purged
+    m.drop_node("n1")
+    assert m.summarize_scheduling()["pending_total"] == 0
+
+
+def test_record_decision_disabled_is_noop_and_cheap():
+    """The perf-gate companion (see test_perf_gate): per-decision
+    recording must be a dict update, and the disabled path a single
+    attribute check."""
+    from ray_tpu.core.node_manager import NodeManager
+
+    nm = NodeManager.__new__(NodeManager)
+    nm._cluster_events_enabled = False
+    nm._sched_decisions = {}
+    nm._sched_dirty = False
+    nm._record_decision({"CPU": 1.0}, None, "granted")
+    assert nm._sched_decisions == {}
+    nm._cluster_events_enabled = True
+    from ray_tpu._internal.ids import NodeID
+
+    nm.node_id = NodeID.random()
+    nm._record_decision({"CPU": 1.0}, None, "granted",
+                        queue_wait_s=0.25)
+    nm._record_decision({"CPU": 1.0}, None, "spillback", hop=1,
+                        reason="spilled")
+    d = nm._sched_decisions["CPU:1"]
+    assert d["granted"] == 1 and d["spillback"] == 1
+    assert d["queued"] == 1 and d["max_spill_hops"] == 2
+    assert len(d["recent"]) == 2 and nm._sched_dirty
+
+
+# --------------------------------------------------------- single node
+def test_decision_traces_and_events_live(local_cluster):
+    """Running tasks leaves granted-verdict traces per demand shape,
+    and the event log carries the cluster's lifecycle so far."""
+    from ray_tpu import state_api
+
+    @rt.remote
+    def traced(x):
+        return x * 2
+
+    assert rt.get([traced.remote(i) for i in range(12)]) == \
+        [2 * i for i in range(12)]
+
+    def got_traces():
+        s = state_api.summarize_scheduling()
+        shape = s["shapes"].get("CPU:1")
+        return s if shape and shape["granted"] >= 1 else None
+
+    s = _wait_for(got_traces, desc="granted decision traces")
+    assert "CPU:1" in s["shapes"]
+    assert s["totals"]["granted"] >= 1
+    events = state_api.list_cluster_events(limit=0)
+    kinds = {e["kind"] for e in events}
+    assert "node_registered" in kinds and "job_started" in kinds
+    # the status surface joins it all
+    st = state_api.cluster_status()
+    assert len(st["nodes"]) == 1
+    n = st["nodes"][0]
+    assert n["alive"] and n["heartbeat_age_s"] is not None
+    assert "pending_leases" in n and "scheduling" in st
+
+    # CLI rendering of the enriched status (testable print helper)
+    from ray_tpu.scripts.cli import _print_cluster_status
+
+    _print_cluster_status(st)
+
+
+def test_infeasible_error_names_shape_and_why_pending(local_cluster):
+    """Satellite: the submitter-side infeasible error names the demand
+    shape, the nearest-fit node's view, and points at why-pending."""
+    @rt.remote(resources={"no_such_resource": 4.0}, max_retries=0)
+    def impossible():
+        return 1
+
+    with pytest.raises(Exception) as ei:
+        rt.get(impossible.remote(), timeout=60)
+    msg = str(ei.value)
+    assert "demand shape" in msg
+    assert "no_such_resource:4" in msg
+    assert "why-pending" in msg
+    assert "Nearest fit" in msg
+
+
+def test_cancelled_pending_lease_releases_slot(local_cluster):
+    """Satellite fix: a lease parked in _pending_leases whose caller
+    goes away records a `cancelled` verdict and releases its queue
+    slot — instead of eventually granting a worker to nobody (leaking
+    the worker + resources forever)."""
+    import asyncio
+
+    from ray_tpu import state_api
+    from ray_tpu._internal.rpc import connect
+    from ray_tpu.core.object_ref import get_core_worker
+
+    @rt.remote(num_cpus=4)
+    class Hog:
+        def ping(self):
+            return 1
+
+    hog = Hog.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == 1
+
+    cw = get_core_worker()
+    host, port = cw.node_address.host, cw.node_address.port
+
+    async def park_then_vanish():
+        conn = await connect(host, port)
+        fut = asyncio.ensure_future(conn.call(
+            "request_lease", ({"CPU": 1.0}, False, None, 1, 0),
+            timeout=60))
+        await asyncio.sleep(1.0)  # parked in _pending_leases by now
+        await conn.close()        # caller gone
+        try:
+            await fut
+        except Exception:
+            pass
+
+    cw.io.run(park_then_vanish())
+
+    def cancelled_recorded():
+        s = state_api.summarize_scheduling()
+        shape = s["shapes"].get("CPU:1")
+        return shape if shape and shape["cancelled"] >= 1 else None
+
+    shape = _wait_for(cancelled_recorded, desc="cancelled verdict")
+    assert shape["cancelled"] >= 1
+    # the queue slot is gone: once the hog dies, a fresh task gets the
+    # resources immediately (a leaked slot would have grabbed them)
+    rt.kill(hog)
+
+    @rt.remote
+    def after():
+        return "ok"
+
+    assert rt.get(after.remote(), timeout=60) == "ok"
+    st = state_api.cluster_status()
+    assert st["nodes"][0]["pending_leases"] == 0
+
+
+def test_cancel_queued_task_client_side(local_cluster):
+    """The PR-5 cancel-wins path still composes with queued leases: a
+    task cancelled while its lease waits behind a saturated node fails
+    as CANCELLED, and the eventually-granted lease is returned (next
+    task runs cleanly)."""
+    @rt.remote(num_cpus=4)
+    class Hog:
+        def ping(self):
+            return 1
+
+    hog = Hog.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == 1
+
+    @rt.remote
+    def queued():
+        return 1
+
+    ref = queued.remote()
+    time.sleep(0.5)  # its lease request is parked at the node manager
+    rt.cancel(ref)
+    with pytest.raises(Exception):
+        rt.get(ref, timeout=30)
+    rt.kill(hog)
+
+    @rt.remote
+    def after():
+        return "ok"
+
+    assert rt.get(after.remote(), timeout=60) == "ok"
+
+
+# ------------------------------------------------------------- chaos
+AS_CONFIG = {
+    # fake provider with max_slices=0: autoscaler_active=True (so
+    # infeasible tasks keep retrying — the why-pending window) but the
+    # cluster never actually grows
+    "provider": {"type": "fake"},
+    "node_types": [{"name": "never", "resources_per_host": {"CPU": 1.0},
+                    "hosts": 1, "max_slices": 0}],
+    "reconcile_interval_s": 0.5,
+}
+
+
+@pytest.fixture
+def chaos_cluster():
+    from ray_tpu._internal.config import get_config
+
+    # short infeasible-retry window: the driver-side deadline that
+    # bounds how long the doomed task below stays pending (default 30s
+    # would dominate the test's wall time)
+    cfg = get_config()
+    old_lease_timeout = cfg.lease_timeout_s
+    cfg.lease_timeout_s = 8.0
+    cluster = Cluster(head_resources={"CPU": 2.0},
+                      autoscaler_config=AS_CONFIG)
+    node_b = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+    cluster.connect()
+    try:
+        yield cluster, node_b
+    finally:
+        cfg.lease_timeout_s = old_lease_timeout
+        cluster.shutdown()
+
+
+def test_chaos_lite_kill_worker_and_node(chaos_cluster):
+    """Acceptance E2E: kill a worker and a node mid-load — both produce
+    caused, severity-tagged events; `rayt status` reflects the lost
+    node; why-pending distinguishes feasible-but-busy from infeasible
+    for tasks queued behind the lost capacity."""
+    from ray_tpu import state_api
+
+    cluster, node_b = chaos_cluster
+
+    # ---- load + kill a busy worker ----
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    def slow_blue(t):
+        time.sleep(t)
+        return os.getpid()
+
+    ref = slow_blue.remote(5.0)
+
+    def busy_worker():
+        for w in state_api.list_workers():
+            if w.get("busy") and w.get("node_id") == node_b.node_id_hex \
+                    and not w.get("actor_id"):
+                return w
+        return None
+
+    victim = _wait_for(busy_worker, desc="busy worker on node B")
+    os.kill(victim["pid"], signal.SIGKILL)
+
+    def worker_died_event():
+        evs = state_api.list_cluster_events(severity="WARNING", limit=0)
+        for e in evs:
+            if e["kind"] == "worker_died" and \
+                    e["node_id"] == node_b.node_id_hex:
+                return e
+        return None
+
+    ev = _wait_for(worker_died_event, desc="worker_died event")
+    assert ev["severity"] == "WARNING"
+    assert "exit code" in ev["message"]
+    assert ev["data"]["pid"] == victim["pid"]
+    # the killed task retries and still completes
+    assert isinstance(rt.get(ref, timeout=120), int)
+
+    # ---- feasible-but-busy: hog every blue CPU, queue another ----
+    @rt.remote(num_cpus=2, resources={"blue": 2.0})
+    class BlueHog:
+        def ping(self):
+            return 1
+
+    hog = BlueHog.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == 1
+    busy_ref = slow_blue.remote(0.0)  # parks behind the hog (kept
+    # referenced so the submit stays live while why-pending inspects it)
+
+    def pending_blue_record():
+        for t in state_api.list_tasks(name="slow_blue", limit=0):
+            if t["state"] not in ("RUNNING", "FINISHED", "FAILED",
+                                  "CANCELLED"):
+                return t
+        return None
+
+    trec = _wait_for(pending_blue_record, desc="pending blue task")
+    why = state_api.why_pending(trec["task_id"])
+    assert why["found"] and why["pending"]
+    assert why["verdict"] == "feasible_but_busy"
+    assert "FEASIBLE BUT BUSY" in why["explanation"]
+    assert any(v["fits_ever"] for v in why["nodes"].values())
+
+    # ---- kill node B mid-load ----
+    cluster.remove_node(node_b, graceful=False)
+
+    def node_dead_event():
+        evs = state_api.list_cluster_events(severity="ERROR", limit=0)
+        for e in evs:
+            if e["kind"] == "node_dead" and \
+                    e["node_id"] == node_b.node_id_hex:
+                return e
+        return None
+
+    ev = _wait_for(node_dead_event, desc="node_dead event")
+    assert "dead" in ev["message"]
+    assert ev["data"].get("cause")
+
+    # `rayt status` reflects the loss within a heartbeat interval
+    def status_shows_dead():
+        st = state_api.cluster_status()
+        rows = {n["node_id"]: n for n in st["nodes"]}
+        b = rows.get(node_b.node_id_hex)
+        return st if b is not None and not b["alive"] else None
+
+    st = _wait_for(status_shows_dead, timeout=15,
+                   desc="status shows node B dead")
+
+    # ---- infeasible: blue capacity is GONE cluster-wide ----
+    results = {}
+
+    def submit_doomed():
+        @rt.remote(resources={"blue": 1.0}, max_retries=0)
+        def needs_blue():
+            return 1
+
+        r = needs_blue.remote()
+        try:
+            results["value"] = rt.get(r, timeout=90)
+        except Exception as e:
+            results["error"] = str(e)
+
+    th = threading.Thread(target=submit_doomed, daemon=True)
+    th.start()
+
+    def pending_infeasible():
+        for t in state_api.list_tasks(name="needs_blue", limit=0):
+            if t["state"] not in ("RUNNING", "FINISHED", "FAILED",
+                                  "CANCELLED"):
+                why = state_api.why_pending(t["task_id"])
+                if why.get("pending"):
+                    return why
+        return None
+
+    why = _wait_for(pending_infeasible, desc="pending infeasible task")
+    assert why["verdict"] == "infeasible"
+    assert "blue" in why["short_resources"]
+    assert "INFEASIBLE cluster-wide" in why["explanation"]
+
+    # CLI rendering of the join (testable print helper)
+    from ray_tpu.scripts.cli import _print_why_pending
+
+    _print_why_pending(why)
+
+    th.join(timeout=120)
+    assert "error" in results  # the doomed task did fail in the end
+    assert "demand shape" in results["error"]
+    rt.kill(hog)
+
+
+def test_worker_oom_reap_event():
+    """Satellite: the memory-monitor reap path emits a
+    worker_oom_reaped cluster event carrying RSS at reap time."""
+    os.environ["RAYT_MEMORY_USAGE_THRESHOLD"] = "0.01"
+    os.environ["RAYT_MEMORY_MONITOR_INTERVAL_S"] = "0.2"
+    cluster = Cluster(head_resources={"CPU": 2.0})
+    try:
+        cluster.connect()
+        from ray_tpu import state_api
+
+        @rt.remote(num_cpus=1, max_retries=0)
+        def doomed():
+            time.sleep(30)
+            return 1
+
+        ref = doomed.remote()
+
+        def oom_event():
+            evs = state_api.list_cluster_events(severity="WARNING",
+                                                limit=0)
+            for e in evs:
+                if e["kind"] == "worker_oom_reaped":
+                    return e
+            return None
+
+        ev = _wait_for(oom_event, timeout=60,
+                       desc="worker_oom_reaped event")
+        assert ev["severity"] == "WARNING"
+        assert ev["data"]["rss_bytes"] > 0
+        assert ev["data"]["memory_fraction"] >= 0.01
+        assert "OOM-reaped" in ev["message"]
+        del ref
+    finally:
+        os.environ.pop("RAYT_MEMORY_USAGE_THRESHOLD", None)
+        os.environ.pop("RAYT_MEMORY_MONITOR_INTERVAL_S", None)
+        cluster.shutdown()

@@ -45,6 +45,11 @@ def test_metrics_prometheus_export(dash_cluster):
 
 
 def test_state_endpoints(dash_cluster):
+    """State endpoints + the new /api/events (filtered cluster event
+    log) and /api/cluster (enriched status: node table with heartbeat
+    age + pending leases, per-shape pending demand, scheduling rollup,
+    recent WARNING+ events — the Cluster tab feed). One cluster boot
+    serves all of them."""
     @rt.remote(num_cpus=0)
     class Marker:
         def ping(self):
@@ -60,6 +65,53 @@ def test_state_endpoints(dash_cluster):
     status = json.loads(
         _get(dash_cluster.dashboard_port, "/api/cluster_status"))
     assert status["num_nodes"] >= 1
+
+    @rt.remote
+    def ping(x):
+        return x
+
+    assert rt.get([ping.remote(i) for i in range(4)]) == [0, 1, 2, 3]
+    port = dash_cluster.dashboard_port
+
+    deadline = time.monotonic() + 30
+    events = []
+    while time.monotonic() < deadline:
+        out = json.loads(_get(port, "/api/events?limit=0"))
+        events = out["events"]
+        if any(e["kind"] == "worker_started" for e in events):
+            break
+        time.sleep(0.3)
+    kinds = {e["kind"] for e in events}
+    assert "node_registered" in kinds
+    assert "worker_started" in kinds
+    assert all({"ts", "severity", "source", "kind", "message"}
+               <= set(e) for e in events)
+    # severity filter is a minimum: INFO events drop out at WARNING
+    warn = json.loads(_get(port, "/api/events?severity=WARNING&limit=0"))
+    assert all(e["severity"] in ("WARNING", "ERROR")
+               for e in warn["events"])
+    # source + kind filters hit AND miss
+    src = json.loads(_get(port, "/api/events?source=gcs&limit=0"))
+    assert src["total"] >= 1
+    assert all(e["source"] == "gcs" for e in src["events"])
+    none = json.loads(_get(port, "/api/events?kind=no_such_kind"))
+    assert none["total"] == 0
+
+    cstat = json.loads(_get(port, "/api/cluster"))
+    assert len(cstat["nodes"]) == 1
+    n = cstat["nodes"][0]
+    assert n["alive"] and n["heartbeat_age_s"] is not None
+    assert "pending_leases" in n and "resources_available" in n
+    assert "pending_demand" in cstat and "scheduling" in cstat
+    assert "recent_events" in cstat
+    # the decision traces flowed: granted leases for the CPU:1 shape
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        cstat = json.loads(_get(port, "/api/cluster"))
+        if cstat["scheduling"].get("granted", 0) >= 1:
+            break
+        time.sleep(0.3)
+    assert cstat["scheduling"]["granted"] >= 1
 
 
 def test_job_submission_lifecycle(dash_cluster, tmp_path):
@@ -147,6 +199,7 @@ def test_index_page_serves_spa(dash_cluster):
     assert html.lstrip().startswith("<!DOCTYPE html>")
     for endpoint in ("/api/nodes", "/api/actors", "/api/jobs",
                      "/api/serve", "/api/data", "/api/cluster_status",
+                     "/api/cluster", "/api/events",
                      "/api/tasks", "/api/tasks/summary",
                      "/api/objects", "/api/objects/summary",
                      "/api/dags",
@@ -155,12 +208,16 @@ def test_index_page_serves_spa(dash_cluster):
         assert endpoint in html, endpoint
     # the SPA's interactive pieces: tab views, sparkline canvas charts,
     # incremental log tailing, task failure drill-down, object rollups,
-    # DAG edge tables with occupancy/throughput sparklines
+    # DAG edge tables with occupancy/throughput sparklines, the
+    # Cluster tab's event stream + pending-demand table + per-node
+    # heartbeat sparklines
     for marker in ("view-metrics", "view-serve", "view-timeline",
                    "view-tasks", "task-summary", "task-err",
                    "view-objects", "object-summary", "view-data",
                    "data-exchanges", "view-dags", "dag-list",
-                   "dag-edges", "sparkline", "offset="):
+                   "dag-edges", "sparkline", "offset=",
+                   "cluster-events", "pending-demand", "event-warn",
+                   "rayt_node_heartbeat_gap_s"):
         assert marker in html, marker
     # one <script> block = one top-level scope: a duplicate const/let/
     # function declaration is a parse-time SyntaxError that kills the

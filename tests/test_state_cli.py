@@ -101,6 +101,26 @@ def test_cli_start_status_stop(tmp_path):
         assert r.returncode == 0, r.stderr
         out = json.loads(r.stdout)
         assert out["dags"] == [] and out["total"] == 0
+
+        # cluster event log plumbing: the head's own registration is
+        # already an event; severity filter drops INFO
+        r = cli("list", "events", "--severity", "INFO",
+                "--address", address)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert any(e["kind"] == "node_registered" for e in out["events"])
+        assert all(e["severity"] != "DEBUG" for e in out["events"])
+
+        # enriched status: node table with heartbeat age + pending
+        r = cli("status", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "nodes:" in r.stdout and "hb-age" in r.stdout
+        assert "ALIVE" in r.stdout
+
+        # why-pending plumbing (no such task)
+        r = cli("why-pending", "deadbeef", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "no task record matches" in r.stdout
     finally:
         r = cli("stop")
         assert r.returncode == 0, r.stderr

@@ -155,6 +155,68 @@ def measure_shuffle(rt, *, mib: int = 128, legacy_mib: int = 32,
     }
 
 
+def measure_sched(rt, cluster, target_nodes: int = 8,
+                  oversubscribe: float = 6.0):
+    """Scheduling decision-plane observability leg (ISSUE 11):
+    oversubscribe a small multi-node fleet with short 1-CPU tasks so
+    leases grant, queue, and spill across nodes, then read the GCS
+    decision-trace rollup — spillback-hop and queue-wait percentiles
+    come straight from the coalesced per-shape trace (the same feed
+    `rayt status` / `rayt why-pending` render)."""
+    from ray_tpu import state_api
+
+    view = cluster._cluster_view()
+    for _ in range(max(0, target_nodes - len(view))):
+        cluster.add_node(num_cpus=2)
+    view = cluster._cluster_view()
+    total_cpus = sum(v.get("total", {}).get("CPU", 0.0)
+                     for v in view.values() if v.get("alive"))
+
+    @rt.remote(num_cpus=1)
+    def sched_probe(t):
+        time.sleep(t)
+        return 1
+
+    # long enough that the wave outlives the grant burst: leases must
+    # actually park (queue-wait) and spill across nodes, or the trace
+    # has nothing to show
+    n = int(total_cpus * oversubscribe)
+    t0 = time.monotonic()
+    assert all(rt.get([sched_probe.remote(0.25) for _ in range(n)],
+                      timeout=900))
+    wall = time.monotonic() - t0
+    time.sleep(2.5)  # sched reports ride the 1s heartbeat cadence
+    s = state_api.summarize_scheduling()
+    shape = s["shapes"].get("CPU:1", {})
+    waits = sorted(r.get("queue_wait_s", 0.0)
+                   for r in shape.get("recent", ())
+                   if r.get("queue_wait_s", 0.0) > 0.0)
+
+    def pct(p):
+        if not waits:
+            return 0.0
+        return round(waits[min(len(waits) - 1,
+                               int(p * len(waits)))], 4)
+
+    return {
+        "nodes": len(view), "cluster_cpus": total_cpus, "tasks": n,
+        "wall_s": round(wall, 2),
+        "tasks_per_s": round(n / wall, 1),
+        "granted": shape.get("granted", 0),
+        "queued": shape.get("queued", 0),
+        "spillbacks": shape.get("spillback", 0),
+        "infeasible": shape.get("infeasible", 0),
+        "max_spill_hops": shape.get("max_spill_hops", 0),
+        "queue_wait_p50_s": pct(0.50),
+        "queue_wait_p95_s": pct(0.95),
+        "queue_wait_max_s": round(shape.get("queue_wait_max_s", 0.0),
+                                  4),
+        "queue_wait_total_s": round(
+            shape.get("queue_wait_s_total", 0.0), 3),
+        "pending_peak_reported": s.get("pending_total", 0),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=16)
@@ -344,6 +406,11 @@ def main():
              "task-based exchange shuffle (pipelined map/reduce, "
              "columnar kernels)",
              lambda: measure_shuffle(rt))
+
+        _leg(results, "sched_decision_traces", "decisions",
+             "lease verdicts coalesced per demand shape: grant/queue/"
+             "spill/infeasible + queue-wait percentiles + hop chains",
+             lambda: measure_sched(rt, cluster))
 
         def broadcast():
             arr = np.zeros(args.broadcast_mib << 20, np.uint8)
