@@ -170,6 +170,55 @@ def run_microbenchmarks(duration: float = 2.0) -> list[dict]:
     prod.close()
     cons.close()
 
+    # device channel tick rate: same-client handoff of a jax.Array —
+    # the value OBJECT moves producer->consumer with no serialize /
+    # deserialize round trip on the hot path (the acceptance bar: this
+    # must beat the shm ring's tick rate for jax.Array payloads)
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.channel import ShmChannel
+    from ray_tpu.dag.device_channel import (DeviceChannel,
+                                            DeviceChannelSpec,
+                                            DeviceTransportChannel,
+                                            attach_device)
+
+    dev = DeviceChannel.create(n_slots=8)
+    dpeer = attach_device(dev.spec)
+    small_dev = jnp.zeros(1024, jnp.float32)
+
+    def dev_window():
+        for _ in range(8):
+            dev.write(small_dev)
+        for _ in range(8):
+            dpeer.read(timeout=60)
+
+    results.append(_timeit("dag_device_ticks_per_second", dev_window, 8,
+                           duration))
+    dpeer.close()
+    dev.close()
+
+    # device-edge bandwidth over the CROSS-PROCESS framing: a 1 MiB
+    # jax.Array as raw shard bytes through a shm ring (scatter write)
+    # with a device_put rebuild on the consumer side — the byte path a
+    # compiled-DAG device edge pays between processes
+    inner = ShmChannel.create(slot_size=2 << 20, n_slots=4)
+    dspec = DeviceChannelSpec(name=inner.spec.name, inner=inner.spec)
+    dprod = DeviceTransportChannel(inner, dspec)
+    dcons = DeviceTransportChannel(ShmChannel.attach(inner.spec), dspec)
+    mib_dev = jnp.zeros(1 << 18, jnp.float32)  # 1 MiB
+
+    def dev_gb():
+        dprod.write(mib_dev)
+        dcons.read(timeout=60)
+
+    r = _timeit("dag_device_gigabytes_per_second", dev_gb, 1,
+                max(duration, 1.0))
+    r["rate_per_s"] = round(r["rate_per_s"] * mib_dev.nbytes / (1 << 30),
+                            3)
+    results.append(r)
+    dcons.close()
+    dprod.close()
+
     for a in (c, ac, e1, e2, e3):
         rt.kill(a)
     return results

@@ -37,13 +37,36 @@ def is_device_value(value: Any) -> bool:
     return isinstance(value, jax.Array)
 
 
-def serialize_array(arr) -> tuple:
-    """jax.Array -> (raw host bytes, dtype str, shape). Gathers sharded
-    arrays to host (the cross-process path is host-staged by design —
-    ICI transfers happen inside jit, not here)."""
+def host_shard_view(arr):
+    """jax.Array -> host numpy view of its payload, WITHOUT the full
+    gather when one addressable shard already covers the whole array
+    (single-shard, or fully replicated — the common case for weights):
+    ship that shard's bytes directly instead of routing through jax's
+    gather path. Truly sharded arrays still gather to host — the
+    cross-process plane is host-staged by design (ICI transfers happen
+    inside jit, not here)."""
     import numpy as np
 
-    np_val = np.asarray(arr)  # device_get; zero-copy if already on host
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        try:
+            one = shards[0].data
+            covers = (tuple(one.shape) == tuple(arr.shape)
+                      and (len(shards) == 1
+                           or bool(getattr(arr, "is_fully_replicated",
+                                           False))))
+        except Exception:
+            covers = False
+        if covers:
+            return np.asarray(one)  # zero-copy on CPU clients
+    return np.asarray(arr)  # device_get; gathers sharded arrays
+
+
+def serialize_array(arr) -> tuple:
+    """jax.Array -> (raw host bytes, dtype str, shape). Single-shard /
+    fully-replicated arrays ship one addressable shard's bytes (see
+    host_shard_view); only truly sharded arrays gather to host."""
+    np_val = host_shard_view(arr)
     return (np_val.tobytes(), str(np_val.dtype), np_val.shape)
 
 

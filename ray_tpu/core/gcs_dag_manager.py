@@ -99,11 +99,16 @@ class GcsDagManager:
                 "producer": _endpoint(e.get("producer")),
                 "consumer": _endpoint(e.get("consumer")),
                 "kind": e.get("kind", "shm"),
+                # shm|dcn beneath a device edge (same as kind otherwise)
+                "transport": e.get("transport", e.get("kind", "shm")),
                 "channel": e.get("channel", ""),
                 "n_slots": int(e.get("n_slots", 0)),
                 "slot_size": int(e.get("slot_size", 0)),
                 "role": e.get("role", "edge"),   # input | edge | output
-                # producer-side cumulatives
+                # producer-side cumulatives (device_arrays counts the
+                # jax.Array leaves shipped as raw shard bytes on a
+                # kind=device edge; stays 0 on host edges)
+                "device_arrays": 0,
                 "ticks": 0, "bytes": 0, "write_block_s": 0.0,
                 # consumer-side cumulatives
                 "reads": 0, "read_block_s": 0.0, "occupancy": 0,
@@ -156,6 +161,10 @@ class GcsDagManager:
                     entry.get("write_blocked_s_now", 0.0))
                 if entry.get("credits") is not None:
                     edge["credits"] = int(entry["credits"])
+                if entry.get("device_arrays") is not None:
+                    edge["device_arrays"] = max(
+                        edge["device_arrays"],
+                        int(entry["device_arrays"]))
                 self._emit_edge_metrics(dag_id, edge_id, ts,
                                         ticks=d_ticks, nbytes=d_bytes,
                                         write_block_s=d_wblock)

@@ -6,6 +6,10 @@ from ray_tpu.dag import collective  # noqa: F401
 from ray_tpu.dag.channel import ShmChannel  # noqa: F401
 from ray_tpu.dag.channel_exec import ChannelCompiledDAG  # noqa: F401
 from ray_tpu.dag.dcn_channel import DcnChannelSpec  # noqa: F401
+from ray_tpu.dag.device_channel import (DeviceChannel,  # noqa: F401
+                                        DeviceChannelSpec,
+                                        DeviceTransportChannel,
+                                        donating_jit)
 from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode,  # noqa: F401
                               FunctionNode, InputNode, MultiOutputNode)

@@ -17,13 +17,18 @@ Channel selection is PER EDGE at compile time:
     existing RPC plane (dag/dcn_channel.py: persistent peer connection,
     scatter-gather frames, credit window == n_slots) — multi-node actor
     graphs stay on the fast path instead of falling back to the
-    4x-slower per-call executor.
+    4x-slower per-call executor,
+  * edges whose payloads are jax.Arrays (the producer node is marked
+    ``.with_tensor_transport()``, or the compile sets
+    ``device_input=True`` for the driver's weight-broadcast edges)
+    -> DEVICE kind (dag/device_channel.py): the same shm/DCN transport
+    underneath, but jax.Array leaves ride as raw shard bytes +
+    dtype/shape metadata (never a host pickle of the device buffer)
+    and rebuild on the consumer's devices during the read.
 
 Eligibility (else ``compile_channels`` raises ``Ineligible`` and the
 caller falls back to the per-call executor in dag/compiled.py):
-  * every compute node is a ClassMethodNode (actors only),
-  * no device edges (tensor_transport) — those ride the device-object
-    plane, whose payloads should NOT transit host channels.
+  * every compute node is a ClassMethodNode (actors only).
 
 Per-tick error semantics mirror the reference: an exception in one actor
 is wrapped and FLOWS along the graph edges (consumers skip compute and
@@ -45,6 +50,9 @@ from ray_tpu._internal.logging_utils import setup_logger
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 from ray_tpu.dag.dcn_channel import (DcnProducerChannel, _dcn_create_endpoints,
                                      attach_channel, create_endpoint)
+from ray_tpu.dag.device_channel import (DeviceChannelSpec,
+                                        DeviceTransportChannel,
+                                        pack_device_tree)
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode, InputAttributeNode,
                               InputNode, MultiOutputNode)
 
@@ -244,12 +252,35 @@ def _dag_loop_body(self, sched: _ActorSchedule):
                 [("consumer", ch) for ch in ins]
                 + [("producer", ch) for ch in outs])
             reporter.start()
+        in_mesh = False
+        # per-collective-op AGREED lowering decision, settled on the
+        # op's first tick (op.pos -> bool | "broken"): the in-mesh path
+        # requires EVERY rank to run the same jitted program, so a rank
+        # must never pick it from its local value type (or its local
+        # view of the mesh) alone — a split would leave ranks parked
+        # between the GSPMD collective and the out-of-band group,
+        # deadlocked. EVERY rank joins the settle gather and posts its
+        # own (value_is_device, in_mesh) pair, so a rank whose
+        # fingerprint rendezvous failed converges the whole group to
+        # the out-of-band path instead of silently diverging from it.
+        mesh_lowering: dict[int, Any] = {}
         if sched.collective_group:
+            from ray_tpu.dag import collective as dag_collective
             from ray_tpu.util.collective import init_collective_group
 
             group = init_collective_group(
                 sched.collective_world, sched.collective_rank,
                 group_name=sched.collective_group)
+            # one-time rendezvous: do the participants share ONE mesh?
+            # If so, reductions lower to a jitted psum/GSPMD collective
+            # (in-mesh) and the out-of-band group stays as the
+            # cross-mesh fallback for host values.
+            try:
+                fps = group.gather_obj(
+                    dag_collective.client_fingerprint())
+                in_mesh = dag_collective.mesh_shared(fps)
+            except Exception:
+                in_mesh = False
         tick_no = 0
         while True:
             reads: dict[int, Any] = {}
@@ -315,9 +346,53 @@ def _dag_loop_body(self, sched: _ActorSchedule):
                     result = flowed          # error flows along edges
                 elif op.collective:
                     kind, red_op = op.collective.split(":")
-                    assert kind == "allreduce"
+                    assert kind in ("allreduce", "allgather"), kind
                     try:
-                        result = group.allreduce(args[0], op=red_op)
+                        from ray_tpu.dag import collective as dagc
+
+                        use_mesh = mesh_lowering.get(op.pos)
+                        if use_mesh is None:
+                            # first tick of this op: AGREE on the
+                            # lowering — in-mesh only when every rank
+                            # sees the shared mesh AND contributes a
+                            # device value (one flag gather EVERY rank
+                            # joins, then cached for the DAG's
+                            # lifetime; the compiled schedule feeds
+                            # each op the same method's output every
+                            # tick, so the flavor is stable)
+                            try:
+                                flags = group.gather_obj(
+                                    (dagc.value_on_device(args[0]),
+                                     in_mesh))
+                                use_mesh = all(dev and mesh
+                                               for dev, mesh in flags)
+                            except Exception:
+                                # a half-completed settle must never be
+                                # retried: the ranks that DID settle
+                                # will not join a re-issued gather, so
+                                # retrying would park this rank against
+                                # nobody every tick. Mark the op broken
+                                # (sticky) — each tick errors fast and
+                                # visibly instead.
+                                mesh_lowering[op.pos] = "broken"
+                                raise
+                            mesh_lowering[op.pos] = use_mesh
+                        elif use_mesh == "broken":
+                            raise RuntimeError(
+                                "collective lowering rendezvous failed "
+                                "on an earlier tick; recompile the DAG "
+                                "to re-settle this op")
+                        if use_mesh is True:
+                            # shared mesh + device values: one jitted
+                            # XLA collective, no out-of-band hop
+                            result = (dagc.in_mesh_allreduce(
+                                args[0], red_op)
+                                if kind == "allreduce"
+                                else dagc.in_mesh_allgather(args[0]))
+                        elif kind == "allreduce":
+                            result = group.allreduce(args[0], op=red_op)
+                        else:
+                            result = group.allgather(args[0])
                     except Exception as e:
                         import traceback
 
@@ -394,18 +469,23 @@ class _ChanPlan:
     """One channel to materialize. ``owner`` is the CONSUMER process:
     None = the driver (creates shm rings and driver-side DCN endpoints
     locally), else the id()-key of the consuming actor handle (its
-    worker creates the DCN endpoint via one compile-time RPC)."""
-    kind: str                 # "shm" | "dcn"
+    worker creates the DCN endpoint via one compile-time RPC).
+    ``device`` layers the raw-shard-bytes jax.Array framing
+    (dag/device_channel.py) over the transport — the edge's reported
+    kind is then "device" and ``kind`` names the transport beneath."""
+    kind: str                 # transport: "shm" | "dcn"
     owner: int | None         # None = driver
     n_slots: int
     slot_size: int
+    device: bool = False      # device edge (jax.Array payload framing)
     spec: Any = None          # filled at materialization
     handle: Any = None        # driver-held handle, when the driver is a peer
 
 
 class ChannelCompiledDAG:
     def __init__(self, output_node: DAGNode, topo: list[DAGNode],
-                 buffer_size_bytes: int = 1 << 20, max_inflight: int = 8):
+                 buffer_size_bytes: int = 1 << 20, max_inflight: int = 8,
+                 device_input: bool = False):
         self.output_node = output_node
         self._closed = False
         self._tick = 0
@@ -423,8 +503,6 @@ class ChannelCompiledDAG:
                               MultiOutputNode, ClassMethodNode)):
                 continue
             raise Ineligible(f"unsupported node type {type(n).__name__}")
-        if any(getattr(n, "tensor_transport", False) for n in compute):
-            raise Ineligible("device edges use the device-object plane")
 
         from ray_tpu._internal.config import get_config
         from ray_tpu.api import _core_worker
@@ -445,8 +523,11 @@ class ChannelCompiledDAG:
         plan_ends: list[tuple] = []   # (producer_key, consumer_key) per plan
 
         def plan_channel(consumer_key: int | None,
-                         producer_key: int | None) -> int:
-            """consumer/producer: id(actor handle), or None = driver."""
+                         producer_key: int | None,
+                         device: bool = False) -> int:
+            """consumer/producer: id(actor handle), or None = driver.
+            ``device`` layers the jax.Array raw-shard-bytes framing
+            over whichever transport the endpoints select."""
             plan_ends.append((producer_key, consumer_key))
             c_node = my_node if consumer_key is None else \
                 placement[consumer_key]
@@ -456,7 +537,7 @@ class ChannelCompiledDAG:
                 # same node as the driver: driver-created shm ring
                 # reaches both peers (driver, or actors on this node)
                 plans.append(_ChanPlan("shm", None, slots,
-                                       buffer_size_bytes))
+                                       buffer_size_bytes, device=device))
             else:
                 # DCN endpoint lives in the CONSUMER'S process — always
                 # the consuming actor's worker (even when that actor
@@ -464,7 +545,7 @@ class ChannelCompiledDAG:
                 # the consumer side at attach is per-process, not
                 # per-node); None = the driver itself consumes (outputs)
                 plans.append(_ChanPlan("dcn", consumer_key, slots,
-                                       buffer_size_bytes))
+                                       buffer_size_bytes, device=device))
             return len(plans) - 1
 
         scheds: dict[int, _ActorSchedule] = {}     # id(actor) -> schedule
@@ -486,7 +567,13 @@ class ChannelCompiledDAG:
                         up.actor is not n.actor:
                     key = (id(up), id(n.actor))
                     if key not in edge_in:
-                        plan_idx = plan_channel(id(n.actor), id(up.actor))
+                        # the producer node's annotation decides the
+                        # edge kind: with_tensor_transport() payloads
+                        # are jax.Arrays and ride the device framing
+                        plan_idx = plan_channel(
+                            id(n.actor), id(up.actor),
+                            device=bool(getattr(up, "tensor_transport",
+                                                False)))
                         sched.in_channels.append(plan_idx)
                         edge_in[key] = len(sched.in_channels) - 1
                         # producer writes the same channel
@@ -505,7 +592,7 @@ class ChannelCompiledDAG:
                 for up in n._upstream())
             has_reads = bool(sched.in_channels)
             if needs_input or not has_reads:
-                plan_idx = plan_channel(aid, None)
+                plan_idx = plan_channel(aid, None, device=device_input)
                 sched.in_channels.append(plan_idx)
                 sched.input_ch = len(sched.in_channels) - 1
                 self._input_plan_idx.append(plan_idx)
@@ -522,7 +609,9 @@ class ChannelCompiledDAG:
             if not isinstance(on, ClassMethodNode):
                 raise Ineligible("outputs must be actor method results")
             sched = sched_for(on.actor)
-            plan_idx = plan_channel(None, id(on.actor))
+            plan_idx = plan_channel(
+                None, id(on.actor),
+                device=bool(getattr(on, "tensor_transport", False)))
             sched.out_channels.append(plan_idx)
             sched._out_idx = getattr(sched, "_out_idx", {})
             sched._out_idx.setdefault(id(on), []).append(
@@ -582,8 +671,20 @@ class ChannelCompiledDAG:
 
     def _init_channels(self, plans, plan_ends, actors, scheds):
         self._materialize_channels(plans, actors)
-        self.channel_kinds = {"shm": sum(p.kind == "shm" for p in plans),
-                              "dcn": sum(p.kind == "dcn" for p in plans)}
+        # device plans: wrap the transport spec/handle in the jax.Array
+        # raw-shard-bytes framing (actors attach the wrapped flavor via
+        # the spec; the driver's handles wrap here)
+        for p in plans:
+            if p.device:
+                p.spec = DeviceChannelSpec(name=_chan_key(p.spec),
+                                           inner=p.spec)
+                if p.handle is not None:
+                    p.handle = DeviceTransportChannel(p.handle, p.spec)
+        self.channel_kinds = {
+            "shm": sum(p.kind == "shm" and not p.device for p in plans),
+            "dcn": sum(p.kind == "dcn" and not p.device for p in plans),
+            "device": sum(p.device for p in plans),
+        }
 
         # schedules now carry real specs instead of plan indices
         for sched in scheds.values():
@@ -597,8 +698,23 @@ class ChannelCompiledDAG:
         for i in self._input_plan_idx:
             p = plans[i]
             if p.handle is None:          # actor-owned DCN endpoint
-                p.handle = DcnProducerChannel(p.spec, self._cw)
+                inner_spec = (p.spec.inner
+                              if isinstance(p.spec, DeviceChannelSpec)
+                              else p.spec)
+                h = DcnProducerChannel(inner_spec, self._cw)
+                p.handle = (DeviceTransportChannel(h, p.spec)
+                            if p.device else h)
             self._input_channels.append(p.handle)
+        # the broadcast in execute() serializes once per framing flavor
+        # (today device_input marks ALL input edges at once, so exactly
+        # one of these lists is non-empty; the split keeps execute()
+        # correct if per-actor device inputs ever land)
+        self._host_input_channels = [
+            ch for ch in self._input_channels
+            if not getattr(ch, "is_device", False)]
+        self._device_input_channels = [
+            ch for ch in self._input_channels
+            if getattr(ch, "is_device", False)]
         self._output_channels = [plans[i].handle
                                  for i in self._output_plan_idx]
         # every driver-held handle, each closed exactly once at teardown
@@ -606,7 +722,9 @@ class ChannelCompiledDAG:
                                  if p.handle is not None]
         # map driver-held channels back to their wire identity for
         # teardown logging + timeout diagnostics
-        self._chan_kind = {_chan_key(p.spec): p.kind for p in plans}
+        self._chan_kind = {_chan_key(p.spec):
+                           ("device" if p.device else p.kind)
+                           for p in plans}
 
         # ---- register the DAG with the GCS ------------------------------
         # synchronous: the record (edge topology + channel kinds) must
@@ -727,7 +845,9 @@ class ChannelCompiledDAG:
                     else "output" if cons is None else "edge")
             edges.append({
                 "edge": f"e{i}", "channel": _chan_key(p.spec),
-                "kind": p.kind, "n_slots": p.n_slots,
+                "kind": "device" if p.device else p.kind,
+                "transport": p.kind,   # shm|dcn beneath a device edge
+                "n_slots": p.n_slots,
                 "slot_size": p.slot_size, "role": role,
                 "producer": endpoint(prod), "consumer": endpoint(cons),
             })
@@ -847,14 +967,27 @@ class ChannelCompiledDAG:
                                     tick=self._tick)
         with span:
             carrier = otel.current_context_carrier()
-            if carrier is not None:
-                value = _TraceTick(carrier, self._tick, value)
-            # serialize ONCE, scatter the same chunk list into every
-            # input channel (N-runner broadcasts pay one serialize)
-            chunks = serialize(value)
-            total = serialized_size(chunks)
-            for ch in self._input_channels:
-                ch.write_chunks(chunks, total, timeout=timeout)
+
+            def _wrap(v):
+                return (_TraceTick(carrier, self._tick, v)
+                        if carrier is not None else v)
+
+            # serialize ONCE PER FRAMING FLAVOR, scatter the same chunk
+            # list into every input channel of that flavor (N-runner
+            # broadcasts pay one serialize; a mixed host+device input
+            # set pays two)
+            if self._host_input_channels:
+                chunks = serialize(_wrap(value))
+                total = serialized_size(chunks)
+                for ch in self._host_input_channels:
+                    ch.write_chunks(chunks, total, timeout=timeout)
+            if self._device_input_channels:
+                packed, n_arrays = pack_device_tree(value)
+                chunks = serialize(_wrap(packed))
+                total = serialized_size(chunks)
+                for ch in self._device_input_channels:
+                    ch.write_chunks(chunks, total, timeout=timeout)
+                    ch.add_device_arrays(n_arrays)
         ref = ChannelDagRef(self, self._tick)
         self._tick += 1
         return ref

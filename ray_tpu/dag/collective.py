@@ -1,17 +1,28 @@
 """Collective nodes for compiled DAGs (ref analog:
 python/ray/dag/collective_node.py:19, experimental/collective/allreduce.py).
 
-``allreduce.bind([n1, ..., nk])`` inserts one collective op per
-participating actor: each actor contributes its upstream node's value and
-receives the reduced result in-loop. On the channel fast path the
-reduction runs over the out-of-band collective group
-(util/collective, GCS-KV rendezvous — the NCCL-group analog); the
-per-call fallback executor reduces via the object store on the driver.
+``allreduce.bind([n1, ..., nk])`` / ``allgather.bind([...])`` insert one
+collective op per participating actor: each actor contributes its
+upstream node's value and receives the reduced/gathered result in-loop.
 
-For values living on a TPU mesh the right tool is usually an in-mesh
-``psum`` inside one jit — DAG collectives are the MPMD-level reduction
-between separate SPMD programs (e.g. pipeline stages exchanging host
-scalars/metrics, or data-parallel actors averaging host gradients).
+Lowering is TWO-TIER on the channel fast path:
+
+* **In-mesh** (psum/GSPMD inside one jit): when every participant
+  shares ONE device mesh — each rank is one jax process of a
+  multi-controller client addressing the same global device set
+  (``mesh_shared`` over the fingerprints the ranks exchange at group
+  init), or the degenerate world of one — the reduction lowers to a
+  single jitted XLA collective over ICI. Device values never leave the
+  chips and nothing gathers to the driver or transits TCP.
+* **Out-of-band fallback** (cross-mesh): the host-plane
+  ``util/collective`` group (GCS-KV rendezvous, rank-0 star / peer
+  ring) — the NCCL-group analog for actors whose clients do NOT share
+  a mesh (the common CPU-actor case). The per-call fallback executor
+  reduces the same way via one-shot groups.
+
+For values living on a TPU mesh *within one SPMD program* the right
+tool remains a plain ``psum`` inside the program's own jit — DAG
+collectives are the MPMD-level reduction between separate programs.
 """
 
 from __future__ import annotations
@@ -21,23 +32,31 @@ import uuid
 from ray_tpu.dag.node import ClassMethodNode
 
 
-class _AllreduceBinder:
+class _CollectiveBinder:
+    """Shared bind machinery: one collective op node per participant,
+    all members of one group (world = len(nodes), rank = position)."""
+
+    kind = "allreduce"
+    has_op = True
+
     def bind(self, nodes: list, op: str = "sum",
              group_name: str | None = None) -> list:
         if not nodes:
-            raise ValueError("allreduce.bind needs at least one node")
+            raise ValueError(f"{self.kind}.bind needs at least one node")
         if not all(isinstance(n, ClassMethodNode) for n in nodes):
-            raise TypeError("allreduce.bind takes actor-method nodes")
+            raise TypeError(f"{self.kind}.bind takes actor-method nodes")
         actors = {id(n.actor) for n in nodes}
         if len(actors) != len(nodes):
             raise ValueError(
-                "allreduce participants must be distinct actors")
-        name = group_name or f"dag-ar-{uuid.uuid4().hex[:8]}"
+                f"{self.kind} participants must be distinct actors")
+        name = group_name or f"dag-{self.kind[:2]}-{uuid.uuid4().hex[:8]}"
+        spec = (f"{self.kind}:{op}" if self.has_op else f"{self.kind}:-")
         out = []
         for rank, n in enumerate(nodes):
-            node = ClassMethodNode(n.actor, "__collective_allreduce__",
+            node = ClassMethodNode(n.actor,
+                                   f"__collective_{self.kind}__",
                                    (n,), {})
-            node.collective = f"allreduce:{op}"
+            node.collective = spec
             node.collective_group = name
             node.collective_rank = rank
             node.collective_world = len(nodes)
@@ -45,4 +64,143 @@ class _AllreduceBinder:
         return out
 
 
-allreduce = _AllreduceBinder()
+class _AllgatherBinder(_CollectiveBinder):
+    kind = "allgather"
+    has_op = False
+
+    def bind(self, nodes: list,
+             group_name: str | None = None) -> list:
+        return super().bind(nodes, group_name=group_name)
+
+
+allreduce = _CollectiveBinder()
+allgather = _AllgatherBinder()
+
+
+# ------------------------------------------------- in-mesh lowering
+
+def client_fingerprint():
+    """This process's jax-client identity, exchanged between collective
+    participants at group init so ``mesh_shared`` can decide whether
+    the group addresses ONE mesh. None when jax is unavailable."""
+    try:
+        import jax
+
+        return (int(jax.process_index()), int(jax.process_count()),
+                tuple(str(d) for d in jax.devices()),
+                len(jax.local_devices()))
+    except Exception:
+        return None
+
+
+def mesh_shared(fingerprints: list) -> bool:
+    """True when every participant is one controller of the SAME mesh:
+    identical global device view, process_count == world, each rank one
+    distinct process_index, one addressable device per rank (the MPMD
+    actor shape — each actor owns one chip of the slice). A world of
+    one trivially shares its own mesh. CPU actor fleets — each its own
+    single-process client whose device view merely LOOKS identical —
+    fail the process_count check and stay out-of-band."""
+    world = len(fingerprints)
+    if world == 1:
+        # a lone participant shares "its mesh" only when its client IS
+        # a single-process one — one controller of a multi-process mesh
+        # must not dispatch a whole-mesh collective alone (the other
+        # controllers would never run the program)
+        return fingerprints[0] is not None and fingerprints[0][1] == 1
+    if any(f is None for f in fingerprints):
+        return False
+    if len({f[2] for f in fingerprints}) != 1:
+        return False                       # different global device views
+    if {f[1] for f in fingerprints} != {world}:
+        return False                       # not world-many mesh controllers
+    if any(f[3] != 1 for f in fingerprints):
+        return False                       # >1 chip per rank: shape unclear
+    return sorted(f[0] for f in fingerprints) == list(range(world))
+
+
+def value_on_device(value) -> bool:
+    from ray_tpu.core.device_objects import is_device_value
+
+    return is_device_value(value)
+
+
+_REDUCERS = {"sum": "sum", "prod": "prod", "min": "min", "max": "max"}
+_identity_jit = None
+
+
+def _identity():
+    global _identity_jit
+    if _identity_jit is None:
+        import jax
+
+        _identity_jit = jax.jit(lambda x: x)
+    return _identity_jit
+
+
+def in_mesh_allreduce(value, op: str = "sum"):
+    """One jitted XLA reduction over the shared mesh — the participant
+    calls this instead of the out-of-band group, and XLA moves the
+    bytes over ICI (GSPMD). World of one: the reduction is the
+    identity, lowered through one jit so the value stays on device."""
+    import jax
+    import jax.numpy as jnp
+
+    if op not in _REDUCERS:
+        raise ValueError(f"in-mesh allreduce does not support op {op!r}")
+    arr = jnp.asarray(value)
+    if jax.process_count() == 1:
+        return _identity()(arr)
+    return _in_mesh_stack_reduce(arr, op)            # pragma: no cover
+
+
+def in_mesh_allgather(value) -> list:
+    """In-mesh twin of the out-of-band allgather: returns the
+    participants' values in rank order, device-resident."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(value)
+    if jax.process_count() == 1:
+        return [_identity()(arr)]
+    return list(_in_mesh_stack_gather(arr))          # pragma: no cover
+
+
+def _global_stack(arr):                              # pragma: no cover
+    """Stack each controller's contribution along a 'ranks' mesh axis:
+    rank i's value becomes shard i of a global [world, ...] array (one
+    addressable device per rank — checked by mesh_shared)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("ranks",))
+    sharding = NamedSharding(mesh, P("ranks"))
+    local = [jax.device_put(arr[None], d) for d in jax.local_devices()]
+    global_arr = jax.make_array_from_single_device_arrays(
+        (len(devs),) + tuple(arr.shape), sharding, local)
+    return global_arr, mesh
+
+
+def _in_mesh_stack_reduce(arr, op: str):             # pragma: no cover
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    global_arr, mesh = _global_stack(arr)
+    red = getattr(jnp, _REDUCERS[op])
+    out_sharding = NamedSharding(mesh, P())           # replicated result
+    return jax.jit(lambda x: red(x, axis=0),
+                   out_shardings=out_sharding)(global_arr)
+
+
+def _in_mesh_stack_gather(arr):                      # pragma: no cover
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    global_arr, mesh = _global_stack(arr)
+    out_sharding = NamedSharding(mesh, P())
+    gathered = jax.jit(lambda x: x,
+                       out_shardings=out_sharding)(global_arr)
+    return [gathered[i] for i in range(gathered.shape[0])]

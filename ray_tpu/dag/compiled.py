@@ -1,10 +1,11 @@
 """Per-call compiled DAG execution — the FALLBACK executor.
 
 Eligible DAGs compile onto pre-allocated channels (shm rings node-local,
-DCN ring channels cross-node) with frozen per-actor schedules instead
-(dag/channel_exec.py — the fast path, ref analog:
-python/ray/dag/compiled_dag_node.py:757 + dag_node_operation.py);
-this module handles the rest: function nodes and device edges.
+DCN ring channels cross-node, device channels for jax.Array edges) with
+frozen per-actor schedules instead (dag/channel_exec.py — the fast
+path, ref analog: python/ray/dag/compiled_dag_node.py:757 +
+dag_node_operation.py); this module handles the rest: graphs with
+function nodes.
 
 compile() topologically sorts the graph once and freezes the submission
 plan; execute() replays it with object refs wired producer→consumer, so
@@ -38,14 +39,17 @@ def _collective_apply_fallback(self, gname: str, world: int, rank: int,
                                spec: str, value):
     """Runs on the member actor via __rayt_apply__: one-shot out-of-band
     reduction for the per-call executor (the channel executor keeps a
-    long-lived group instead)."""
+    long-lived group instead, and lowers in-mesh when the participants
+    share one device mesh)."""
     from ray_tpu.util.collective import init_collective_group
 
     kind, op = spec.split(":")
-    assert kind == "allreduce", spec
+    assert kind in ("allreduce", "allgather"), spec
     group = init_collective_group(world, rank, group_name=gname)
     try:
-        return group.allreduce(value, op=op)
+        if kind == "allreduce":
+            return group.allreduce(value, op=op)
+        return group.allgather(value)
     finally:
         try:
             group.destroy()

@@ -359,9 +359,14 @@ def create_endpoint(token: str, n_slots: int, slot_size: int,
 
 
 def attach_channel(spec):
-    """Attach either channel flavor from its serializable spec: the
+    """Attach any channel flavor from its serializable spec: device
+    specs wrap their inner transport in the jax.Array framing; the
     process that registered a DCN token gets the consumer side, any
     other process the producer side; shm specs attach as before."""
+    from ray_tpu.dag.device_channel import DeviceChannelSpec, attach_device
+
+    if isinstance(spec, DeviceChannelSpec):
+        return attach_device(spec)
     if isinstance(spec, DcnChannelSpec):
         with _registry_lock:
             sink = _sinks.get(spec.token)

@@ -1,0 +1,428 @@
+"""Device-resident channels for compiled DAGs — the third per-edge kind.
+
+Ref analog: python/ray/experimental/channel/torch_tensor_nccl_channel.py
+(compiled-graph GPU channels: tensors move producer→consumer without a
+host pickle bounce). The TPU-native split (core/device_objects.py):
+
+* **Same-client** producer/consumer (one process, one jax client —
+  ``DeviceChannel``): a tick hands the jax.Array OBJECT over — no
+  serialize, no copy, no host staging. Writing transfers ownership
+  (the donation contract below), so the consumer may feed the array
+  straight into a donating jit and let XLA reuse the buffer in place.
+* **Cross-process** edges (``DeviceTransportChannel``): the payload
+  rides the EXISTING shm-ring / DCN framing, but jax.Array leaves are
+  re-framed as raw shard bytes + dtype/shape metadata
+  (``pack_device_tree``): the host view of one addressable shard
+  (zero-copy on CPU clients; replicated arrays ship ONE shard —
+  ``device_objects.host_shard_view``) travels as a pickle-5 OUT-OF-BAND
+  buffer, scatter-written into the ring slot — the pickle stream itself
+  never contains the device buffer. The consumer rebuilds with
+  ``jax.device_put`` DURING deserialize, so the value is resident on
+  its devices the moment ``read`` returns.
+
+Donation contract (the ``donate_argnums``/``donation_vector`` pjit
+machinery): an array written to a device edge is RELINQUISHED by the
+producer — it must not read or mutate it afterwards. That is what makes
+it legal for the consumer to donate the edge-supplied args into its
+jitted compute (``donating_jit`` derives the donation vector from the
+edge arity). Holding a read value ACROSS ticks:
+
+* same-client: safe — ownership transferred with the object;
+* cross-process: the rebuilt array may alias the ring slot when the
+  local client's ``device_put`` is zero-copy, so the shm slot-pin rule
+  applies transparently (the pin releases when the array dies); copy
+  out (``jnp.array(v, copy=True)``) anything held for many ticks, the
+  same copy-on-hold discipline as host edges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass
+from typing import Any
+
+from ray_tpu.dag.channel import ChannelClosed, ChannelStats
+
+
+@dataclass(frozen=True)
+class DeviceChannelSpec:
+    """Serializable descriptor for a device edge. ``name`` is the stable
+    wire identity (the inner channel's, so both ends' dag-state reports
+    coalesce onto one edge); ``inner`` is the transport spec (shm ring
+    or DCN endpoint) — None marks a same-client-only channel resolved
+    through the in-process registry."""
+    name: str
+    inner: Any = None
+
+
+# ------------------------------------------------- device payload framing
+
+def _is_jax_array(value) -> bool:
+    from ray_tpu.core.device_objects import is_device_value
+
+    return is_device_value(value)
+
+
+def _rebuild_leaf(np_view, dtype, shape):
+    """Runs INSIDE the consumer's deserialize: raw shard bytes ->
+    jax.Array on the local devices. dtype/shape ride for wire-format
+    parity with device_objects.serialize_array (np_view carries both)."""
+    import jax
+
+    return jax.device_put(np_view)
+
+
+class _DeviceLeaf:
+    """One jax.Array leaf crossing a device edge. ``__reduce__`` emits
+    raw shard bytes + metadata — never a pickle of the device buffer:
+    the host shard view goes OUT OF BAND (pickle-5 buffer, scatter-
+    written by the transport), only dtype/shape enter the stream, and
+    unpickling lands the value on the consumer's devices via
+    ``device_put``."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __reduce__(self):
+        from ray_tpu.core.device_objects import host_shard_view
+
+        np_val = host_shard_view(self.arr)
+        return (_rebuild_leaf, (np_val, str(np_val.dtype), np_val.shape))
+
+
+def pack_device_tree(value) -> tuple[Any, int]:
+    """Replace every jax.Array leaf of a dict/list/tuple pytree with a
+    ``_DeviceLeaf`` so serialization ships raw shard bytes instead of a
+    host pickle of the buffer. Returns ``(packed, n_arrays)`` —
+    ``n_arrays == 0`` means the payload had no device leaves and the
+    packed value is the original. Pre-wrapped ``_DeviceLeaf`` values
+    (``wrap_host_arrays``) count as packed. The walk covers the
+    containers DAG payloads are built from; a jax.Array nested inside
+    an opaque object would fall back to its own (host-copy) reducer."""
+    n = 0
+
+    def walk(v):
+        nonlocal n
+        if isinstance(v, _DeviceLeaf):
+            n += 1
+            return v
+        if _is_jax_array(v):
+            n += 1
+            return _DeviceLeaf(v)
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(walk(x) for x in v)
+        return v
+
+    packed = walk(value)
+    return (packed if n else value), n
+
+
+def wrap_host_arrays(tree) -> tuple[Any, int]:
+    """Mark a HOST numpy pytree for the device framing without staging
+    it onto the producer's devices first: each np.ndarray leaf becomes
+    a ``_DeviceLeaf`` (its bytes already live on host — shipping pays
+    zero extra copies) and the consumer's read rebuilds it on ITS
+    devices via device_put. This is the weight-broadcast producer path
+    for drivers that hold host weights: `device_put` + pack would do a
+    wasted H2D+D2H round trip of every leaf per broadcast."""
+    import numpy as np
+
+    n = 0
+
+    def walk(v):
+        nonlocal n
+        if isinstance(v, np.ndarray):
+            n += 1
+            return _DeviceLeaf(v)
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(walk(x) for x in v)
+        return v
+
+    wrapped = walk(tree)
+    return (wrapped if n else tree), n
+
+
+def tree_nbytes(value) -> int:
+    """Raw array bytes in a payload (device + numpy leaves) — the
+    same-client channel's bytes accounting."""
+    if isinstance(value, dict):
+        return sum(tree_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(tree_nbytes(v) for v in value)
+    return int(getattr(value, "nbytes", 0) or 0)
+
+
+def count_device_leaves(value) -> int:
+    """jax.Array leaves in a payload (same-client stats accounting)."""
+    if isinstance(value, dict):
+        return sum(count_device_leaves(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(count_device_leaves(v) for v in value)
+    return 1 if _is_jax_array(value) else 0
+
+
+# ------------------------------------------------------- donation helpers
+
+def donation_argnums_for(n_edge_args: int, offset: int = 0) -> tuple:
+    """Donation vector derived from edge arity: the consumer's jitted
+    compute takes its device-edge inputs as ``offset..offset+n-1`` and
+    may donate exactly those (the producer relinquished them on
+    write)."""
+    return tuple(range(offset, offset + n_edge_args))
+
+
+def donating_jit(fn, n_edge_args: int, offset: int = 0,
+                 extra_donate: tuple = ()):
+    """``jax.jit`` with the donation vector derived from the edge arity
+    (plus any explicitly-owned extra args, e.g. an optimizer state that
+    never leaves the process). XLA reuses donated buffers in place;
+    buffers it cannot donate (e.g. views aliasing a ring slot) fall
+    back to a copy — donation is an optimization, never a hazard."""
+    import jax
+
+    donate = tuple(sorted(set(donation_argnums_for(n_edge_args, offset))
+                          | set(extra_donate)))
+    return jax.jit(fn, donate_argnums=donate)
+
+
+# --------------------------------------------- same-client (one process)
+
+_local_lock = threading.Lock()
+_local_handoffs: dict[str, "_LocalHandoff"] = {}
+
+
+class _LocalHandoff:
+    """The shared state behind a same-client channel: a bounded SPSC
+    deque of jax.Array payloads (objects, not bytes)."""
+
+    def __init__(self, n_slots: int):
+        self.n_slots = n_slots
+        self.items: deque = deque()
+        self.cv = threading.Condition()
+        self.closed = False
+
+
+class DeviceChannel:
+    """Same-client device channel: producer and consumer share one jax
+    client, so a tick hands the array OBJECT over — no serialize, no
+    deserialize, no copy on the hot path. Ownership transfers with the
+    write (donation contract), which is what lets the consumer donate
+    the value into its jitted compute. SPSC by usage, same as the shm
+    ring."""
+
+    is_device = True
+
+    def __init__(self, handoff: _LocalHandoff, spec: DeviceChannelSpec):
+        self._handoff = handoff
+        self.spec = spec
+        self._closed_locally = False
+        self.stats = ChannelStats()
+        self.device_arrays = 0
+
+    @classmethod
+    def create(cls, n_slots: int = 8,
+               name: str | None = None) -> "DeviceChannel":
+        token = name or f"devchan-{uuid.uuid4().hex[:16]}"
+        handoff = _LocalHandoff(max(2, n_slots))
+        with _local_lock:
+            _local_handoffs[token] = handoff
+        return cls(handoff, DeviceChannelSpec(name=token, inner=None))
+
+    # ------------------------------------------------------------ protocol
+    def write(self, value, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        h = self._handoff
+        st = self.stats
+        with h.cv:
+            while len(h.items) >= h.n_slots:
+                if h.closed:
+                    st.end_write_block()
+                    raise ChannelClosed()
+                if st.write_blocked_since is None:
+                    st.write_blocked_since = time.monotonic()
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    st.end_write_block()
+                    raise TimeoutError(
+                        "device channel write timed out (handoff full)")
+                h.cv.wait(timeout=(remaining if remaining is not None
+                                   else 1.0))
+            if h.closed:
+                st.end_write_block()
+                raise ChannelClosed()
+            st.end_write_block()
+            h.items.append(value)
+            h.cv.notify_all()
+        st.writes += 1
+        st.bytes_written += tree_nbytes(value)
+        self.device_arrays += count_device_leaves(value)
+
+    def read(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        h = self._handoff
+        st = self.stats
+        with h.cv:
+            while not h.items:
+                if h.closed:
+                    st.end_read_block()
+                    raise ChannelClosed()
+                if st.read_blocked_since is None:
+                    st.read_blocked_since = time.monotonic()
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    st.end_read_block()
+                    raise TimeoutError(
+                        "device channel read timed out (handoff empty)")
+                h.cv.wait(timeout=(remaining if remaining is not None
+                                   else 1.0))
+            st.end_read_block()
+            value = h.items.popleft()
+            h.cv.notify_all()
+        st.reads += 1
+        st.bytes_read += tree_nbytes(value)
+        return value
+
+    # ------------------------------------------------------ observability
+    def occupancy(self) -> int:
+        return len(self._handoff.items)
+
+    def cursor_state(self) -> tuple[int, int]:
+        st = self.stats
+        return st.reads, st.reads + len(self._handoff.items)
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["occupancy"] = self.occupancy()
+        snap["pinned_slots"] = 0
+        snap["n_slots"] = self._handoff.n_slots
+        snap["device_arrays"] = self.device_arrays
+        return snap
+
+    def close(self):
+        if self._closed_locally:
+            return  # idempotent: closed exactly once per holder
+        self._closed_locally = True
+        with _local_lock:
+            if _local_handoffs.get(self.spec.name) is self._handoff:
+                _local_handoffs.pop(self.spec.name, None)
+        h = self._handoff
+        with h.cv:
+            h.closed = True
+            h.cv.notify_all()
+
+
+# --------------------------------------------- cross-process transport
+
+class DeviceTransportChannel:
+    """Device edge over an existing host transport (shm ring or DCN
+    channel): values are re-framed by ``pack_device_tree`` on write so
+    jax.Array leaves ride as raw shard bytes, and rebuilt on the
+    consumer's devices during the inner channel's deserialize. All flow
+    control, blocking, close and stats semantics are the inner
+    channel's — this wrapper only swaps the payload framing."""
+
+    is_device = True
+
+    def __init__(self, inner, spec: DeviceChannelSpec | None = None):
+        self._inner = inner
+        inner_spec = inner.spec
+        self.spec = spec or DeviceChannelSpec(
+            name=(getattr(inner_spec, "name", None)
+                  or getattr(inner_spec, "token", "")),
+            inner=inner_spec)
+        self.device_arrays = 0   # producer-side packed leaf count
+        self._closed_locally = False
+
+    # ------------------------------------------------------------ protocol
+    def write(self, value, timeout: float | None = None):
+        # the actor loop hands us the (possibly trace-enveloped) tick
+        # payload; pack the value inside so the envelope stays intact
+        from ray_tpu.dag.channel_exec import _TraceTick
+
+        if type(value) is _TraceTick:
+            packed, n = pack_device_tree(value.value)
+            if n:
+                value = _TraceTick(value.carrier, value.tick, packed)
+        else:
+            value, n = pack_device_tree(value)
+        self.device_arrays += n
+        self._inner.write(value, timeout=timeout)
+
+    def write_chunks(self, chunks: list, total: int | None = None,
+                     timeout: float | None = None):
+        """Pre-packed broadcast path (the driver serializes a packed
+        payload ONCE and scatters it; it accounts device_arrays via
+        add_device_arrays)."""
+        self._inner.write_chunks(chunks, total, timeout=timeout)
+
+    def add_device_arrays(self, n: int):
+        self.device_arrays += n
+
+    def read(self, timeout: float | None = None):
+        # Shm ring inner: COPY the slot payload (read_bytes — the slot
+        # releases deterministically) and rebuild over the private
+        # bytes. The zero-copy slot view is deliberately NOT used here:
+        # jax's dispatch can trap device_put's host input in a
+        # reference cycle that only a FULL gc collects (observed on jax
+        # 0.4.37 — a promoted straggler survives the
+        # most-recent-call-frees-previous pattern), and a trapped slot
+        # view pins the ring until the producer stalls, which no slot
+        # count fixes. A trapped private buffer is ordinary heap
+        # garbage instead. DCN inners already deserialize over a
+        # private receive buffer, so they keep their native read.
+        if hasattr(self._inner, "read_bytes"):
+            from ray_tpu._internal.serialization import deserialize
+
+            payload = self._inner.read_bytes(timeout=timeout)
+            return deserialize(payload)
+        return self._inner.read(timeout=timeout)
+
+    # ------------------------------------------------------ observability
+    @property
+    def stats(self) -> ChannelStats:
+        return self._inner.stats
+
+    def occupancy(self) -> int:
+        return self._inner.occupancy()
+
+    def cursor_state(self) -> tuple[int, int]:
+        return self._inner.cursor_state()
+
+    def snapshot(self) -> dict:
+        snap = self._inner.snapshot()
+        snap["device_arrays"] = self.device_arrays
+        return snap
+
+    def close(self):
+        if self._closed_locally:
+            return
+        self._closed_locally = True
+        self._inner.close()
+
+
+def attach_device(spec: DeviceChannelSpec):
+    """Attach a device channel from its spec: the process holding the
+    same-client handoff gets the direct side; everyone else attaches
+    the inner transport and gets the raw-shard-bytes framing."""
+    with _local_lock:
+        handoff = _local_handoffs.get(spec.name)
+    if handoff is not None:
+        return DeviceChannel(handoff, spec)
+    if spec.inner is None:
+        raise ChannelClosed(
+            f"same-client device channel {spec.name!r} is not registered "
+            "in this process and has no transport spec")
+    from ray_tpu.dag.dcn_channel import attach_channel
+
+    return DeviceTransportChannel(attach_channel(spec.inner), spec)

@@ -14,14 +14,19 @@ class DAGNode:
 
     def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
                              max_inflight: int = 8,
-                             channels: object = "auto") -> "object":
+                             channels: object = "auto",
+                             device_input: bool = False) -> "object":
         """Compile the DAG. channels="auto" uses the pre-allocated
         channel fast path (dag/channel_exec.py) when the graph is
-        eligible (actor-only, host edges): node-local edges ride shm
-        rings, cross-node edges ride DCN channels over the RPC plane.
-        Falls back to the per-call executor only for function nodes and
-        device edges; True forces channels (raises if ineligible);
-        False forces the per-call executor."""
+        eligible (actor-only): node-local edges ride shm rings,
+        cross-node edges ride DCN channels over the RPC plane, and
+        edges whose producer is marked ``.with_tensor_transport()``
+        ride DEVICE channels (jax.Array leaves as raw shard bytes,
+        rebuilt on the consumer's devices). ``device_input=True`` marks
+        the driver's input edges device too (weight broadcasts).
+        Falls back to the per-call executor only for function nodes;
+        True forces channels (raises if ineligible); False forces the
+        per-call executor."""
         from ray_tpu.dag.compiled import CompiledDAG
 
         if channels in ("auto", True):
@@ -32,7 +37,8 @@ class DAGNode:
                 return ChannelCompiledDAG(
                     self, CompiledDAG._topo_sort(self),
                     buffer_size_bytes=buffer_size_bytes,
-                    max_inflight=max_inflight)
+                    max_inflight=max_inflight,
+                    device_input=device_input)
             except Ineligible:
                 if channels is True:
                     raise

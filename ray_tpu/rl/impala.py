@@ -83,6 +83,14 @@ class IMPALAConfig:
     # driver-side bookkeeping the bottleneck — drain this many updates
     # per iteration (soft 5s cap keeps slow-env iterations bounded)
     min_updates_per_iteration: int = 4
+    # device edges (dag/device_channel.py — the Anakin shape): the
+    # aggregator→learner batch edge and the learner→driver weights edge
+    # carry jax.Arrays as raw shard bytes (never a host pickle of the
+    # buffer), batches land on the learner's devices during the read,
+    # weights broadcast back over a device input edge, and the learner's
+    # update jit DONATES the edge-supplied batch (donation vector from
+    # edge arity). False restores host framing on every edge.
+    use_device_edges: bool = True
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
@@ -110,13 +118,28 @@ def _tree_leaves(tree):
 
 
 def _tree_copy(tree):
-    """Copy a param pytree's arrays (jax-free): the copy-on-hold rule
-    for values retained across compiled-DAG ticks."""
+    """Copy a param pytree's arrays — the copy-on-hold rule for values
+    retained across compiled-DAG ticks. jax.Array leaves (device-edge
+    weights) copy into a FRESH device buffer so a rebuilt array that
+    zero-copy-aliased its ring slot never pins the ring across ticks;
+    the check stays jax-free on the host path (jax only loads when a
+    device leaf has already loaded it)."""
     if isinstance(tree, dict):
         return {k: _tree_copy(v) for k, v in tree.items()}
     if isinstance(tree, (list, tuple)):
         return type(tree)(_tree_copy(v) for v in tree)
-    return np.array(tree) if isinstance(tree, np.ndarray) else tree
+    if isinstance(tree, np.ndarray):
+        return np.array(tree)
+    import sys
+
+    if "jax" in sys.modules:
+        from ray_tpu.core.device_objects import is_device_value
+
+        if is_device_value(tree):
+            import jax.numpy as jnp
+
+            return jnp.array(tree, copy=True)
+    return tree
 
 
 def _sample_fragment_nbytes(module_cfg, rollout_fragment_length: int,
@@ -176,6 +199,24 @@ class AggregatorActor:
             if b is not None:
                 batches.append(b)
         return batches
+
+    def add_many_device(self, min_batch_timesteps: int, *samples) -> list:
+        """Device-edge tick (``use_device_edges``): ready batches leave
+        as jax.Arrays so the aggregator→learner edge ships raw shard
+        bytes and the learner's read lands them on ITS devices — the
+        batch never takes a host-pickle round trip."""
+        batches = self.add_many(min_batch_timesteps, *samples)
+        if not batches:
+            return batches
+        import jax
+
+        out = []
+        for b in batches:
+            returns = b.pop("episode_returns")
+            b = {k: jax.device_put(v) for k, v in b.items()}
+            b["episode_returns"] = returns
+            out.append(b)
+        return out
 
     def ping(self) -> bool:
         return True
@@ -276,7 +317,17 @@ class IMPALALearner:
 
             return _optax.apply_updates(params, updates), new_opt, aux
 
-        self._update = jax.jit(update)
+        if self.cfg.use_device_edges:
+            # the batch is the edge-supplied arg (arity 1, position 2):
+            # the producer relinquished it on write, so the update jit
+            # DONATES it and XLA reuses the buffers in place (buffers
+            # it cannot donate — e.g. a view aliasing a ring slot —
+            # fall back to a copy, never a hazard)
+            from ray_tpu.dag.device_channel import donating_jit
+
+            self._update = donating_jit(update, n_edge_args=1, offset=2)
+        else:
+            self._update = jax.jit(update)
 
         from ray_tpu.rl.connectors import default_learner_pipeline
 
@@ -343,7 +394,12 @@ class IMPALALearner:
                                  + out["updates"])
         if out["updates"] and \
                 self._since_broadcast >= self.cfg.broadcast_interval:
-            out["weights"] = self.get_weights()
+            # device edges broadcast the params DEVICE-RESIDENT: the
+            # output edge ships raw shard bytes straight off the update
+            # result (no np.asarray host copy of every leaf per
+            # broadcast); the host path keeps the numpy copy
+            out["weights"] = (self.params if self.cfg.use_device_edges
+                              else self.get_weights())
             self._since_broadcast = 0
         return out
 
@@ -451,15 +507,26 @@ class IMPALA:
 
         cfg = self.config
         runners = self._runners.healthy_actors()
+        agg_method = ("add_many_device" if cfg.use_device_edges
+                      else "add_many")
         with InputNode() as inp:
             samples = [r.sample_dag.bind(inp, cfg.rollout_fragment_length)
                        for r in runners]
             n_agg = len(self._aggregators)
             agg_outs = [
-                self._aggregators[k].add_many.bind(
+                getattr(self._aggregators[k], agg_method).bind(
                     cfg.train_batch_size, *samples[k::n_agg])
                 for k in range(n_agg)]
+            if cfg.use_device_edges:
+                # agg→learner batches + learner→driver weights ride
+                # device edges (raw shard bytes, zero host pickle);
+                # runner→agg fragments are host numpy and stay on the
+                # host framing
+                for node in agg_outs:
+                    node.with_tensor_transport()
             out = self._learner.step.bind(*agg_outs)
+            if cfg.use_device_edges:
+                out.with_tensor_transport()
         # slot sizing: the widest edge is agg→learner, which can carry a
         # whole tick's worth of batches (every runner's fragment,
         # re-concatenated) — and a RELEASED batch holds up to
@@ -483,7 +550,10 @@ class IMPALA:
                   batch_bytes, weights_nbytes, 1 << 20)
         self._dag = out.experimental_compile(
             buffer_size_bytes=buf,
-            max_inflight=max(2, cfg.max_requests_in_flight))
+            max_inflight=max(2, cfg.max_requests_in_flight),
+            # weight broadcasts over the input edges ride the device
+            # framing too, closing the on-device loop driver-side
+            device_input=cfg.use_device_edges)
 
     def _train_dag(self) -> dict:
         """One iteration on the compiled DAG: keep `max_requests_in_flight`

@@ -46,6 +46,11 @@ class PPOConfig:
     # the on-policy batch per update). False restores per-call sampling.
     use_compiled_dag: bool = True
     sample_waves: int = 2
+    # device edges: wave-0's weight broadcast rides a DEVICE input edge
+    # (dag/device_channel.py — jax.Array leaves as raw shard bytes,
+    # rebuilt on each runner's devices; never a host pickle of the
+    # buffers). False restores host framing on the input edges.
+    use_device_edges: bool = True
 
     def learner_config(self) -> PPOLearnerConfig:
         return PPOLearnerConfig(
@@ -119,7 +124,8 @@ class PPO:
         ) + (1 << 16)
         self._dag = node.experimental_compile(
             buffer_size_bytes=max(sample_nbytes, weights_nbytes, 1 << 20),
-            max_inflight=max(2, cfg.sample_waves + 1))
+            max_inflight=max(2, cfg.sample_waves + 1),
+            device_input=cfg.use_device_edges)
 
     # ------------------------------------------------------------------ train
     def train(self) -> dict:
@@ -132,7 +138,16 @@ class PPO:
             # update happens between waves)
             from ray_tpu.util import builtin_metrics as _bm
 
-            refs = [self._dag.execute(self._weights if k == 0 else None)
+            w0 = self._weights
+            if cfg.use_device_edges:
+                # device input edges ship raw shard bytes: mark the
+                # HOST weight leaves for the framing directly —
+                # device_put-then-pack would round-trip every leaf
+                # H2D+D2H on an accelerator-backed driver for nothing
+                from ray_tpu.dag.device_channel import wrap_host_arrays
+
+                w0, _ = wrap_host_arrays(w0)
+            refs = [self._dag.execute(w0 if k == 0 else None)
                     for k in range(max(1, cfg.sample_waves))]
             # PPO stays on-policy: staleness is bounded by the wave
             # count (all waves sample the weights broadcast on wave 0)

@@ -425,10 +425,11 @@ def _print_dag(rec: dict):
           f"job={rec['job_id'][:12]}  edges={rec['num_edges']} ({kinds})"
           + (f"  stalled={len(rec['stalled_edges'])}"
              if rec["stalled_edges"] else ""))
-    fmt = "{:<4} {:<7} {:<30} {:<5} {:>8} {:>12} {:>5} {:>9} {:>9}  {}"
+    fmt = ("{:<4} {:<7} {:<30} {:<10} {:>8} {:>12} {:>6} {:>5} "
+           "{:>9} {:>9}  {}")
     print(fmt.format("edge", "role", "producer->consumer", "kind",
-                     "ticks", "bytes", "occ", "w-block", "r-block",
-                     "stall"))
+                     "ticks", "bytes", "arrs", "occ", "w-block",
+                     "r-block", "stall"))
     for e in rec["edges"]:
         pair = f"{e['producer']['label']}->{e['consumer']['label']}"
         s = e.get("stall")
@@ -437,9 +438,17 @@ def _print_dag(rec: dict):
             badge = f"{s['blocked']}-blocked {s['blocked_s']:.1f}s"
             if s.get("dead_peer"):
                 badge += f" peer {s['culprit']} DEAD"
+        kind = e["kind"]
+        if kind == "device" and e.get("transport"):
+            # a device edge's bytes column IS its shard-bytes
+            # throughput; name the transport it rides
+            kind = f"device/{e['transport']}"
+        arrs = (str(e.get("device_arrays", 0))
+                if e["kind"] == "device" else "—")
         print(fmt.format(
-            e["edge"], e["role"], pair[:30], e["kind"],
-            max(e["ticks"], e["reads"]), e["bytes"], e["occupancy"],
+            e["edge"], e["role"], pair[:30], kind,
+            max(e["ticks"], e["reads"]), e["bytes"], arrs,
+            e["occupancy"],
             f"{e['write_block_s']:.1f}s", f"{e['read_block_s']:.1f}s",
             badge))
 

@@ -143,8 +143,28 @@ class LLMEngine:
             return nxt.astype(jnp.int32), cache, key
 
         # one jit; prefill (s=bucket) and decode (s=1) are separate traces
-        # of the same function, cached per shape
-        self._step = jax.jit(step, donate_argnums=(1,))
+        # of the same function, cached per shape. Donation keeps the
+        # decode state ON-CHIP between ticks with in-place buffer reuse:
+        # cache (1), tokens (2) and PRNG key (3) are all rebound from
+        # the return at every call site, so XLA may overwrite them —
+        # temps (4) is NOT donated: decode reuses it across steps.
+        self._step_jit = jax.jit(step, donate_argnums=(1, 2, 3))
+        self._key_seed = seed ^ 0x5EED
+        self._key_reseeds = 0
+
+        def _step_guarded(*args):
+            # the key rides donated through every call site (incl. the
+            # prefill paths that never reach _poison_recover): a failed
+            # step may have consumed its buffer, so re-seed BEFORE
+            # re-raising or the engine would raise 'Array has been
+            # deleted' on every later step, forever
+            try:
+                return self._step_jit(*args)
+            except BaseException:
+                self._reseed_key()
+                raise
+
+        self._step = _step_guarded
 
         def insert_row(cache, row_k, row_v, slot, length, start):
             """Graft a freshly prefilled request's KV rows into `slot` of
@@ -425,10 +445,20 @@ class LLMEngine:
             self._cur, self._temps, jnp.int32(slot), jnp.int32(first),
             jnp.float32(req.temperature))
 
+    def _reseed_key(self):
+        """Rebuild the PRNG key after a failed (donating) step consumed
+        its buffer; the reseed counter keeps the stream fresh."""
+        import jax as _jax
+
+        self._key_reseeds += 1
+        self._key = _jax.random.PRNGKey(
+            self._key_seed ^ (self._key_reseeds << 16))
+
     def _poison_recover(self):
         """The shared decode cache was donated into a call that failed:
         its buffers are gone. Fail every active request and reset so the
-        next admission rebuilds from scratch (callers hold _mutex)."""
+        next admission rebuilds from scratch (callers hold _mutex).
+        The PRNG key is re-seeded by the _step guard at the raise site."""
         err = RuntimeError("decode cache lost to a failed engine step")
         for s in self._slots:
             if s is not None:

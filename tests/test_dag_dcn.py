@@ -192,3 +192,100 @@ def test_dcn_channel_credit_backpressure(two_node_cluster):
         with pytest.raises(ChannelClosed):
             cons.read(timeout=10)
         cons.close()
+
+
+def test_mixed_kind_graph_with_allreduce_fast_path(two_node_cluster):
+    """ISSUE 12 satellite: allreduce.bind on the CHANNEL fast path with
+    mixed shm + DCN edge kinds in ONE graph (one participant co-located
+    with the driver, one on node B), plus a device edge — the graph
+    compiles with no per-call fallback, the reduction matches the
+    per-call fallback numerically, and teardown closes the device
+    edges exactly once. One actor pair serves both executors (the
+    fallback's one-shot groups are tagged per execution), so the test
+    never races a kill against a fresh actor's worker placement."""
+    from ray_tpu.dag import collective
+
+    @rt.remote(num_cpus=1, resources={"red": 1.0})
+    class RedW:
+        def grad(self, x):
+            return np.full((4,), float(x))
+
+        def jgrad(self, x):
+            import jax.numpy as jnp
+
+            return jnp.full((4,), float(x))
+
+    @rt.remote(num_cpus=1, resources={"blue": 1.0})
+    class BlueW:
+        def grad(self, x):
+            return np.full((4,), float(x * 2))
+
+        def jgrad(self, x):
+            import jax.numpy as jnp
+
+            return jnp.full((4,), float(x * 2))
+
+    a, b = RedW.remote(), BlueW.remote()
+    with InputNode() as inp:
+        # distinct-actors validation holds on the mixed graph too
+        with pytest.raises(ValueError):
+            collective.allreduce.bind(
+                [a.grad.bind(inp), a.grad.bind(inp)])
+        ra, rb = collective.allreduce.bind(
+            [a.grad.bind(inp), b.grad.bind(inp)], op="sum")
+        # device edges ride the same graph over BOTH transports: the
+        # red actor's jax output crosses to the driver over a shm
+        # ring, the blue actor's over a DCN channel (raw shard bytes
+        # through the NOTIFY framing, device_put rebuild on the
+        # driver's receive path)
+        dev_shm = a.jgrad.bind(inp).with_tensor_transport()
+        dev_dcn = b.jgrad.bind(inp).with_tensor_transport()
+        dag = MultiOutputNode(
+            [ra, rb, dev_shm, dev_dcn]).experimental_compile(
+                channels=True)
+    assert isinstance(dag, ChannelCompiledDAG)
+    # all three kinds in ONE graph, no fallback
+    assert dag.channel_kinds["shm"] >= 1, dag.channel_kinds
+    assert dag.channel_kinds["dcn"] >= 1, dag.channel_kinds
+    assert dag.channel_kinds["device"] == 2, dag.channel_kinds
+    try:
+        va, vb, vshm, vdcn = dag.execute(3).get(timeout=90)
+        np.testing.assert_allclose(va, np.full((4,), 9.0))  # 3 + 6
+        np.testing.assert_allclose(vb, np.full((4,), 9.0))
+        np.testing.assert_allclose(np.asarray(vshm), np.full((4,), 3.0))
+        np.testing.assert_allclose(np.asarray(vdcn), np.full((4,), 6.0))
+        va, vb, _, _ = dag.execute(5).get(timeout=90)
+        np.testing.assert_allclose(va, np.full((4,), 15.0))
+    finally:
+        import collections
+
+        calls = collections.Counter()
+        device_chs = [ch for ch in dag._driver_channels
+                      if getattr(ch, "is_device", False)]
+        assert device_chs, "driver holds no device-edge handle"
+        for ch in device_chs:
+            def _patched(_ch=ch, _orig=ch.close):
+                if not getattr(_ch, "_closed_locally", False):
+                    calls.update([id(_ch)])
+                return _orig()
+
+            ch.close = _patched
+        dag.teardown()
+        dag.teardown()
+        assert all(v == 1 for v in calls.values()), calls
+        assert len(calls) == len(device_chs)
+
+    # per-call-fallback parity on the SAME actors (their DAG loops have
+    # exited at teardown; fallback groups are tagged per execution, so
+    # no rendezvous collision with the channel path's long-lived group)
+    try:
+        with InputNode() as inp:
+            fa, fb = collective.allreduce.bind(
+                [a.grad.bind(inp), b.grad.bind(inp)], op="sum")
+            fallback = MultiOutputNode([fa, fb]).experimental_compile(
+                channels=False)
+        wa, wb = fallback.execute(3).get(timeout=90)
+        np.testing.assert_allclose(wa, np.full((4,), 9.0))
+        np.testing.assert_allclose(wb, np.full((4,), 9.0))
+    finally:
+        _kill(a, b)

@@ -217,7 +217,7 @@ def test_dag_record_lifecycle(dag_obs_cluster):
     rec = _wait_for(record_ready)
     assert rec is not None, "dag record never reached 10 ticks"
     assert rec["state"] == "RUNNING"
-    assert rec["channel_kinds"] == {"shm": 3, "dcn": 0}
+    assert rec["channel_kinds"] == {"shm": 3, "dcn": 0, "device": 0}
     roles = sorted(e["role"] for e in rec["edges"])
     assert roles == ["edge", "input", "output"]
     edge = next(e for e in rec["edges"] if e["role"] == "edge")
