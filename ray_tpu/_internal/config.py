@@ -172,6 +172,20 @@ class Config:
     # job holding the most records evicts oldest-first with per-job
     # dropped accounting (same contract as task/object managers).
     dag_state_max_dags: int = 500
+    # ---- compiled-DAG recovery (dag/recovery.py) ----
+    # RecoverableDag.get() re-checks peer liveness at this cadence while
+    # waiting on a tick, so a dead runner is detected in ~probe seconds
+    # instead of the caller's full timeout (the stall watchdog's
+    # attribution rides the same check).
+    dag_recovery_probe_s: float = 5.0
+    # After a teardown, how long to wait for the GCS to bring each
+    # restartable dead actor back to ALIVE before giving up (or handing
+    # the survivors to the algorithm's recover callback to respawn
+    # replacements from specs).
+    dag_recovery_restart_timeout_s: float = 60.0
+    # Recoveries per RecoverableDag lifetime; beyond it the failure is
+    # re-raised (a crash-looping actor should fail loudly, not churn).
+    dag_recovery_max_attempts: int = 8
     # ---- scheduling-plane observability (cluster events + traces) ----
     # Gates the cluster event log AND the lease decision tracer: node
     # managers record per-demand-shape request_lease verdicts and emit

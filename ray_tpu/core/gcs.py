@@ -151,6 +151,18 @@ class GcsServer:
         self._dirty = False
         self._bg: list[asyncio.Task] = []
         if self._backend is not None:
+            if hasattr(self._backend, "failure_listener"):
+                # remote store unreachable past the retry budget: the
+                # head keeps running but persistence is DEGRADED — put
+                # that on the cluster event log, not just a logger line
+                self._backend.failure_listener = (
+                    lambda exc, method: self.record_event(
+                        source="gcs", kind="snapshot_store_unavailable",
+                        severity="WARNING",
+                        message=(f"snapshot store {method} failed after "
+                                 f"retries: {exc!r}; head state is NOT "
+                                 "being persisted"),
+                        persist_path=self.persist_path or ""))
             self._load_snapshot()
 
     # ------------------------------------------------------- persistence

@@ -129,6 +129,10 @@ class GcsDagManager:
             "updated_at": ts,
             "torn_down_at": 0.0,
             "channel_kinds": dict(report.get("channel_kinds") or {}),
+            # recovery lineage: epoch > 0 marks a recompile-and-resume
+            # ring and recovered_from names the dag_id it replaced
+            "epoch": int(report.get("epoch", 0)),
+            "recovered_from": report.get("recovered_from", ""),
             "edges": edges,
         }
         self._by_job.setdefault(job, {})[dag_id] = None
@@ -370,6 +374,8 @@ class GcsDagManager:
             "updated_at": rec["updated_at"],
             "torn_down_at": rec["torn_down_at"],
             "channel_kinds": dict(rec["channel_kinds"]),
+            "epoch": rec.get("epoch", 0),
+            "recovered_from": rec.get("recovered_from", ""),
             "num_edges": len(rec["edges"]),
             "ticks": ticks,
             "bytes": sum(e["bytes"] for e in rec["edges"].values()),

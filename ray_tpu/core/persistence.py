@@ -79,6 +79,11 @@ class FileSnapshotBackend(SnapshotBackend):
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(value)
+            # fsync BEFORE the rename: os.replace alone is atomic
+            # against a process crash but not a host crash — the rename
+            # can hit disk before the data, leaving a torn snapshot
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
 
     def get(self, key: str) -> Optional[bytes]:
@@ -96,17 +101,67 @@ class RemoteSnapshotBackend(SnapshotBackend):
     """Sync facade over the async RPC client: snapshot IO happens off
     the GCS event loop (executor thread / process start-stop), so each
     call blocks on a private IO loop the way CoreWorker's sync API
-    does."""
+    does.
+
+    Store-server restarts are expected (it is a plain process on a
+    different box), so every call retries with backoff and redials the
+    connection on transport errors. Only after the retry budget is
+    exhausted does the error surface — and `failure_listener` (wired by
+    the GCS server to a WARNING cluster event) fires so operators learn
+    persistence is degraded even though the head keeps running."""
+
+    MAX_ATTEMPTS = 4
+    BACKOFF_S = 0.2      # doubles per attempt: 0.2, 0.4, 0.8
 
     def __init__(self, host: str, port: int, timeout_s: float = 30.0):
         from ray_tpu._internal.rpc import EventLoopThread, connect
 
+        self._host, self._port = host, port
         self._io = EventLoopThread(name="rayt-snap-store")
         self._timeout = timeout_s
         self._conn = self._io.run(connect(host, port), timeout_s)
+        # called (exc, method) after the retry budget is exhausted
+        self.failure_listener = None
+
+    def _redial(self):
+        from ray_tpu._internal.rpc import connect
+
+        try:
+            self._io.run(self._conn.close(), 2)
+        except Exception:
+            pass
+        self._conn = self._io.run(connect(self._host, self._port),
+                                  self._timeout)
 
     def _call(self, method: str, arg):
-        return self._io.run(self._conn.call(method, arg), self._timeout)
+        import time as _time
+
+        delay = self.BACKOFF_S
+        last: Exception | None = None
+        for attempt in range(self.MAX_ATTEMPTS):
+            try:
+                if self._conn is None:
+                    self._redial()
+                return self._io.run(self._conn.call(method, arg),
+                                    self._timeout)
+            except Exception as e:
+                last = e
+                self._conn = None   # force a redial next attempt
+                if attempt < self.MAX_ATTEMPTS - 1:
+                    logger.warning(
+                        "snapshot store %s failed (%r), retrying in "
+                        "%.1fs (%d/%d)", method, e, delay, attempt + 1,
+                        self.MAX_ATTEMPTS)
+                    _time.sleep(delay)
+                    delay *= 2
+        logger.error("snapshot store %s failed after %d attempts: %r",
+                     method, self.MAX_ATTEMPTS, last)
+        if self.failure_listener is not None:
+            try:
+                self.failure_listener(last, method)
+            except Exception:
+                pass
+        raise last
 
     def put(self, key: str, value: bytes) -> None:
         self._call("store_put", (key, value))
@@ -119,7 +174,8 @@ class RemoteSnapshotBackend(SnapshotBackend):
 
     def close(self) -> None:
         try:
-            self._io.run(self._conn.close(), 5)
+            if self._conn is not None:
+                self._io.run(self._conn.close(), 5)
         except Exception:
             pass
         self._io.stop()
@@ -158,6 +214,10 @@ class SnapshotStoreServer:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(bytes(value))
+            # durability is this process's whole job: data must be on
+            # disk before the rename commits it (host-crash safety)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
         return True
 

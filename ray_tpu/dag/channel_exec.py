@@ -106,6 +106,25 @@ class _TraceTick:
         return (_TraceTick, (self.carrier, self.tick, self.value))
 
 
+class _EpochTick:
+    """Recovery-epoch envelope (outermost, wrapping any _TraceTick).
+    After a recompile-and-resume (dag/recovery.py) the driver and every
+    actor schedule carry the new DAG's epoch; frames stamped with an
+    older epoch are pre-failure leftovers from a surviving peer and are
+    DISCARDED at read instead of double-consumed. Epoch-0 DAGs (never
+    recovered) skip the envelope entirely, so the steady-state wire
+    format is unchanged."""
+
+    __slots__ = ("epoch", "value")
+
+    def __init__(self, epoch, value):
+        self.epoch = epoch
+        self.value = value
+
+    def __reduce__(self):
+        return (_EpochTick, (self.epoch, self.value))
+
+
 # reusable no-op context for the untraced compute path
 import contextlib as _contextlib
 
@@ -213,6 +232,7 @@ class _ActorSchedule:
     collective_world: int = 0
     collective_rank: int = 0
     dag_id: str = ""                  # dag_state reporting key ("" = off)
+    epoch: int = 0                    # recovery epoch (0 = never recovered)
 
 
 def _dag_actor_loop(self, sched_blob: bytes):
@@ -291,7 +311,19 @@ def _dag_loop_body(self, sched: _ActorSchedule):
 
             def read_ch(i):
                 if i not in reads:
-                    v = ins[i].read()
+                    while True:
+                        v = ins[i].read()
+                        if type(v) is _EpochTick:
+                            if v.epoch != sched.epoch:
+                                # stale pre-failure frame from a
+                                # surviving peer: discard, re-read
+                                continue
+                            v = v.value
+                        elif sched.epoch:
+                            # unstamped frame in a recovered DAG
+                            # predates the recompile: discard it
+                            continue
+                        break
                     if type(v) is _TraceTick:
                         trace_ctx[0] = v.carrier
                         trace_ctx[1] = v.tick
@@ -421,6 +453,11 @@ def _dag_loop_body(self, sched: _ActorSchedule):
                     # downstream spans join the driver's trace
                     out_val = _TraceTick(trace_ctx[0], trace_ctx[1],
                                          result)
+                if sched.epoch:
+                    # stamp the recovery epoch OUTERMOST so peers (and
+                    # the driver) can discard frames from a pre-failure
+                    # epoch; device channels pack inside the envelope
+                    out_val = _EpochTick(sched.epoch, out_val)
                 try:
                     for w in op.writes:
                         outs[w].write(out_val)
@@ -485,10 +522,18 @@ class _ChanPlan:
 class ChannelCompiledDAG:
     def __init__(self, output_node: DAGNode, topo: list[DAGNode],
                  buffer_size_bytes: int = 1 << 20, max_inflight: int = 8,
-                 device_input: bool = False):
+                 device_input: bool = False, epoch: int = 0,
+                 recovered_from: str = ""):
         self.output_node = output_node
         self._closed = False
         self._tick = 0
+        # recovery epoch: >0 when this compile replaces a torn-down ring
+        # (dag/recovery.py). Every frame both ways is then stamped with
+        # an _EpochTick envelope and mismatches are discarded.
+        self.epoch = epoch
+        # dag_id of the ring this compile replaces (recovery lineage in
+        # the GCS record), "" on a first compile
+        self.recovered_from = recovered_from
         self._next_read = 0
         self._buffered: dict[int, Any] = {}
         # outputs already consumed for the in-progress wave (a get()
@@ -652,6 +697,16 @@ class ChannelCompiledDAG:
         # collective groups: nodes marked by dag.collective.allreduce
         self._wire_collectives(compute, scheds, actors)
 
+        # actor handles by id() key — dag/recovery.py probes these for
+        # DEAD/RESTARTING peers when a tick read times out
+        self._actors = dict(actors)
+        # restart baseline: an actor that RESTARTED since this compile
+        # is back to ALIVE but is NOT running this ring's loop — its
+        # num_restarts moving past this baseline marks it failed even
+        # when a liveness probe never catches the DEAD window
+        self._restart_baseline = {
+            hexid: info[1] for hexid, info in self._peer_info().items()}
+
         # ---- materialize channels ---------------------------------------
         # every Ineligible check has passed by here: a failure below is a
         # hard error (e.g. a consumer actor died before its endpoint
@@ -742,7 +797,8 @@ class ChannelCompiledDAG:
                 collective_group=sched.collective_group,
                 collective_world=sched.collective_world,
                 collective_rank=sched.collective_rank,
-                dag_id=self.dag_id if report_state else ""))
+                dag_id=self.dag_id if report_state else "",
+                epoch=self.epoch))
             handle = actors[aid]
             from ray_tpu.api import ActorMethod
 
@@ -855,7 +911,9 @@ class ChannelCompiledDAG:
                "job_id": self._cw.job_id.hex(),
                "driver": self._cw.worker_info.worker_id.hex(),
                "ts": time.time(), "edges": edges,
-               "channel_kinds": dict(self.channel_kinds)}
+               "channel_kinds": dict(self.channel_kinds),
+               "epoch": self.epoch,
+               "recovered_from": self.recovered_from}
         try:
             self._cw.io.run(self._cw.gcs.publish(CH_DAGS, reg),
                             timeout=10.0)
@@ -911,6 +969,56 @@ class ChannelCompiledDAG:
             return "; ".join(lines)
         except Exception:
             return ""
+
+    def _peer_info(self) -> dict[str, tuple]:
+        """actor_id hex -> (state, num_restarts) for every DAG actor
+        (one lightweight RPC each; unknown actors report DEAD)."""
+        info: dict[str, tuple] = {}
+        for handle in self._actors.values():
+            aid = handle._actor_id
+            try:
+                res = self._cw.io.run(
+                    self._cw.gcs.actor_handle_state(aid), timeout=5.0)
+                if res:
+                    info[aid.hex()] = (res[0], int(res[3] or 0))
+                else:
+                    info[aid.hex()] = ("DEAD", 0)
+            except Exception:
+                info[aid.hex()] = ("UNKNOWN", 0)
+        return info
+
+    def actor_states(self) -> dict[str, str]:
+        """actor_id hex -> GCS lifecycle state for every DAG actor."""
+        return {hexid: st for hexid, (st, _) in self._peer_info().items()}
+
+    def failed_peers(self) -> dict[str, str]:
+        """The DAG actors the control plane considers gone from THIS
+        ring: GCS state DEAD/RESTARTING, actors whose num_restarts moved
+        past the compile-time baseline (restarted fast enough that no
+        probe caught the DEAD window — the fresh incarnation is not
+        running this ring's loop), unioned with the stall watchdog's
+        dead-peer attribution on this DAG's record. Empty dict = every
+        peer looks alive (a tick timeout is then a stall, not a
+        death)."""
+        failed: dict[str, str] = {}
+        for hexid, (st, restarts) in self._peer_info().items():
+            if st in ("DEAD", "RESTARTING"):
+                failed[hexid] = st
+            elif restarts > self._restart_baseline.get(hexid, 0):
+                failed[hexid] = "RESTARTED"
+        try:
+            out = self._cw.io.run(
+                self._cw.gcs.call("list_dags",
+                                  {"dag_id": self.dag_id, "limit": 1}),
+                timeout=5.0)
+            recs = (out or {}).get("dags") or []
+            for e in (recs[0]["edges"] if recs else []):
+                s = e.get("stall") or {}
+                if s.get("dead_peer"):
+                    failed.setdefault(s["dead_peer"], "DEAD")
+        except Exception:
+            pass
+        return failed
 
     def _timeout_message(self, timeout_s: float, consumed: int) -> str:
         """The enriched _get_tick timeout: per-output-channel cursor
@@ -969,8 +1077,11 @@ class ChannelCompiledDAG:
             carrier = otel.current_context_carrier()
 
             def _wrap(v):
-                return (_TraceTick(carrier, self._tick, v)
-                        if carrier is not None else v)
+                v = (_TraceTick(carrier, self._tick, v)
+                     if carrier is not None else v)
+                # epoch stamp OUTERMOST (recovered DAGs only): actor
+                # loops discard frames whose epoch predates the resume
+                return _EpochTick(self.epoch, v) if self.epoch else v
 
             # serialize ONCE PER FRAMING FLAVOR, scatter the same chunk
             # list into every input channel of that flavor (N-runner
@@ -1024,6 +1135,12 @@ class ChannelCompiledDAG:
                 except TimeoutError:
                     raise TimeoutError(self._timeout_message(
                         timeout_s, len(vals))) from None
+                if type(v) is _EpochTick:
+                    if v.epoch != self.epoch:
+                        continue   # stale pre-failure frame: discard
+                    v = v.value
+                elif self.epoch:
+                    continue       # unstamped frame predates recovery
                 if type(v) is _TraceTick:
                     v = v.value
                 vals.append(v)

@@ -72,6 +72,12 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
+    # per-call submissions already get per-call fault tolerance (task
+    # retries + lineage), so the recovery engine (dag/recovery.py)
+    # treats this executor as never having dead-ring failures: epoch
+    # stays 0 and failed_peers() is always empty.
+    epoch = 0
+
     def __init__(self, output_node: DAGNode):
         self.output_node = output_node
         self.topo = self._topo_sort(output_node)
@@ -182,6 +188,9 @@ class CompiledDAG:
         if isinstance(value, ObjectRef):
             return value
         return rt.put(value)
+
+    def failed_peers(self) -> dict:
+        return {}  # per-call path: retries handle actor death already
 
     def teardown(self):
         pass  # per-call path holds no persistent resources

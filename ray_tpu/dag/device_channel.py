@@ -346,16 +346,22 @@ class DeviceTransportChannel:
 
     # ------------------------------------------------------------ protocol
     def write(self, value, timeout: float | None = None):
-        # the actor loop hands us the (possibly trace-enveloped) tick
-        # payload; pack the value inside so the envelope stays intact
-        from ray_tpu.dag.channel_exec import _TraceTick
+        # the actor loop hands us the (possibly epoch- and/or
+        # trace-enveloped) tick payload; pack the value inside so the
+        # envelopes stay intact (_EpochTick outermost, then _TraceTick)
+        from ray_tpu.dag.channel_exec import _EpochTick, _TraceTick
 
+        epoch = None
+        if type(value) is _EpochTick:
+            epoch, value = value.epoch, value.value
         if type(value) is _TraceTick:
             packed, n = pack_device_tree(value.value)
             if n:
                 value = _TraceTick(value.carrier, value.tick, packed)
         else:
             value, n = pack_device_tree(value)
+        if epoch is not None:
+            value = _EpochTick(epoch, value)
         self.device_arrays += n
         self._inner.write(value, timeout=timeout)
 

@@ -15,7 +15,9 @@ class DAGNode:
     def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
                              max_inflight: int = 8,
                              channels: object = "auto",
-                             device_input: bool = False) -> "object":
+                             device_input: bool = False,
+                             epoch: int = 0,
+                             recovered_from: str = "") -> "object":
         """Compile the DAG. channels="auto" uses the pre-allocated
         channel fast path (dag/channel_exec.py) when the graph is
         eligible (actor-only): node-local edges ride shm rings,
@@ -26,7 +28,10 @@ class DAGNode:
         the driver's input edges device too (weight broadcasts).
         Falls back to the per-call executor only for function nodes;
         True forces channels (raises if ineligible); False forces the
-        per-call executor."""
+        per-call executor. ``epoch``/``recovered_from`` are set by the
+        recovery engine (dag/recovery.py) on a recompile-and-resume:
+        frames are then stamped with the epoch so pre-failure leftovers
+        are discarded, and the GCS record links to the replaced ring."""
         from ray_tpu.dag.compiled import CompiledDAG
 
         if channels in ("auto", True):
@@ -38,7 +43,8 @@ class DAGNode:
                     self, CompiledDAG._topo_sort(self),
                     buffer_size_bytes=buffer_size_bytes,
                     max_inflight=max_inflight,
-                    device_input=device_input)
+                    device_input=device_input,
+                    epoch=epoch, recovered_from=recovered_from)
             except Ineligible:
                 if channels is True:
                     raise

@@ -43,6 +43,17 @@ class FaultTolerantActorManager:
                 self._healthy[i] = False
         return out
 
+    def replace(self, old, new) -> None:
+        """Swap a permanently-dead actor for a freshly spawned
+        replacement (DAG recovery's respawn path); the replacement
+        starts healthy and keeps the fleet size stable."""
+        for i, a in enumerate(self._actors):
+            if a is old:
+                self._actors[i] = new
+                self._healthy[i] = True
+                return
+        raise ValueError("actor is not managed by this manager")
+
     def probe_unhealthy(self, timeout: float = 10.0) -> int:
         """Try to restore unhealthy actors (restarted actors respond
         again); returns how many are healthy now."""

@@ -459,6 +459,12 @@ class IMPALA:
                    timeout=self.config.call_timeout_s))
 
         runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
+        # runner spec, retained so DAG recovery can respawn REPLACEMENT
+        # runners when a dead one has no restarts left (or its restart
+        # times out) — the DAG's actor set is rebuildable from here
+        self._runner_cls = runner_cls
+        self._module_blob = module_blob
+        self._spawned_runners = config.num_env_runners
         runners = []
         wave = config.boot_wave or config.num_env_runners
         for lo in range(0, config.num_env_runners, wave):
@@ -503,6 +509,17 @@ class IMPALA:
                                        cfg.num_envs_per_runner)
 
     def _build_dag(self):
+        """Wrap the compiled ring in the recovery engine: a dead runner
+        mid-tick tears the ring down, restarts (or respawns) the runner,
+        recompiles over the CURRENT fleet and resumes — DAG mode keeps
+        worker fault tolerance instead of trading it away."""
+        from ray_tpu.dag.recovery import RecoverableDag
+
+        self._dag = RecoverableDag(
+            self._compile_dag, recover_cb=self._recover_runners,
+            name="appo" if self.config.use_appo_loss else "impala")
+
+    def _compile_dag(self, epoch: int = 0, recovered_from: str = ""):
         from ray_tpu.dag import InputNode
 
         cfg = self.config
@@ -548,12 +565,53 @@ class IMPALA:
                 timeout=cfg.call_timeout_s))) + (1 << 16)
         buf = max(2 * frag_bytes * max(1, len(runners)) + (1 << 16),
                   batch_bytes, weights_nbytes, 1 << 20)
-        self._dag = out.experimental_compile(
+        return out.experimental_compile(
             buffer_size_bytes=buf,
             max_inflight=max(2, cfg.max_requests_in_flight),
             # weight broadcasts over the input edges ride the device
             # framing too, closing the on-device loop driver-side
-            device_input=cfg.use_device_edges)
+            device_input=cfg.use_device_edges,
+            epoch=epoch, recovered_from=recovered_from)
+
+    def _recover_runners(self, failed: dict):
+        """RecoverableDag recover_cb. Runners are restartable
+        (max_restarts=-1): wait for the GCS to bring each one back
+        ALIVE, and respawn a replacement from the stored spec when one
+        stays dead past the restart budget. Aggregator/learner death is
+        fatal — the learner's params live nowhere else. Restarted and
+        replacement runners re-init from the ORIGINAL module blob, so
+        push the learner's CURRENT weights before the ring recompiles
+        (bounded loss: only the dead runner's in-flight fragments)."""
+        from ray_tpu._internal.config import get_config
+        from ray_tpu.dag.recovery import DagRecoveryError, wait_actor_alive
+
+        cfg = self.config
+        by_hex = {a._actor_id.hex(): a for a in self._runners._actors}
+        fatal = [h for h in failed if h not in by_hex]
+        if fatal:
+            raise DagRecoveryError(
+                f"non-runner DAG peers died ({fatal}): aggregator/"
+                "learner state is not recoverable — restart training "
+                "from a checkpoint")
+        timeout = get_config().dag_recovery_restart_timeout_s
+        for hexid in failed:
+            runner = by_hex[hexid]
+            state = wait_actor_alive(runner, timeout)
+            if state != "ALIVE":
+                # no restarts left (or restart timed out): respawn a
+                # replacement runner from the retained spec
+                replacement = self._runner_cls.remote(
+                    cfg.env, cfg.num_envs_per_runner,
+                    cfg.seed + self._spawned_runners,
+                    self._module_blob, self._connector_blob)
+                self._spawned_runners += 1
+                self._runners.replace(runner, replacement)
+        self._runners.probe_unhealthy(timeout=timeout)
+        self._weights_ref = rt.put(
+            rt.get(self._learner.get_weights.remote(),
+                   timeout=cfg.call_timeout_s))
+        self._runners.foreach(
+            lambda a: a.set_weights.remote(self._weights_ref))
 
     def _train_dag(self) -> dict:
         """One iteration on the compiled DAG: keep `max_requests_in_flight`

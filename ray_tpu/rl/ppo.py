@@ -85,6 +85,11 @@ class PPO:
         learner_blob = cloudpickle.dumps(self.config.learner_config())
 
         runner_cls = rt.remote(num_cpus=1, max_restarts=-1)(EnvRunner)
+        # runner spec retained for DAG recovery's respawn path (a dead
+        # runner with no restarts left is replaced from here)
+        self._runner_cls = runner_cls
+        self._module_blob = module_blob
+        self._spawned_runners = config.num_env_runners
         self._runners = FaultTolerantActorManager([
             runner_cls.remote(config.env, config.num_envs_per_runner,
                               config.seed + i, module_blob)
@@ -107,6 +112,16 @@ class PPO:
             self._build_dag()
 
     def _build_dag(self):
+        """Recovery-wrapped compiled sampling plane: a dead runner
+        mid-wave triggers teardown → restart/respawn → recompile →
+        resume (see dag/recovery.py) instead of failing the iteration."""
+        from ray_tpu.dag.recovery import RecoverableDag
+
+        self._dag = RecoverableDag(
+            self._compile_dag, recover_cb=self._recover_runners,
+            name="ppo")
+
+    def _compile_dag(self, epoch: int = 0, recovered_from: str = ""):
         from ray_tpu.dag import InputNode, MultiOutputNode
 
         cfg = self.config
@@ -122,10 +137,40 @@ class PPO:
         weights_nbytes = 2 * sum(
             int(np.asarray(w).nbytes) for w in _tree_leaves(self._weights)
         ) + (1 << 16)
-        self._dag = node.experimental_compile(
+        return node.experimental_compile(
             buffer_size_bytes=max(sample_nbytes, weights_nbytes, 1 << 20),
             max_inflight=max(2, cfg.sample_waves + 1),
-            device_input=cfg.use_device_edges)
+            device_input=cfg.use_device_edges,
+            epoch=epoch, recovered_from=recovered_from)
+
+    def _recover_runners(self, failed: dict):
+        """RecoverableDag recover_cb (same policy as IMPALA's): wait for
+        GCS restarts, respawn replacements for runners that stay dead,
+        and push the driver's current weights so a restarted runner does
+        not sample from its init params until wave 0 replays."""
+        from ray_tpu._internal.config import get_config
+        from ray_tpu.dag.recovery import DagRecoveryError, wait_actor_alive
+
+        cfg = self.config
+        by_hex = {a._actor_id.hex(): a for a in self._runners._actors}
+        fatal = [h for h in failed if h not in by_hex]
+        if fatal:
+            raise DagRecoveryError(
+                f"non-runner DAG peers died ({fatal}); PPO's sampling "
+                "ring only spans env runners")
+        timeout = get_config().dag_recovery_restart_timeout_s
+        for hexid in failed:
+            runner = by_hex[hexid]
+            if wait_actor_alive(runner, timeout) != "ALIVE":
+                replacement = self._runner_cls.remote(
+                    cfg.env, cfg.num_envs_per_runner,
+                    cfg.seed + self._spawned_runners, self._module_blob)
+                self._spawned_runners += 1
+                self._runners.replace(runner, replacement)
+        self._runners.probe_unhealthy(timeout=timeout)
+        weights_ref = rt.put(self._weights)
+        self._runners.foreach(
+            lambda a: a.set_weights.remote(weights_ref))
 
     # ------------------------------------------------------------------ train
     def train(self) -> dict:

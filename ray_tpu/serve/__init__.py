@@ -191,6 +191,15 @@ def shutdown():
         rt.kill(controller)
     except Exception:
         pass
+    try:
+        # drop the HA checkpoint: an INTENTIONAL shutdown must not leave
+        # state a future controller would adopt (only crashes should)
+        from ray_tpu.experimental.internal_kv import _internal_kv_del
+        from ray_tpu.serve.controller import CKPT_KEY, CKPT_NAMESPACE
+
+        _internal_kv_del(CKPT_KEY, namespace=CKPT_NAMESPACE)
+    except Exception:
+        pass
     if _proxy is not None:
         try:
             rt.kill(_proxy)

@@ -24,6 +24,11 @@ CONTROLLER_NAME = "serve_controller"
 # with traceback, rate-limited so a persistent failure can't flood
 RECONCILE_ERR_LOG_INTERVAL_S = 30.0
 
+# controller-state checkpoint location in the GCS KV (survives a
+# controller bounce; with a persisted GCS it survives a head bounce too)
+CKPT_NAMESPACE = "serve"
+CKPT_KEY = "controller:checkpoint"
+
 
 class ServeController:
     def __init__(self):
@@ -63,8 +68,96 @@ class ServeController:
 
     async def ensure_loop(self) -> bool:
         if self._loop_task is None:
+            # HA: a freshly (re)created controller restores the last
+            # checkpoint BEFORE its first reconcile, so live replicas
+            # from the previous incarnation are ADOPTED into the routing
+            # table instead of being cold-started next to orphans
+            if not self.apps:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._restore_checkpoint)
             self._loop_task = asyncio.ensure_future(self._reconcile_loop())
         return True
+
+    # ------------------------------------------------- HA checkpointing
+    def _checkpoint_state(self) -> dict:
+        """Serializable controller state. Monotonic marks/deadlines are
+        stored as AGES/REMAINING seconds (a restarted process has a new
+        monotonic clock)."""
+        now = time.monotonic()
+        return {
+            "apps": self.apps,
+            "version": self.version,
+            "replicas": {k: list(v) for k, v in self.replicas.items()},
+            "draining": [(h, max(0.0, dl - now))
+                         for h, dl in self._draining],
+            "updating": {k: {"old": list(st["old"]),
+                             "warming": list(st["warming"]),
+                             "drain_timeout_s": st["drain_timeout_s"]}
+                         for k, st in self._updating.items()},
+            "scale_marks": {k: now - first
+                            for k, first in self._scale_marks.items()},
+            "autoscale_status": dict(self._autoscale_status),
+        }
+
+    def _save_checkpoint(self):
+        """Write controller state to the GCS KV (sync; callers run it in
+        an executor). Best-effort: serving must not depend on it."""
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            blob = cloudpickle.dumps(self._checkpoint_state())
+            cw.io.run(cw.gcs.kv_put(CKPT_KEY, blob,
+                                    namespace=CKPT_NAMESPACE),
+                      timeout=10.0)
+        except Exception:
+            self._log_reconcile_error("checkpoint")
+
+    def _restore_checkpoint(self):
+        """Rebuild state from the last checkpoint (sync, executor-run).
+        Replica handles are restored as-is: the next reconcile pass
+        filters dead ones via _alive() and tops live sets up to target —
+        adoption, not cold start."""
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            blob = cw.io.run(cw.gcs.kv_get(CKPT_KEY,
+                                           namespace=CKPT_NAMESPACE),
+                             timeout=10.0)
+        except Exception:
+            return
+        if not blob:
+            return
+        try:
+            state = cloudpickle.loads(blob)
+            now = time.monotonic()
+            self.apps = state.get("apps", {})
+            # version bump past the checkpoint: every handle/proxy gets
+            # a full table push on its next refresh (their cached
+            # versions came from the dead incarnation)
+            self.version = int(state.get("version", 0)) + 1
+            self.replicas = {k: list(v)
+                             for k, v in state.get("replicas",
+                                                   {}).items()}
+            self._draining = [(h, now + rem)
+                              for h, rem in state.get("draining", [])]
+            self._updating = state.get("updating", {})
+            self._scale_marks = {k: now - age for k, age in
+                                 state.get("scale_marks", {}).items()}
+            self._autoscale_status = state.get("autoscale_status", {})
+            adopted = sum(len(v) for v in self.replicas.values())
+            from ray_tpu.core.gcs_event_manager import emit_cluster_event
+
+            emit_cluster_event(
+                source="serve", kind="serve_controller_restored",
+                severity="WARNING",
+                message=(f"serve controller restored from checkpoint: "
+                         f"{len(self.apps)} app(s), {adopted} replica "
+                         "handle(s) adopted for reconciliation"),
+                apps=list(self.apps), replicas=adopted)
+        except Exception:
+            self._log_reconcile_error("restore")
 
     # ---------------------------------------------------------- app deploy
     @staticmethod
@@ -116,6 +209,8 @@ class ServeController:
             self.version += 1
         self.apps[app_name] = new
         await self._reconcile()
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._save_checkpoint)
         return True
 
     @staticmethod
@@ -148,6 +243,8 @@ class ServeController:
             self._signal_cache.pop((app_name, dep_name), None)
             self._autoscale_status.pop(f"{app_name}/{dep_name}", None)
         self.version += 1
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._save_checkpoint)
         return True
 
     def list_applications(self) -> list[str]:
@@ -298,6 +395,10 @@ class ServeController:
                     changed = True
         if changed:
             self.version += 1
+            # replica-set changes checkpoint so a bounced controller
+            # adopts the CURRENT fleet, not the one deploy() created
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._save_checkpoint)
 
     async def _step_update(self, key: tuple, spec: dict,
                            live: list) -> bool:

@@ -30,6 +30,18 @@ replica removal drops exactly the dead entries.
 A handle and all its ``options()`` clones share one router state
 (table, in-flight counts, affinity), so a proxy that builds a per-model
 clone per request still routes on complete local knowledge.
+
+HA: the controller is a cached dependency, not a hard one. When the
+route-info RPC fails (controller crashed, head bouncing) the router
+keeps serving from its LAST table — requests go directly to replica
+actors, which outlive the controller — while it re-resolves the named
+controller in the background of each refresh (throttled). Re-resolution
+uses ``serve._controller(create=True)``: if nothing else has restarted
+the controller, the first surviving handle recreates it and the new
+controller restores its GCS checkpoint, so the control plane self-heals
+from the data plane. A GCS reconnect (head restart) registers a hook
+that forces a full-table resync (version -1) since the restarted
+control plane's version counter is not comparable to ours.
 """
 
 from __future__ import annotations
@@ -80,6 +92,8 @@ class _RouterState:
             OrderedDict()
         self.handle_hex = uuid.uuid4().hex[:8]
         self.waiting = 0                  # requests parked in the gate
+        self._last_heal = 0.0             # controller re-resolve throttle
+        self._reconnect_hooked = False
 
     # ------------------------------------------------------------- refresh
     def refresh(self, force: bool = False):
@@ -88,14 +102,87 @@ class _RouterState:
             fresh = now - self.table_ts < 1.0 and self.replicas
             if fresh and not force:
                 return
+        self._ensure_reconnect_hook()
         import ray_tpu as rt
 
-        if self.controller is None:
-            self.controller = _get_controller()
-        known = -1 if force else self.table_version
-        info = rt.get(self.controller.get_route_info.remote(known, self.key),
-                      timeout=30)
+        try:
+            if self.controller is None:
+                self.controller = _get_controller()
+                force = True   # new controller handle: full table
+            known = -1 if force else self.table_version
+            info = rt.get(
+                self.controller.get_route_info.remote(known, self.key),
+                timeout=30)
+        except Exception:
+            # controller unreachable (crashed / head bouncing): drop the
+            # cached handle and try to re-resolve — recreating restores
+            # the controller's GCS checkpoint, so a surviving handle
+            # self-heals the control plane
+            self.controller = None
+            info = None
+            healed = self._heal_controller()
+            if healed is not None:
+                try:
+                    info = rt.get(
+                        healed.get_route_info.remote(-1, self.key),
+                        timeout=30)
+                    self.controller = healed
+                except Exception:
+                    info = None
+            if info is None:
+                with self.lock:
+                    if self.replicas:
+                        # stale-while-error: keep routing on the last
+                        # table (replicas outlive the controller);
+                        # bumping table_ts rate-limits the retries
+                        self.table_ts = time.monotonic()
+                        return
+                raise
         self.apply_route_info(info, now)
+
+    def _heal_controller(self):
+        """Re-resolve (and, if gone, recreate) the named controller.
+        Throttled so every parked request in a proxy does not stampede
+        ``ensure_loop`` during a head bounce."""
+        now = time.monotonic()
+        with self.lock:
+            if now - self._last_heal < 2.0:
+                return None
+            self._last_heal = now
+        try:
+            from ray_tpu import serve as _serve
+
+            return _serve._controller(create=True)
+        except Exception:
+            return None
+
+    def _ensure_reconnect_hook(self):
+        """After a GCS reconnect (head restart) the control plane's
+        version counter restarts too — force a full-table resync and a
+        controller re-resolution on the next refresh."""
+        if self._reconnect_hooked:
+            return
+        import weakref
+
+        try:
+            from ray_tpu.api import _core_worker
+
+            cw = _core_worker()
+            ref = weakref.ref(self)
+
+            def _on_gcs_reconnect():
+                state = ref()
+                if state is None:
+                    return
+                with state.lock:
+                    state.table_version = -1
+                    state.table_ts = 0.0
+                state.controller = None
+
+            cw.gcs.on_reconnect.append(_on_gcs_reconnect)
+            self._reconnect_hooked = True
+        except Exception:
+            pass
 
     def apply_route_info(self, info: dict, now: float | None = None):
         update = info.get("update")

@@ -206,7 +206,7 @@ def test_impala_learns_cartpole(local_cluster):
         lr=1e-3, entropy_coeff=0.01, seed=1).build()
     best = 0.0
     try:
-        assert isinstance(algo._dag, ChannelCompiledDAG), \
+        assert isinstance(algo._dag.dag, ChannelCompiledDAG), \
             "IMPALA fell back off the compiled-DAG plane"
         assert algo._dag.channel_kinds["shm"] > 0
         # device edges are ON by default (ISSUE 12): agg→learner
@@ -242,7 +242,7 @@ def test_impala_learns_cartpole(local_cluster):
         import ray_tpu as rt
         from ray_tpu.dag.device_channel import pack_device_tree
 
-        dev_inputs = algo._dag._device_input_channels
+        dev_inputs = algo._dag.dag._device_input_channels
         assert dev_inputs, "weight-broadcast edges are not device-kind"
         assert sum(ch.device_arrays for ch in dev_inputs) > 0, \
             "no weight arrays rode the device framing"

@@ -217,6 +217,156 @@ def measure_sched(rt, cluster, target_nodes: int = 8,
     }
 
 
+# ---------------------------------------------------------- chaos legs
+# Recovery SLOs under injected faults (tools/chaos.py; ref analog: the
+# nightly chaos suites — kill things on a cadence under load, assert
+# the workload's recovery envelope, not just survival).
+
+def measure_chaos_tasks(rt, cluster, *, tasks: int = 40) -> dict:
+    """SLO: every submitted task completes despite a sudden node loss
+    mid-flight (task retries + lineage re-execution of lost objects)."""
+    from chaos import ChaosMonkey
+
+    node = cluster.add_node(num_cpus=2)
+
+    @rt.remote(num_cpus=0.5, scheduling_strategy="SPREAD")
+    def work(i):
+        time.sleep(0.3)
+        return i
+
+    monkey = ChaosMonkey(cluster)
+    refs = [work.remote(i) for i in range(tasks)]
+    monkey.at(0.5, monkey.kill_worker_node,
+              cluster.worker_nodes.index(node)).start()
+    t0 = time.monotonic()
+    got = rt.get(refs, timeout=300)
+    wall = time.monotonic() - t0
+    monkey.stop()
+    assert sorted(got) == list(range(tasks)), got
+    assert all(e["ok"] for e in monkey.log), monkey.log
+    return {"tasks": tasks, "completed": len(got), "nodes_killed": 1,
+            "wall_s": round(wall, 2)}
+
+
+def measure_chaos_dag(rt, *, ticks: int = 10,
+                      kill_at_tick: int = 3) -> dict:
+    """SLO: a compiled-DAG ring runner killed mid-tick — the driver
+    detects the death, recompiles the ring and resumes (epoch bump);
+    every tick's result still arrives (in-flight ticks re-run from the
+    driver's retained inputs)."""
+    from chaos import ChaosMonkey
+
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.recovery import RecoverableDag
+
+    @rt.remote(num_cpus=0.1, max_restarts=-1)
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    a, b = Stage.remote(), Stage.remote()
+
+    def compile_fn(epoch=0, recovered_from=""):
+        with InputNode() as inp:
+            out = b.step.bind(a.step.bind(inp))
+        return out.experimental_compile(
+            epoch=epoch, recovered_from=recovered_from)
+
+    dag = RecoverableDag(compile_fn, name="chaos-ring")
+    monkey = ChaosMonkey()
+    results = []
+    t0 = time.monotonic()
+    for i in range(ticks):
+        ref = dag.execute(i)
+        if i == kill_at_tick:
+            monkey.kill_actor(a)   # synchronous mid-tick injection
+        results.append(ref.get(timeout=180))
+    wall = time.monotonic() - t0
+    recoveries, epoch = dag.recoveries, dag.epoch
+    dag.teardown()
+    for h in (a, b):
+        rt.kill(h)
+    assert results == [i + 2 for i in range(ticks)], results
+    assert recoveries >= 1, "runner death went undetected"
+    return {"ticks": ticks, "ticks_lost": 0, "recoveries": recoveries,
+            "epoch": epoch,
+            # teardown -> restart -> recompile -> resume wall time, as
+            # measured by the recovery engine itself (timing the kill
+            # tick's get() undercounts: pipelining may have buffered it)
+            "recovery_s": round(dag.last_recovery_s, 2),
+            "wall_s": round(wall, 2)}
+
+
+def measure_chaos_serve(rt, *, load_s: float = 8.0,
+                        drivers: int = 2) -> dict:
+    """SLO: serve controller killed under load — ZERO failed requests
+    (handles keep routing on their last table, then self-heal the
+    controller, which restores its checkpoint and adopts the live
+    replicas instead of cold-starting new ones)."""
+    import threading
+
+    from chaos import ChaosMonkey
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="chaos_app")
+    assert handle.remote(0).result(timeout=30) == 0
+    before = set()
+    with handle._router.lock:
+        before = {r._actor_id.hex() for r in handle._replicas}
+
+    stats = {"ok": 0, "fail": 0}
+    stop = threading.Event()
+
+    def drive():
+        i = 0
+        while not stop.is_set():
+            try:
+                assert handle.remote(i).result(timeout=60) == i
+                stats["ok"] += 1
+            except Exception:
+                stats["fail"] += 1
+            i += 1
+
+    threads = [threading.Thread(target=drive, daemon=True)
+               for _ in range(drivers)]
+    for t in threads:
+        t.start()
+    try:
+        monkey = ChaosMonkey()
+        time.sleep(1.0)
+        t_kill = time.monotonic()
+        monkey.kill_serve_controller()
+        restored_s = None
+        deadline = time.monotonic() + load_s
+        while time.monotonic() < deadline:
+            if restored_s is None:
+                try:
+                    c = serve._controller(create=False)
+                    rt.get(c.list_applications.remote(), timeout=5)
+                    restored_s = time.monotonic() - t_kill
+                except Exception:
+                    pass
+            time.sleep(0.25)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    with handle._router.lock:
+        after = {r._actor_id.hex() for r in handle._replicas}
+    serve.shutdown()
+    assert stats["fail"] == 0, stats
+    assert restored_s is not None, "controller never came back"
+    return {"requests": stats["ok"], "failed": stats["fail"],
+            "controller_restored_s": round(restored_s, 2),
+            "replicas_adopted": len(before & after),
+            "replicas": len(before)}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=16)
@@ -446,6 +596,22 @@ def main():
 
         _leg(results, "placement_groups_ready_simultaneously", "PGs",
              "1,000+", pg_storm)
+
+        # ---- chaos legs: recovery SLOs under injected faults --------
+        _leg(results, "chaos_task_reexecution_node_kill", "tasks",
+             "nightly chaos: sudden node loss under load, every task "
+             "completes (retries + lineage re-execution)",
+             lambda: measure_chaos_tasks(rt, cluster))
+
+        _leg(results, "chaos_dag_runner_kill_recovery", "ticks",
+             "compiled-DAG ring rides a runner death: detect -> "
+             "recompile -> resume, zero ticks lost",
+             lambda: measure_chaos_dag(rt))
+
+        _leg(results, "chaos_serve_controller_bounce", "requests",
+             "serve data plane rides a controller bounce: zero failed "
+             "requests, replicas adopted not cold-started",
+             lambda: measure_chaos_serve(rt))
     finally:
         cluster.shutdown()
 
