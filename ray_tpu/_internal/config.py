@@ -41,6 +41,29 @@ class Config:
     # Mark a node dead after this many seconds without a heartbeat (used
     # after head restart, when the death-detecting connection is gone).
     node_death_timeout_s: float = 10.0
+    # ---- node drain / preemption lifecycle ----
+    # Default deadline for rt.drain_node when the caller passes none: the
+    # drain coordinator must finish migrating the node's workloads
+    # (actors, serve replicas, PG bundles, sole object copies) within
+    # this budget; at the deadline the node is declared DRAINED with
+    # whatever migrated (remaining workloads fall back to the reactive
+    # death-recovery paths when the node actually goes away).
+    drain_deadline_s: float = 300.0
+    # Poll cadence of the drain coordinator while it waits for migrated
+    # actors to come back ALIVE elsewhere.
+    drain_poll_interval_s: float = 0.25
+    # Preemption watcher (node_manager): when set, each node polls this
+    # file path (formatted with {node_id} if present); the file appearing
+    # simulates the TPU maintenance-event endpoint and the node
+    # self-initiates a drain. The file body may be JSON
+    # {"deadline_s": ..., "reason": ...}; empty body uses defaults.
+    preemption_notice_file: str = ""
+    preemption_poll_interval_s: float = 1.0
+    # A PENDING placement group whose driver has not polled
+    # get_pending_demand status for this long is pruned as abandoned
+    # (was a hardcoded 15s; the prune now records a WARNING
+    # `placement_group_pruned` cluster event).
+    pg_pending_poll_timeout_s: float = 15.0
     # ---- scheduler ----
     lease_timeout_s: float = 30.0
     # GCS gives up placing a PENDING actor after this (ref: actor

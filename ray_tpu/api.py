@@ -345,6 +345,29 @@ def nodes() -> list:
     return cw.io.run(cw.gcs.get_all_nodes())
 
 
+def drain_node(node_id, deadline_s: float | None = None,
+               reason: str = "") -> bool:
+    """Start a deadline-bound graceful drain of a node: no new leases
+    land there, restartable actors / serve replicas / placement-group
+    bundles migrate to live nodes, and primary object copies are
+    evacuated before the node is torn down. Accepts a NodeID or its hex
+    string. Returns True if the drain was accepted."""
+    from ray_tpu._internal.ids import NodeID
+
+    if isinstance(node_id, str):
+        node_id = NodeID(bytes.fromhex(node_id))
+    cw = _core_worker()
+    return bool(cw.io.run(cw.gcs.conn.call(
+        "drain_node", (node_id, deadline_s, reason))))
+
+
+def drain_status() -> dict:
+    """Per-node drain records keyed by node-id hex: state
+    (DRAINING/DRAINED/DEAD), reason, deadline, and migrated counts."""
+    cw = _core_worker()
+    return cw.io.run(cw.gcs.conn.call("get_drain_status")) or {}
+
+
 # ----------------------------------------------------------- placement groups
 class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID, bundles, strategy, placement):

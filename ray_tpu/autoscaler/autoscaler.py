@@ -246,6 +246,10 @@ class Autoscaler:
                 demands.extend(dict(b) for b in pg["bundles"])
         demands.extend(pending["actors"])
         demands.extend(pending.get("tasks", []))
+        # a DRAINING node's in-use load counts as pending demand: its
+        # workloads are migrating off, so replacement capacity must
+        # launch before the node is torn down, not after
+        demands.extend(pending.get("draining", []))
         # filter out demands some live node could already satisfy in full
         unmet = []
         for d in demands:
@@ -257,6 +261,8 @@ class Autoscaler:
         for nid, info in self.gcs.nodes.items():
             if not info.alive:
                 continue
+            if (getattr(info, "labels", None) or {}).get("draining"):
+                continue  # scheduler won't place there; neither do we
             avail = self.gcs.node_resources_available.get(nid, {})
             if all(avail.get(r, 0.0) >= amt for r, amt in demand.items()):
                 return True

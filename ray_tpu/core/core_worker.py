@@ -1558,6 +1558,20 @@ class CoreWorker:
                 continue
             return ("unknown",)
 
+    def rpc_add_object_location(self, conn, arg):
+        """A node manager evacuated a copy of an object we own (drain
+        migration): record the new location so reads keep resolving from
+        the copy after the draining node dies — never through lineage
+        re-execution."""
+        oid, node_id = arg
+        meta = self.object_meta.get(oid)
+        if meta is None or not meta.in_shm:
+            return False
+        if node_id not in meta.node_ids and \
+                node_id not in self._dead_nodes:
+            meta.node_ids.append(node_id)
+        return True
+
     def rpc_report_device_object_lost(self, conn, arg):
         """A borrower failed to reach the recorded holder of a device
         object we own: drop the stale meta and lineage-reconstruct if
@@ -2237,8 +2251,11 @@ class CoreWorker:
                     "report_task_demand", demand)
             except Exception:
                 autoscaler_listening = False
-            if not autoscaler_listening:
-                # nothing will ever grow the cluster — fail fast
+            if not autoscaler_listening and "draining" not in str(res[1]):
+                # nothing will ever grow the cluster — fail fast.
+                # Exception: a drain-caused verdict is transient by
+                # construction (migration is freeing capacity right
+                # now), so keep retrying until lease_timeout_s.
                 raise self._infeasible_error(demand, res)
             nm_addr = Address(self.node_address.host, self.node_address.port)
             allow_spill = True

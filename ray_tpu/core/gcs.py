@@ -89,6 +89,15 @@ class GcsServer:
         self._actors_placing: set[ActorID] = set()
         self.jobs: dict[JobID, dict] = {}
         self.placement_groups: dict[PlacementGroupID, dict] = {}
+        # node drain state machine (ALIVE -> DRAINING(deadline, reason)
+        # -> DRAINED | DEAD): record per draining node, snapshotted so a
+        # head restart mid-drain resumes the migration (ref analog:
+        # DrainNodeRequest / autoscaler v2 drain, extended with a
+        # deadline-bound proactive-migration coordinator)
+        self.draining: dict[NodeID, dict] = {}
+        # PGs currently inside _reschedule_pg (re-entrancy guard for the
+        # retry loop vs. drain/death triggered reschedules)
+        self._pgs_rescheduling: set[PlacementGroupID] = set()
         # at-most-once envelope for client-retried mutations, keyed
         # per-client so one chatty client can't evict another client's
         # record before its retry lands: client_id -> (seq -> (ok,
@@ -206,6 +215,7 @@ class GcsServer:
             "named_actors": self.named_actors,
             "jobs": self.jobs,
             "placement_groups": self.placement_groups,
+            "draining": self.draining,
             "dedup_results": {c: dict(t)
                               for c, t in self._dedup_results.items()},
         }, pending_blobs)
@@ -258,6 +268,7 @@ class GcsServer:
         self.named_actors = state.get("named_actors", {})
         self.jobs = state.get("jobs", {})
         self.placement_groups = state.get("placement_groups", {})
+        self.draining = state.get("draining", {})
         from collections import OrderedDict
         saved = state.get("dedup_results", {})
         self._dedup_results = OrderedDict()
@@ -341,6 +352,7 @@ class GcsServer:
         port = await self.server.start(host, port)
         self._bg.append(asyncio.ensure_future(self._metrics_prune_loop()))
         self._bg.append(asyncio.ensure_future(self._heartbeat_gap_loop()))
+        self._bg.append(asyncio.ensure_future(self._pg_reschedule_loop()))
         if self._backend is not None:
             self._bg.append(asyncio.ensure_future(self._flush_loop()))
             self._bg.append(asyncio.ensure_future(self._node_timeout_loop()))
@@ -350,6 +362,11 @@ class GcsServer:
                 if info.state in (ActorState.PENDING, ActorState.RESTARTING) \
                         and aid in self.actor_specs:
                     asyncio.ensure_future(self._schedule_actor(aid))
+            # drains restored mid-flight resume their migration the same
+            # way — the pre-crash coordinator died with the old process
+            for nid, rec in self.draining.items():
+                if rec.get("state") == "DRAINING":
+                    asyncio.ensure_future(self._drain_coordinator(nid))
         logger.info("GCS listening on %s:%s", host, port)
         return port
 
@@ -590,6 +607,19 @@ class GcsServer:
 
     # -------------------------------------------------------------- nodes
     async def rpc_register_node(self, conn: Connection, info: NodeInfo):
+        # A node registering after a COMPLETED drain starts a FRESH
+        # lifecycle: it must not inherit a `draining` label or a stale
+        # drain record from restored snapshot state (drain -> die ->
+        # restart would otherwise come back permanently unschedulable).
+        # But a node RE-registering while its drain is still DRAINING —
+        # the head bounced mid-drain — keeps both: the resumed
+        # coordinator finishes the migration.
+        rec = self.draining.get(info.node_id)
+        if rec is not None and rec.get("state") == "DRAINING":
+            info.labels["draining"] = "1"
+        else:
+            info.labels.pop("draining", None)
+            self.draining.pop(info.node_id, None)
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
         self.node_resources_available[info.node_id] = dict(info.resources_total)
@@ -636,6 +666,19 @@ class GcsServer:
             node_id=node_id.hex(), cause=cause,
             heartbeat_gap_s=round(gap, 3))
         await self.publish(CH_NODE, {"event": "removed", "node": info})
+        # a drain interrupted by the node dying ends DEAD, not DRAINED
+        drain = self.draining.get(node_id)
+        if drain is not None and drain.get("state") == "DRAINING":
+            drain["state"] = "DEAD"
+            self.mark_dirty()
+        # Re-place placement groups with a bundle on the dead node BEFORE
+        # failing over its actors: the replacement bundles' `{r}_pg_*`
+        # resource keys must exist on live nodes for the restarted actors
+        # to land (stale placements served forever was the old behavior).
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg.get("state") == "CREATED" and \
+                    node_id in (pg.get("placement") or []):
+                asyncio.ensure_future(self._reschedule_pg(pg_id))
         # Fail over actors on this node (restart if budget remains).
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (
@@ -720,13 +763,210 @@ class GcsServer:
         return {nid.hex(): self._node_view_entry(nid)
                 for nid in self.nodes}
 
-    def rpc_drain_node(self, conn, node_id: NodeID):
+    def rpc_drain_node(self, conn, arg):
+        """Start a deadline-bound drain (ref analog: DrainNodeRequest +
+        autoscaler v2 drain, extended with proactive migration).
+
+        arg: (node_id, deadline_s, reason) — or a bare NodeID for the
+        legacy label-only form (deadline/reason default). Idempotent: a
+        second drain of a DRAINING node just returns True. The label
+        stops new placement immediately (scheduling_policy filters it);
+        the coordinator then migrates workloads off the node and flips
+        the record to DRAINED (or DEAD if the node dies first)."""
+        from ray_tpu._internal.config import get_config
+
+        if isinstance(arg, (tuple, list)):
+            node_id = arg[0]
+            deadline_s = arg[1] if len(arg) > 1 else None
+            reason = (arg[2] if len(arg) > 2 else "") or ""
+        else:
+            node_id, deadline_s, reason = arg, None, ""
         info = self.nodes.get(node_id)
-        if info is None:
+        if info is None or not info.alive:
             return False
+        if deadline_s is None:
+            deadline_s = get_config().drain_deadline_s
         info.labels["draining"] = "1"
         self._mark_resource_change(node_id)  # view entry carries labels
+        rec = self.draining.get(node_id)
+        if rec is not None and rec.get("state") == "DRAINING":
+            return True  # coordinator already running
+        rec = {
+            "state": "DRAINING",
+            "reason": reason or "requested",
+            "deadline": now() + float(deadline_s),
+            "deadline_s": float(deadline_s),
+            "started": now(),
+            "migrated": {"actors": 0, "placement_groups": 0,
+                         "objects": 0},
+        }
+        self.draining[node_id] = rec
+        self.mark_dirty()
+        self.record_event(
+            source="gcs", kind="node_draining", severity="WARNING",
+            message=(f"node {node_id.hex()[:12]} draining: "
+                     f"{rec['reason']} (deadline {deadline_s:g}s)"),
+            node_id=node_id.hex(), reason=rec["reason"],
+            deadline_s=float(deadline_s))
+        asyncio.ensure_future(self._drain_coordinator(node_id))
         return True
+
+    def rpc_get_drain_status(self, conn, arg=None):
+        """Drain records by node-id hex (read-only; serve controller
+        polls this to find replicas it must migrate, CLI/state API
+        render it)."""
+        return {nid.hex(): dict(rec)
+                for nid, rec in self.draining.items()}
+
+    def _drain_rec(self, node_id: NodeID) -> dict | None:
+        """The node's drain record IF the drain is still live (the node
+        may have died or re-registered mid-coordination)."""
+        rec = self.draining.get(node_id)
+        if rec is None or rec.get("state") != "DRAINING":
+            return None
+        return rec
+
+    async def _drain_coordinator(self, node_id: NodeID):
+        """Migrate a draining node's workloads before teardown, bounded
+        by the drain deadline:
+
+          1. placement groups with a bundle on the node re-place their
+             gang elsewhere (their `{r}_pg_*` keys must exist on live
+             nodes before the member actors move);
+          2. restartable actors fail over via _handle_actor_failure —
+             the NEW incarnation schedules onto another node (the
+             draining label filters this one) while the OLD instance
+             keeps running; once the replacement is ALIVE the old worker
+             is killed (its late death report is absorbed by the stale-
+             worker guard). Non-restartable actors are left alone: serve
+             replicas (max_restarts=0) are migrated by their controller,
+             which watches get_drain_status;
+          3. sole-copy objects on the node are pushed to live peers
+             (node_manager evacuate_objects) so readers never need
+             lineage re-execution after teardown;
+          4. wait (deadline-bound) for the node to empty of ALIVE
+             actors, then flip the record to DRAINED and emit the
+             node_drained event with per-workload migration counts.
+
+        Re-entrant: a head restart mid-drain resumes here from the
+        restored record, and every phase only acts on workloads still
+        on the node."""
+        from ray_tpu._internal.config import get_config
+
+        rec = self._drain_rec(node_id)
+        if rec is None:
+            return
+        poll = max(0.05, get_config().drain_poll_interval_s)
+        try:
+            # -- phase 1: placement groups off the node (gang re-place)
+            for pg_id, pg in list(self.placement_groups.items()):
+                if self._drain_rec(node_id) is None:
+                    return
+                if pg.get("state") == "CREATED" and \
+                        node_id in (pg.get("placement") or []):
+                    if await self._reschedule_pg(pg_id,
+                                                 exclude=node_id):
+                        rec["migrated"]["placement_groups"] += 1
+                        self.mark_dirty()
+            # -- phase 2: restartable actors fail over (make-before-
+            # break: old instance keeps serving until the new one lands)
+            migrating: list[ActorInfo] = []
+            for actor in list(self.actors.values()):
+                if self._drain_rec(node_id) is None:
+                    return
+                if actor.node_id != node_id:
+                    continue
+                if actor.state == ActorState.RESTARTING:
+                    # restored mid-failover (head restart): the
+                    # _schedule_actor resumed in start() owns the
+                    # replacement — adopt the wait, don't re-fail it
+                    migrating.append(actor)
+                    continue
+                if actor.state != ActorState.ALIVE:
+                    continue
+                if actor.max_restarts == 0:
+                    continue  # controller-managed (serve) or pinned
+                await self._handle_actor_failure(
+                    actor, f"node draining: {rec['reason']}")
+                migrating.append(actor)
+            for actor in migrating:
+                while now() < rec["deadline"] and \
+                        actor.state == ActorState.RESTARTING and \
+                        self._drain_rec(node_id) is not None:
+                    await asyncio.sleep(poll)
+                if self._drain_rec(node_id) is None:
+                    return
+                if actor.state == ActorState.ALIVE and \
+                        actor.node_id != node_id:
+                    rec["migrated"]["actors"] += 1
+                    self.mark_dirty()
+                # the old incarnation still runs on the draining node —
+                # stop it now that (or whether) the replacement landed
+                conn = self.node_conns.get(node_id)
+                if conn is not None:
+                    try:
+                        await conn.call("kill_actor_worker",
+                                        actor.actor_id, timeout=10)
+                    except Exception:
+                        pass
+            # -- phase 3: evacuate object copies whose only home is the
+            # draining node (push to live peers; owners learn the new
+            # location so post-teardown reads never hit lineage)
+            conn = self.node_conns.get(node_id)
+            targets = [
+                (nid, info.address)
+                for nid, info in self.nodes.items()
+                if info.alive and nid != node_id
+                and nid in self.node_conns
+                and not (info.labels or {}).get("draining")]
+            if conn is not None and targets:
+                budget = max(5.0, rec["deadline"] - now())
+                try:
+                    moved = await conn.call("evacuate_objects", targets,
+                                            timeout=budget)
+                    rec["migrated"]["objects"] += int(moved or 0)
+                    self.mark_dirty()
+                except Exception as e:
+                    logger.warning("drain %s: object evacuation "
+                                   "failed: %s", node_id, e)
+            # -- phase 4: deadline-bound wait for the node to empty
+            # RESTARTING counts as still-on-the-node: its replacement is
+            # in flight and node_id only moves once that lands — flipping
+            # DRAINED early would let a re-register shed the record
+            # while the migration is unfinished
+            while now() < rec["deadline"]:
+                if self._drain_rec(node_id) is None:
+                    return
+                if not any(a.node_id == node_id
+                           and a.state in (ActorState.ALIVE,
+                                           ActorState.RESTARTING)
+                           for a in self.actors.values()):
+                    break
+                await asyncio.sleep(poll)
+            if self._drain_rec(node_id) is None:
+                return
+            remaining = sum(
+                1 for a in self.actors.values()
+                if a.node_id == node_id
+                and a.state in (ActorState.ALIVE, ActorState.RESTARTING))
+            rec["state"] = "DRAINED"
+            rec["completed"] = now()
+            self.mark_dirty()
+            took = rec["completed"] - rec["started"]
+            mig = rec["migrated"]
+            self.record_event(
+                source="gcs", kind="node_drained", severity="WARNING",
+                message=(f"node {node_id.hex()[:12]} drained in "
+                         f"{took:.1f}s: {mig['actors']} actor(s), "
+                         f"{mig['placement_groups']} placement "
+                         f"group(s), {mig['objects']} object(s) "
+                         f"migrated; {remaining} actor(s) left behind "
+                         f"({rec['reason']})"),
+                node_id=node_id.hex(), reason=rec["reason"],
+                drain_s=round(took, 3), migrated=dict(mig),
+                remaining_actors=remaining)
+        except Exception:
+            logger.exception("drain coordinator for %s failed", node_id)
 
     # --------------------------------------------------------------- jobs
     def rpc_register_job(self, conn, arg):
@@ -1067,8 +1307,14 @@ class GcsServer:
         }
         return placement
 
-    async def _schedule_pg(self, pg_id, bundles, strategy):
-        alive = [(nid, info) for nid, info in self.nodes.items() if info.alive]
+    async def _schedule_pg(self, pg_id, bundles, strategy, exclude=None):
+        """exclude: a node to avoid even if schedulable (the node being
+        drained — its label may not have propagated to every view yet).
+        Draining nodes never receive new bundles (same contract as the
+        lease/actor path in scheduling_policy)."""
+        alive = [(nid, info) for nid, info in self.nodes.items()
+                 if info.alive and nid != exclude
+                 and not (info.labels or {}).get("draining")]
         if not alive:
             return None
         placement: list[NodeID] = []
@@ -1144,6 +1390,82 @@ class GcsServer:
         for nid, i in prepared:
             await self.node_conns[nid].call("pg_commit", (pg_id, i), timeout=10)
         return placement
+
+    async def _reschedule_pg(self, pg_id,
+                             exclude: NodeID | None = None) -> bool:
+        """Gang re-placement of a PG displaced by a dead or draining
+        node (ref analog: gcs_placement_group_manager rescheduling on
+        node death — the piece the old `_on_node_lost` never did).
+
+        A CREATED PG with a bundle on a bad node releases its surviving
+        reservations (all-or-nothing: bundles can't half-move), flips to
+        RESCHEDULING, and re-places the whole gang on live non-draining
+        nodes. On failure it STAYS RESCHEDULING: its bundles read as
+        pending demand (autoscaler launches capacity) and
+        _pg_reschedule_loop retries until placement succeeds."""
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg_id in self._pgs_rescheduling:
+            return False
+        self._pgs_rescheduling.add(pg_id)
+        try:
+            state = pg.get("state")
+            if state == "CREATED":
+                placement = pg.get("placement") or []
+
+                def bad(nid):
+                    info = self.nodes.get(nid)
+                    return (nid == exclude or info is None
+                            or not info.alive
+                            or bool((info.labels or {}).get("draining")))
+
+                if not any(bad(nid) for nid in placement):
+                    return False  # nothing displaced
+                for i, nid in enumerate(placement):
+                    c = self.node_conns.get(nid)
+                    if c is not None:
+                        try:
+                            await c.call("pg_return", (pg_id, i),
+                                         timeout=10)
+                        except Exception:
+                            pass
+                pg["state"] = "RESCHEDULING"
+                pg["placement"] = None
+                pg["last_poll"] = now()
+                self.mark_dirty()
+            elif state != "RESCHEDULING":
+                return False
+            placement = await self._schedule_pg(
+                pg_id, pg["bundles"], pg["strategy"], exclude=exclude)
+            if placement is None:
+                return False
+            pg["placement"] = placement
+            pg["state"] = "CREATED"
+            self.mark_dirty()
+            self.record_event(
+                source="gcs", kind="placement_group_rescheduled",
+                severity="WARNING",
+                message=(f"placement group {pg_id.hex()[:12]} "
+                         f"re-placed on "
+                         f"{sorted({n.hex()[:12] for n in placement})}"),
+                placement_group_id=pg_id.hex(),
+                nodes=[n.hex() for n in placement])
+            return True
+        finally:
+            self._pgs_rescheduling.discard(pg_id)
+
+    async def _pg_reschedule_loop(self):
+        """Retry RESCHEDULING placement groups once capacity appears
+        (a reschedule that found no room parks the PG here; autoscaled
+        or newly registered nodes make the next attempt succeed)."""
+        while True:
+            await asyncio.sleep(1.0)
+            for pg_id, pg in list(self.placement_groups.items()):
+                if pg.get("state") == "RESCHEDULING":
+                    try:
+                        await self._reschedule_pg(pg_id)
+                    except Exception:
+                        logger.exception("pg %s reschedule retry failed",
+                                         pg_id)
 
     async def rpc_remove_placement_group(self, conn, pg_id):
         pg = self.placement_groups.pop(pg_id, None)
@@ -1374,18 +1696,36 @@ class GcsServer:
         infeasible task demands."""
         # prune PENDING PGs whose client stopped polling (gave up/died) —
         # otherwise they'd read as unmet demand forever and the autoscaler
-        # would thrash launch/idle-terminate cycles
+        # would thrash launch/idle-terminate cycles. The window is a
+        # config knob (a paused/debugged driver outlives 15s easily) and
+        # the prune is a WARNING event, so a vanished PG is diagnosable.
+        from ray_tpu._internal.config import get_config
+
         t = now()
+        prune_after = get_config().pg_pending_poll_timeout_s
         for pg_id, pg in list(self.placement_groups.items()):
             if pg.get("state") == "PENDING" and \
-                    t - pg.get("last_poll", t) > 15.0:
+                    t - pg.get("last_poll", t) > prune_after:
+                idle = t - pg.get("last_poll", t)
                 del self.placement_groups[pg_id]
                 self.mark_dirty()
+                self.record_event(
+                    source="gcs", kind="placement_group_pruned",
+                    severity="WARNING",
+                    message=(f"placement group {pg_id.hex()[:12]} "
+                             f"pruned: PENDING with no client poll for "
+                             f"{idle:.1f}s (> {prune_after:g}s — driver "
+                             f"gone?)"),
+                    placement_group_id=pg_id.hex(),
+                    idle_s=round(idle, 3))
+        # RESCHEDULING PGs (displaced by a dead/draining node) are demand
+        # too: their gang needs room on live nodes before the retry loop
+        # can re-place it
         pgs = [
             {"pg_id": pg_id, "bundles": pg["bundles"],
              "strategy": pg["strategy"]}
             for pg_id, pg in self.placement_groups.items()
-            if pg.get("state") == "PENDING"
+            if pg.get("state") in ("PENDING", "RESCHEDULING")
         ]
         actors = []
         for aid, info in self.actors.items():
@@ -1397,7 +1737,29 @@ class GcsServer:
         t = now()
         tasks = [d for d, ts in getattr(self, "task_demands", [])
                  if t - ts < 10.0]
-        return {"placement_groups": pgs, "actors": actors, "tasks": tasks}
+        # a DRAINING node's in-use load is demand-in-waiting: its
+        # workloads are about to migrate, so the autoscaler must launch
+        # replacement capacity NOW, not after the migration stalls.
+        # PG-scoped keys (`CPU_pg_<hex>_<i>`) fold back to their base
+        # resource — a fresh node satisfies CPU, never the scoped key.
+        draining = []
+        for nid, rec in self.draining.items():
+            if rec.get("state") != "DRAINING":
+                continue
+            info = self.nodes.get(nid)
+            if info is None or not info.alive:
+                continue
+            avail = self.node_resources_available.get(nid, {})
+            used: dict[str, float] = {}
+            for r, tot in info.resources_total.items():
+                amt = tot - avail.get(r, 0.0)
+                if amt > 1e-9:
+                    base = r.split("_pg_", 1)[0]
+                    used[base] = used.get(base, 0.0) + amt
+            if used:
+                draining.append(used)
+        return {"placement_groups": pgs, "actors": actors,
+                "tasks": tasks, "draining": draining}
 
     # ---------------------------------------------------------- debugging
     def rpc_cluster_status(self, conn, arg=None):
@@ -1411,9 +1773,18 @@ class GcsServer:
         for nid, info in self.nodes.items():
             h = nid.hex()
             hb = self.node_last_heartbeat.get(nid)
+            drain = self.draining.get(nid)
+            if not info.alive:
+                state = "DEAD"
+            elif drain is not None and drain.get("state") in (
+                    "DRAINING", "DRAINED"):
+                state = drain["state"]
+            else:
+                state = "ALIVE"
             node_rows.append({
                 "node_id": h,
                 "alive": info.alive,
+                "state": state,
                 "address": (f"{info.address.host}:{info.address.port}"
                             if info.address else ""),
                 "labels": dict(info.labels or {}),
@@ -1441,8 +1812,11 @@ class GcsServer:
                 {"placement_group_id": pg_id.hex(),
                  "bundles": pg.get("bundles"),
                  "strategy": pg.get("strategy"),
+                 "state": pg.get("state"),
                  "nodes": [n.hex() for n in pg.get("placement") or []]}
                 for pg_id, pg in self.placement_groups.items()],
+            "drains": {nid.hex(): dict(rec)
+                       for nid, rec in self.draining.items()},
         }
         # monitor-in-head: head_main attaches the autoscaler so `rayt
         # status` can show the instance lifecycle (ref: `ray status`
@@ -1540,6 +1914,7 @@ class GcsClient:
         "list_dags", "summarize_dags",
         "list_cluster_events", "summarize_scheduling", "why_pending",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
+        "get_drain_status",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU
         "report_task_demand", "add_task_events",

@@ -324,6 +324,9 @@ class NodeManager:
             self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         cfg = get_config()
+        if cfg.preemption_notice_file:
+            self._tasks.append(
+                asyncio.ensure_future(self._preemption_watch_loop()))
         for _ in range(cfg.idle_worker_pool_size):
             self._spawn_worker()
         logger.info("node manager %s up at %s", self.node_id, self.address)
@@ -931,6 +934,13 @@ class NodeManager:
         return all(self.resources_total.get(r, 0.0) >= amt - 1e-9
                    for r, amt in demand.items())
 
+    def _draining_self(self) -> bool:
+        """Whether the GCS has marked THIS node draining, read from the
+        synced cluster view (the label is GCS-applied; the sync cadence
+        bounds how long a fresh drain can race a local grant)."""
+        me = self._cluster_view.get(self.node_id.hex())
+        return bool(me and (me.get("labels") or {}).get("draining"))
+
     def _pick_spillback(self, demand: dict[str, float],
                         strategy=None) -> Address | None:
         """Spillback target via the shared hybrid top-k policy (ref:
@@ -1085,6 +1095,19 @@ class NodeManager:
                                       by_capacity=True)
             if nid_hex is not None and nid_hex != self.node_id.hex():
                 return spill(self._cluster_view[nid_hex].get("address"))
+        # A draining node admits NO new leases — not even from a driver
+        # attached to this node manager, which never consults the
+        # cluster-wide placement filter. Redirect to a live peer even on
+        # an already-spilled hop (a peer with a view predating the drain
+        # label may have sent it here; the redirect can't ping-pong back
+        # because the spill pick itself filters draining nodes). With no
+        # peer fitting, report infeasible so the caller's retry loop
+        # lands the task once replacement capacity arrives.
+        if self._draining_self():
+            target = await self._pick_spillback_fresh(demand, strategy)
+            if target is not None:
+                return spill(target)
+            return infeasible("node is draining")
         # PG-bundle demands translate to reserved-resource keys upstream.
         if not self._can_ever_satisfy(demand):
             if allow_spill:
@@ -1562,6 +1585,51 @@ class NodeManager:
             fut.set_result(ok)
         return ok
 
+    async def _preemption_watch_loop(self):
+        """Preemption-notice watcher (simulates the TPU maintenance-
+        event endpoint a preemptible slice would poll): watch the
+        configured notice file; when it appears, self-initiate a
+        deadline-bound drain through the GCS. The file may carry a JSON
+        body {"deadline_s": .., "reason": ..}; an empty or unparsable
+        file drains with the config-default deadline."""
+        cfg = get_config()
+        path = cfg.preemption_notice_file.format(
+            node_id=self.node_id.hex())
+        poll = max(0.05, cfg.preemption_poll_interval_s)
+        while not self._stopping:
+            await asyncio.sleep(poll)
+            try:
+                if not os.path.exists(path):
+                    continue
+            except OSError:
+                continue
+            deadline_s, reason = None, "preemption notice"
+            try:
+                import json
+
+                with open(path) as f:
+                    body = json.load(f)
+                deadline_s = body.get("deadline_s")
+                reason = body.get("reason") or reason
+            except Exception:
+                pass  # empty/garbled notice: defaults
+            self._emit_event(
+                "preemption_notice",
+                f"preemption notice at {path}: self-draining ({reason})",
+                severity="WARNING", notice_file=path, reason=reason)
+            try:
+                ok = await self.gcs_conn.call(
+                    "drain_node", (self.node_id, deadline_s, reason))
+            except Exception:
+                logger.exception("self-drain after preemption notice "
+                                 "failed; retrying")
+                continue
+            if ok:
+                logger.warning("preemption notice %s: node %s draining "
+                               "(%s)", path, self.node_id, reason)
+                return  # drain initiated — the watcher's job is done
+            await asyncio.sleep(poll)
+
     async def _memory_monitor_loop(self):
         """Node OOM guard (ref: memory_monitor.h + retriable-FIFO worker
         killing policy): past the RAM watermark, kill the most recently
@@ -1756,10 +1824,91 @@ class NodeManager:
 
     async def rpc_store_remote_object(self, conn, arg):
         """Pull `object_id` from another node's manager into local shm —
-        chunked, admission-controlled, deduplicated (_PullManager)."""
-        object_id, size, owner, remote_addr = arg
-        return await self._pull_manager.pull(object_id, size, owner,
-                                             remote_addr)
+        chunked, admission-controlled, deduplicated (_PullManager).
+        Optional 5th element pin=True promotes the copy to a durable
+        primary (drain evacuation: the source node is going away, so
+        this copy must not be LRU-evictable)."""
+        object_id, size, owner, remote_addr = arg[:4]
+        pin = bool(arg[4]) if len(arg) > 4 else False
+        ok = await self._pull_manager.pull(object_id, size, owner,
+                                           remote_addr)
+        if ok and pin:
+            meta = self.object_dir.get(object_id)
+            if meta is not None and not meta.get("pinned"):
+                try:
+                    meta["pinned"] = self.shm.pin(object_id)
+                except Exception:
+                    pass
+                self._objects_dirty = True
+        return ok
+
+    async def rpc_evacuate_objects(self, conn, targets):
+        """Drain-time object migration (called by the GCS drain
+        coordinator): push every primary copy living here (pinned in
+        shm or spilled to this node's disk) to a live peer, pinned
+        there, and record the new location with the object's owner — so
+        reads after this node's teardown resolve from the copy instead
+        of lineage re-execution.
+
+        targets: [(NodeID, Address)] of live non-draining peers.
+        Returns the number of objects successfully evacuated."""
+        if not targets:
+            return 0
+        moved = 0
+        peer_conns: dict = {}
+        owner_conns: dict = {}
+
+        async def conn_to(cache, addr):
+            key = (addr.host, addr.port)
+            c = cache.get(key)
+            if c is None or c.closed:
+                c = cache[key] = await connect(addr.host, addr.port)
+            return c
+
+        try:
+            i = 0
+            for oid, meta in list(self.object_dir.items()):
+                if not (meta.get("pinned") or meta.get("spilled")):
+                    continue  # secondary copy: durable home elsewhere
+                size = meta.get("size", 0)
+                owner = meta.get("owner")
+                target_nid, target_addr = targets[i % len(targets)]
+                i += 1
+                try:
+                    c = await conn_to(peer_conns, target_addr)
+                    ok = await c.call(
+                        "store_remote_object",
+                        (oid, size, owner, self.address, True),
+                        timeout=120)
+                except Exception as e:
+                    logger.warning("evacuation of %s to %s failed: %s",
+                                   oid, target_nid, e)
+                    continue
+                if not ok:
+                    continue
+                moved += 1
+                # the owner appends the new location; the draining
+                # node's own entry is pruned by its CH_NODE removal
+                if owner is not None and owner.address is not None:
+                    try:
+                        oc = await conn_to(owner_conns, owner.address)
+                        await oc.call("add_object_location",
+                                      (oid, target_nid), timeout=10)
+                    except Exception:
+                        pass  # owner gone: its refs died with it
+        finally:
+            for c in list(peer_conns.values()) + list(owner_conns.values()):
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+        if moved:
+            self._emit_event(
+                "objects_evacuated",
+                f"{moved} primary object cop(ies) evacuated to "
+                f"{len(targets)} peer(s) ahead of drain",
+                severity="WARNING", moved=moved)
+        return moved
 
     # ------------------------------------------------------------ debugging
     def rpc_list_objects(self, conn, arg=None):

@@ -359,8 +359,18 @@ class DashboardHead:
     async def _nodes(self, request):
         from aiohttp import web
 
+        def state_of(nid, info):
+            if not info.alive:
+                return "DEAD"
+            rec = self.gcs.draining.get(nid)
+            if rec is not None and rec.get("state") in ("DRAINING",
+                                                        "DRAINED"):
+                return rec["state"]
+            return "ALIVE"
+
         nodes = [
             {"node_id": nid.hex(), "alive": info.alive,
+             "state": state_of(nid, info),
              "address": f"{info.address.host}:{info.address.port}",
              "resources_total": info.resources_total,
              "resources_available": self.gcs.node_resources_available.get(

@@ -175,7 +175,7 @@ def _print_cluster_status(status: dict):
     summary lines alone)."""
     nodes = status.get("nodes")
     if nodes:
-        fmt = "{:<14} {:<6} {:>8} {:>8}  {}"
+        fmt = "{:<14} {:<8} {:>8} {:>8}  {}"
         print("nodes:")
         print(fmt.format("node", "state", "hb-age", "pending",
                          "resources (avail/total)"))
@@ -186,11 +186,27 @@ def _print_cluster_status(status: dict):
                 for k, v in sorted(n["resources_total"].items())
                 if k != "memory")
             hb = n.get("heartbeat_age_s")
+            state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
             print(fmt.format(
-                n["node_id"][:14],
-                "ALIVE" if n["alive"] else "DEAD",
+                n["node_id"][:14], state,
                 "—" if hb is None else f"{hb:.1f}s",
                 str(n.get("pending_leases", 0)), res))
+    drains = status.get("drains") or {}
+    active = {h: r for h, r in drains.items()
+              if r.get("state") in ("DRAINING", "DRAINED")}
+    if active:
+        print("drains:")
+        for h, rec in sorted(active.items()):
+            mig = rec.get("migrated", {})
+            mig_s = " ".join(f"{k}={v}" for k, v in sorted(mig.items()))
+            if rec.get("state") == "DRAINING":
+                left = rec.get("deadline", 0) - time.time()
+                print(f"  {h[:14]}  DRAINING ({rec.get('reason', '')}), "
+                      f"{max(0.0, left):.0f}s to deadline  [{mig_s}]")
+            else:
+                took = (rec.get("completed", 0) or 0) - \
+                    (rec.get("started", 0) or 0)
+                print(f"  {h[:14]}  DRAINED in {took:.1f}s  [{mig_s}]")
     pending = status.get("pending_demand") or {}
     if pending:
         print("pending lease demand by shape:")
@@ -217,6 +233,32 @@ def _print_cluster_status(status: dict):
                 e["ts"]).strftime("%H:%M:%S")
             print(f"  {ts}  {e['severity']:<7} {e['source']:<12} "
                   f"{e['kind']:<20} {e['message']}")
+
+
+def cmd_drain(args):
+    from ray_tpu import state_api
+
+    _attach(args)
+    ok = state_api.drain_node(args.node, deadline_s=args.deadline,
+                              reason=args.reason or "cli")
+    if not ok:
+        raise SystemExit(f"drain of {args.node} rejected "
+                         "(unknown or dead node)")
+    print(f"draining {args.node}")
+    if not args.wait:
+        return
+    while True:
+        rec = None
+        for h, r in state_api.drain_status().items():
+            if h.startswith(args.node):
+                rec = r
+        if rec is None or rec.get("state") != "DRAINING":
+            state = rec.get("state") if rec else "?"
+            mig = rec.get("migrated", {}) if rec else {}
+            print(f"drain finished: {state}  " +
+                  " ".join(f"{k}={v}" for k, v in sorted(mig.items())))
+            return
+        time.sleep(0.5)
 
 
 def cmd_summary(args):
@@ -735,6 +777,20 @@ def main(argv=None):
     sp = sub.add_parser("status")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "drain",
+        help="gracefully drain a node: stop new placement, migrate "
+             "actors/replicas/bundles/objects, then DRAINED")
+    sp.add_argument("node", help="node id (hex, prefix ok)")
+    sp.add_argument("--deadline", type=float, default=None,
+                    help="drain deadline in seconds "
+                         "(default: RAYT_DRAIN_DEADLINE_S)")
+    sp.add_argument("--reason", default="")
+    sp.add_argument("--wait", action="store_true",
+                    help="block until the drain leaves DRAINING")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("summary",
                         help="cluster rollup, or `summary tasks` for "

@@ -51,6 +51,13 @@ class ServeController:
         # serving until a new-version replica is READY, so the routing
         # table never goes empty mid-update)
         self._updating: dict[tuple, dict] = {}
+        # proactive drain migration: (app, dep) -> {"victims": [routed
+        # handles on DRAINING nodes], "warming": [replacements not yet
+        # routed], "drain_timeout_s"} — same make-before-break shape as
+        # _updating, driven by the GCS drain state instead of a deploy
+        self._migrating: dict[tuple, dict] = {}
+        # cached DRAINING-node view: (set of node hexes, monotonic ts)
+        self._drain_cache: tuple[set, float] = (set(), 0.0)
         # active health probing: actor_hex -> consecutive failures
         # (ref: deployment_state.py replica health checks)
         self._health_fails: dict[str, int] = {}
@@ -94,6 +101,10 @@ class ServeController:
                              "warming": list(st["warming"]),
                              "drain_timeout_s": st["drain_timeout_s"]}
                          for k, st in self._updating.items()},
+            "migrating": {k: {"victims": list(st["victims"]),
+                              "warming": list(st["warming"]),
+                              "drain_timeout_s": st["drain_timeout_s"]}
+                          for k, st in self._migrating.items()},
             "scale_marks": {k: now - first
                             for k, first in self._scale_marks.items()},
             "autoscale_status": dict(self._autoscale_status),
@@ -143,6 +154,7 @@ class ServeController:
             self._draining = [(h, now + rem)
                               for h, rem in state.get("draining", [])]
             self._updating = state.get("updating", {})
+            self._migrating = state.get("migrating", {})
             self._scale_marks = {k: now - age for k, age in
                                  state.get("scale_marks", {}).items()}
             self._autoscale_status = state.get("autoscale_status", {})
@@ -229,6 +241,10 @@ class ServeController:
         if st is not None:
             for h in st["warming"]:
                 self._kill_quietly(h)
+        mig = self._migrating.pop(key, None)
+        if mig is not None:
+            for h in mig["warming"]:
+                self._kill_quietly(h)
 
     async def delete_application(self, app_name: str) -> bool:
         import ray_tpu as rt
@@ -304,9 +320,17 @@ class ServeController:
             except Exception:
                 self._log_reconcile_error("reconcile")
             try:
+                await self._migrate_tick()
+            except Exception:
+                self._log_reconcile_error("migrate")
+            try:
                 await self._drain_tick()
             except Exception:
                 self._log_reconcile_error("drain")
+            try:
+                await self._self_evacuate_tick()
+            except Exception:
+                self._log_reconcile_error("self-evacuate")
             await asyncio.sleep(0.5)
 
     def _log_reconcile_error(self, phase: str):
@@ -324,6 +348,145 @@ class ServeController:
                 traceback.format_exc())
         except Exception:
             pass  # logging must never take the loop down with it
+
+    async def _self_evacuate_tick(self):
+        """The controller itself may sit on a DRAINING node — nothing
+        else can move it (max_restarts=0, and only IT can hand off its
+        fleet safely). Once every replica hand-off has settled, save a
+        final checkpoint and exit: the next handle request self-heals a
+        fresh controller, which the draining label places on a live node
+        and which ADOPTS the running replicas from the checkpoint."""
+        if self._migrating or self._updating or self._draining:
+            return  # hand-offs still in flight; finish them first
+        import os
+
+        me = os.environ.get("RAYT_NODE_ID", "")
+        if not me or me not in self._draining_nodes():
+            return
+        try:
+            from ray_tpu.core.gcs_event_manager import emit_cluster_event
+
+            emit_cluster_event(
+                source="serve", kind="serve_controller_evacuating",
+                severity="WARNING",
+                message=("serve controller exiting draining node "
+                         f"{me[:12]}; a handle will self-heal it onto "
+                         "a live node from its checkpoint"))
+        except Exception:
+            pass
+        self._save_checkpoint()
+        os._exit(0)
+
+    def _draining_nodes(self) -> set:
+        """Node hexes currently DRAINING per the GCS drain state
+        machine (core/gcs.py rpc_drain_node), cached ~1s so the 0.5s
+        reconcile cadence doesn't double-query."""
+        now = time.monotonic()
+        cached, ts = self._drain_cache
+        if now - ts < 1.0:
+            return cached
+        nodes = cached  # keep the last view across a control-plane hiccup
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            status = cw.io.run(cw.gcs.conn.call("get_drain_status"),
+                               timeout=5.0)
+            nodes = {h for h, rec in (status or {}).items()
+                     if rec.get("state") == "DRAINING"}
+        except Exception:
+            pass
+        self._drain_cache = (nodes, now)
+        return nodes
+
+    def _replica_node(self, handle) -> str:
+        from ray_tpu.core.object_ref import get_core_worker
+
+        try:
+            cw = get_core_worker()
+            info = cw.io.run(cw.gcs.conn.call("get_actor_info",
+                                              handle._actor_id))
+            return (info.node_id.hex()
+                    if info is not None and info.node_id else "")
+        except Exception:
+            return ""
+
+    async def _migrate_tick(self):
+        """Proactive replica migration off DRAINING nodes (the serve leg
+        of the node drain protocol). Make-before-break, mirroring
+        _step_update: replacements warm FIRST (the draining label keeps
+        them off the doomed node); a victim leaves the routing table only
+        when its replacement is READY, then finishes in-flight requests
+        on the _draining list — zero admitted-request failures."""
+        if not self.apps:
+            return
+        draining_nodes = self._draining_nodes()
+        if not draining_nodes and not self._migrating:
+            return
+        changed = False
+        for app_name, specs in list(self.apps.items()):
+            for dep_name, spec in specs.items():
+                key = (app_name, dep_name)
+                if key in self._updating:
+                    continue  # the rolling update already replaces these
+                live = self.replicas.get(key, [])
+                mig = self._migrating.get(key)
+                if mig is None:
+                    if not draining_nodes:
+                        continue
+                    victims = [h for h in live
+                               if self._replica_node(h) in draining_nodes]
+                    if not victims:
+                        continue
+                    mig = self._migrating[key] = {
+                        "victims": victims, "warming": [],
+                        "drain_timeout_s": float(spec.get(
+                            "drain_timeout_s", 30.0) or 0),
+                    }
+                    try:
+                        from ray_tpu.core.gcs_event_manager import \
+                            emit_cluster_event
+
+                        emit_cluster_event(
+                            source="serve", kind="serve_replicas_migrating",
+                            severity="WARNING",
+                            message=(f"{app_name}/{dep_name}: "
+                                     f"{len(victims)} replica(s) on "
+                                     "draining node(s); warming "
+                                     "replacements before de-routing"),
+                            app=app_name, deployment=dep_name,
+                            victims=len(victims))
+                    except Exception:
+                        pass
+                # victims that died on their own leave the queue (the
+                # reconcile target loop replaces them the ordinary way)
+                mig["victims"] = [h for h in mig["victims"] if h in live]
+                while len(mig["warming"]) < len(mig["victims"]):
+                    mig["warming"].append(
+                        self._start_replica(app_name, spec))
+                ready, still = [], []
+                for h in mig["warming"]:
+                    if await self._is_ready(h):
+                        ready.append(h)
+                    else:
+                        still.append(h)
+                mig["warming"] = still
+                for h in ready:
+                    live.append(h)      # route the replacement in ...
+                    changed = True
+                    if mig["victims"]:  # ... and de-route one victim
+                        victim = mig["victims"].pop()
+                        if victim in live:
+                            live.remove(victim)
+                        self._draining.append(
+                            (victim,
+                             time.monotonic() + mig["drain_timeout_s"]))
+                if not mig["victims"] and not mig["warming"]:
+                    del self._migrating[key]
+        if changed:
+            self.version += 1
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._save_checkpoint)
 
     async def _drain_tick(self):
         """Kill draining (de-routed) replicas once their in-flight requests

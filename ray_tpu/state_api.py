@@ -100,6 +100,31 @@ def cluster_status() -> dict:
     return cw.io.run(cw.gcs.conn.call("cluster_status"))
 
 
+def drain_node(node_id: str, deadline_s: Optional[float] = None,
+               reason: str = "") -> bool:
+    """Start a graceful drain of a node (hex id or unique prefix):
+    stop new placement, migrate its workloads, then mark it DRAINED."""
+    from ray_tpu._internal.ids import NodeID
+
+    cw = _cw()
+    matches = [n.node_id for n in cw.io.run(cw.gcs.get_all_nodes())
+               if n.node_id.hex().startswith(node_id)]
+    if len(matches) != 1:
+        raise ValueError(
+            f"node id {node_id!r} matches {len(matches)} nodes")
+    nid: NodeID = matches[0]
+    return bool(cw.io.run(cw.gcs.conn.call(
+        "drain_node", (nid, deadline_s, reason))))
+
+
+def drain_status() -> dict:
+    """Drain records keyed by node-id hex (state / reason / deadline /
+    migrated counts), covering DRAINING, DRAINED, and drain-interrupted
+    (DEAD) nodes."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.conn.call("get_drain_status")) or {}
+
+
 def summary() -> dict:
     """`ray summary`-style rollup."""
     nodes = list_nodes()

@@ -205,6 +205,77 @@ def test_serve_controller_bounce_zero_request_failures(chaos_cluster):
         "restored controller cold-started replicas instead of adopting"
 
 
+def test_impala_preemption_notice_drains_runner_node(
+        fast_recovery, monkeypatch, tmp_path):
+    """Acceptance E2E: a preemption notice lands mid-IMPALA — the node
+    manager self-initiates a drain, the ring runners on the doomed node
+    fail over make-before-break, the RecoverableDag recompiles over the
+    migrated actors, and training keeps learning with zero lost ticks
+    (every train() call returns a result; no fallback off the
+    channel-DAG plane)."""
+    import json
+
+    import ray_tpu as rt
+    from ray_tpu import state_api
+    from ray_tpu._internal import config as cfg_mod
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag.channel_exec import ChannelCompiledDAG
+    from ray_tpu.rl import IMPALAConfig
+
+    monkeypatch.setenv("RAYT_PREEMPTION_NOTICE_FILE",
+                       str(tmp_path / "notice-{node_id}"))
+    monkeypatch.setenv("RAYT_PREEMPTION_POLL_INTERVAL_S", "0.2")
+    cfg_mod.set_config(cfg_mod.load_config())
+
+    with Cluster(head_resources={"CPU": 6.0}) as cluster:
+        node_b = cluster.add_node(num_cpus=6)
+        cluster.connect()
+        algo = IMPALAConfig(
+            env="CartPole-v1", num_env_runners=2, num_envs_per_runner=8,
+            rollout_fragment_length=64, train_batch_size=512,
+            vf_coeff=0.25, lr=1e-3, entropy_coeff=0.01, seed=1).build()
+        try:
+            assert isinstance(algo._dag.dag, ChannelCompiledDAG)
+            algo.train()                # warmup (jit compile)
+            # aim the notice at a node hosting a RUNNER (restartable ->
+            # the drain migrates it); prefer the worker node, which the
+            # learner (max_restarts=0, left in place) tends not to share
+            runner_ids = {a._actor_id.hex()
+                          for a in algo._runners._actors}
+            rows = [a for a in state_api.list_actors(state="ALIVE")
+                    if a["actor_id"] in runner_ids]
+            nodes = {a["node_id"] for a in rows if a["node_id"]}
+            assert nodes, "no live runners found"
+            victim = (node_b.node_id_hex
+                      if node_b.node_id_hex in nodes else nodes.pop())
+            with open(str(tmp_path / f"notice-{victim}"), "w") as f:
+                json.dump({"deadline_s": 60.0,
+                           "reason": "maintenance event"}, f)
+            best = 0.0
+            for _ in range(40):
+                result = algo.train()   # zero lost ticks: every call
+                assert result is not None   # returns a real result
+                best = max(best, result["episode_return_mean"])
+                if best >= 80.0 and algo._dag.recoveries >= 1:
+                    break
+            rec = state_api.drain_status().get(victim)
+            assert rec is not None, "notice never became a drain"
+            assert rec["state"] in ("DRAINING", "DRAINED"), rec
+            assert rec["reason"] == "maintenance event"
+            assert algo._dag.recoveries >= 1, \
+                "drain migration never reached the DAG"
+            assert isinstance(algo._dag.dag, ChannelCompiledDAG), \
+                "IMPALA fell back off the compiled-DAG plane"
+            assert best >= 80.0, f"IMPALA stopped learning: best={best}"
+            # the migrated runners really left the doomed node
+            rows = [a for a in state_api.list_actors(state="ALIVE")
+                    if a["actor_id"] in runner_ids]
+            assert rows and all(a["node_id"] != victim for a in rows), \
+                rows
+        finally:
+            algo.stop()
+
+
 def test_serve_survives_head_bounce(fast_recovery, tmp_path):
     """Handles ride a HEAD bounce: the GCS restarts from its snapshot,
     the client reconnect fires the handle's on_reconnect hook (full

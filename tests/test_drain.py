@@ -1,0 +1,273 @@
+"""Node drain lifecycle: the deadline-bound drain protocol (ref analog:
+DrainNodeRequest + the autoscaler v2 drain path, extended with proactive
+migration).
+
+Covers: rt.drain_node migrates restartable actors make-before-break and
+stops new placement; placement groups with a bundle on a DEAD node
+reschedule their gang onto live nodes (the stale-placement regression);
+a PENDING PG whose client stopped polling is pruned on the config-knob
+window with a WARNING event; a node re-registering after a COMPLETED
+drain sheds the draining label, while a head restart MID-drain restores
+DRAINING state and resumes the migration; the preemption-notice file
+self-initiates a drain; drain events surface through the state API and
+the `rayt status` renderer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import state_api
+from ray_tpu.cluster_utils import Cluster
+
+
+def _wait_drained(node_hex: str, timeout_s: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    rec = None
+    while time.monotonic() < deadline:
+        try:  # tolerate a reconnect window mid-poll (head bounce tests)
+            rec = state_api.drain_status().get(node_hex)
+        except Exception:
+            rec = None
+        if rec is not None and rec.get("state") == "DRAINED":
+            return rec
+        time.sleep(0.2)
+    raise TimeoutError(f"node {node_hex} never reached DRAINED: {rec}")
+
+
+@pytest.fixture
+def _config_env(monkeypatch):
+    """Apply RAYT_* env overrides to this process AND (via
+    RAYT_CONFIG_JSON at spawn) to cluster children."""
+    from ray_tpu._internal import config as cfg_mod
+
+    old = cfg_mod._config
+
+    def apply(**env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        cfg_mod.set_config(cfg_mod.load_config())
+
+    yield apply
+    cfg_mod._config = old
+
+
+# ------------------------------------------------------- tentpole drill
+def test_drain_migrates_actor_and_stops_placement(capsys):
+    """rt.drain_node: the restartable actor on the draining node fails
+    over to the other blue node while the old instance still runs (make
+    before break), new blue demand lands elsewhere, the record flips to
+    DRAINED, and the events + status surfaces tell the story."""
+    with Cluster(head_resources={"CPU": 2.0}) as cluster:
+        node_b = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+        cluster.connect()
+
+        @rt.remote(num_cpus=1, resources={"blue": 1.0}, max_restarts=-1)
+        class Pinned:
+            def where(self):
+                return os.environ["RAYT_NODE_ID"]
+
+        a = Pinned.remote()
+        assert rt.get(a.where.remote(), timeout=90) == node_b.node_id_hex
+        # replacement capacity arrives BEFORE the drain (the normal
+        # preemption flow: autoscaler/operator provisions, then drains)
+        node_c = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+
+        assert rt.drain_node(node_b.node_id_hex, 60.0, "maintenance")
+        rec = _wait_drained(node_b.node_id_hex)
+        assert rec["reason"] == "maintenance"
+        assert rec["migrated"]["actors"] >= 1
+
+        # the actor survived the drain on the OTHER node
+        assert rt.get(a.where.remote(), timeout=90) == node_c.node_id_hex
+        # new placement for blue demand avoids the drained node
+        @rt.remote(num_cpus=0.5, resources={"blue": 0.5})
+        def where():
+            return os.environ["RAYT_NODE_ID"]
+
+        assert rt.get(where.remote(), timeout=90) == node_c.node_id_hex
+
+        # events: node_draining + node_drained with the reason
+        kinds = {}
+        for e in state_api.list_cluster_events(severity="WARNING",
+                                               limit=200):
+            kinds.setdefault(e["kind"], e)
+        assert "node_draining" in kinds
+        assert "node_drained" in kinds
+        assert kinds["node_draining"]["data"]["reason"] == "maintenance"
+        assert "actors" in kinds["node_drained"]["data"]["migrated"]
+
+        # the `rayt status` renderer shows the DRAINED row + drain line
+        from ray_tpu.scripts.cli import _print_cluster_status
+
+        _print_cluster_status(state_api.cluster_status())
+        out = capsys.readouterr().out
+        assert "DRAINED" in out
+        assert "drains:" in out
+
+
+# --------------------------------- satellite: stale-PG placement on death
+def test_pg_reschedules_off_dead_node():
+    """Regression: _on_node_lost used to leave placement_groups pointing
+    at the dead node forever. Now the gang re-places (RESCHEDULING ->
+    CREATED) and an actor scheduled into the PG lands on a LIVE node."""
+    with Cluster(head_resources={"CPU": 2.0}) as cluster:
+        node_b = cluster.add_node(num_cpus=2, resources={"red": 2.0})
+        cluster.connect()
+        pg = rt.placement_group([{"red": 1.0}], strategy="PACK",
+                                timeout=60)
+        assert pg.placement  # reserved on node_b (only red node)
+
+        cluster.remove_node(node_b, graceful=False)
+        node_c = cluster.add_node(num_cpus=2, resources={"red": 2.0})
+
+        @rt.remote(num_cpus=0, resources={"red": 0.5}, max_restarts=0)
+        class InPg:
+            def where(self):
+                return os.environ["RAYT_NODE_ID"]
+
+        a = InPg.options(
+            scheduling_strategy=pg.bundle_strategy(0)).remote()
+        assert rt.get(a.where.remote(),
+                      timeout=120) == node_c.node_id_hex
+        rows = {p["placement_group_id"]: p
+                for p in state_api.list_placement_groups()}
+        assert rows[pg.id.hex()]["state"] == "CREATED"
+        ev = state_api.list_cluster_events(
+            kind="placement_group_rescheduled", limit=50)
+        assert ev, "no placement_group_rescheduled event recorded"
+        rt.remove_placement_group(pg)
+
+
+# ------------------------------------ satellite: PENDING-PG prune knob
+def test_pg_pending_prune_knob_and_event(_config_env):
+    """An unsatisfiable PG whose client stops polling is pruned after
+    the RAYT_PG_PENDING_POLL_TIMEOUT_S window (was a hardcoded 15s) and
+    leaves a placement_group_pruned WARNING in the event log."""
+    _config_env(RAYT_PG_PENDING_POLL_TIMEOUT_S="1.0")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1)
+    try:
+        from ray_tpu._internal.ids import PlacementGroupID
+        from ray_tpu.core.runtime import get_runtime_context
+
+        cw = get_runtime_context().core_worker
+        pg_id = PlacementGroupID.random()
+        placement = cw.io.run(cw.gcs.conn.call(
+            "create_placement_group", (pg_id, [{"CPU": 64.0}], "PACK")))
+        assert placement is None  # infeasible -> PENDING
+        time.sleep(1.3)           # client "gave up": poll gap > knob
+        pending = cw.io.run(cw.gcs.conn.call("get_pending_demand"))
+        assert pg_id not in [p["pg_id"]
+                             for p in pending.get("placement_groups", [])]
+        ev = state_api.list_cluster_events(kind="placement_group_pruned",
+                                           limit=50)
+        assert ev and ev[0]["data"]["placement_group_id"] == pg_id.hex()
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------- satellite: drain -> die -> re-register starts fresh
+def test_reregister_after_completed_drain_clears_label(tmp_path):
+    """A node re-registering after its drain COMPLETED must come back
+    schedulable: the restored snapshot's draining label and the DRAINED
+    record are both shed on register."""
+    cluster = Cluster(gcs_only_head=True,
+                      persist_path=str(tmp_path / "gcs.snap"))
+    node = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+    cluster.connect()
+    try:
+        assert rt.drain_node(node.node_id_hex, 10.0, "scale-in")
+        _wait_drained(node.node_id_hex, timeout_s=30.0)
+        time.sleep(0.5)                # snapshot flush (100ms debounce)
+        cluster.kill_head(graceful=False)
+        cluster.restart_head()
+        # the node's reconnect loop re-registers it: fresh lifecycle
+        deadline = time.monotonic() + 30.0
+        entry = None
+        while time.monotonic() < deadline:
+            try:
+                entry = {n["node_id"]: n for n in state_api.list_nodes()
+                         }.get(node.node_id_hex)
+            except Exception:  # reconnect window
+                entry = None
+            if entry is not None and entry["alive"]:
+                break
+            time.sleep(0.2)
+        assert entry is not None and entry["alive"]
+        assert "draining" not in entry["labels"]
+        assert node.node_id_hex not in state_api.drain_status()
+
+        @rt.remote(num_cpus=1, resources={"blue": 1.0})
+        def where():
+            return os.environ["RAYT_NODE_ID"]
+
+        assert rt.get(where.remote(), timeout=90) == node.node_id_hex
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------- satellite: head restart mid-drain resumes drain
+def test_head_restart_mid_drain_resumes_migration(tmp_path):
+    """The GCS dies while a drain is migrating: the restored snapshot
+    carries the DRAINING record, the re-registering node KEEPS its
+    draining label, and the resumed coordinator finishes the migration
+    (actor ends up ALIVE on the other node, record flips to DRAINED)."""
+    cluster = Cluster(gcs_only_head=True,
+                      persist_path=str(tmp_path / "gcs.snap"))
+    node_b = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+    cluster.connect()
+    try:
+        @rt.remote(num_cpus=1, resources={"blue": 1.0}, max_restarts=-1)
+        class Slow:
+            def __init__(self):
+                time.sleep(2.0)   # keeps the migration in flight
+
+            def where(self):
+                return os.environ["RAYT_NODE_ID"]
+
+        a = Slow.remote()    # only node_b has blue yet
+        assert rt.get(a.where.remote(), timeout=90) == node_b.node_id_hex
+        node_c = cluster.add_node(num_cpus=2, resources={"blue": 2.0})
+
+        assert rt.drain_node(node_b.node_id_hex, 60.0, "preempt")
+        time.sleep(0.6)  # coordinator enters phase 2; snapshot flushes
+        rec = state_api.drain_status().get(node_b.node_id_hex)
+        assert rec is not None and rec["state"] == "DRAINING"
+        cluster.kill_head(graceful=False)
+        cluster.restart_head()
+
+        rec = _wait_drained(node_b.node_id_hex, timeout_s=60.0)
+        assert rec["reason"] == "preempt"
+        assert rt.get(a.where.remote(),
+                      timeout=120) == node_c.node_id_hex
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------- preemption notice self-drain E2E
+def test_preemption_notice_triggers_self_drain(tmp_path, _config_env):
+    """The node manager polls the (TPU-maintenance-event stand-in)
+    notice file and initiates its OWN drain: record appears with the
+    notice's reason/deadline, a preemption_notice WARNING is logged,
+    and the node ends DRAINED."""
+    _config_env(
+        RAYT_PREEMPTION_NOTICE_FILE=str(tmp_path / "notice-{node_id}"),
+        RAYT_PREEMPTION_POLL_INTERVAL_S="0.1")
+    with Cluster(head_resources={"CPU": 2.0}) as cluster:
+        node = cluster.add_node(num_cpus=2)
+        cluster.connect()
+        with open(tmp_path / f"notice-{node.node_id_hex}", "w") as f:
+            json.dump({"deadline_s": 30.0,
+                       "reason": "maintenance event"}, f)
+        rec = _wait_drained(node.node_id_hex, timeout_s=30.0)
+        assert rec["reason"] == "maintenance event"
+        ev = state_api.list_cluster_events(kind="preemption_notice",
+                                           limit=50)
+        assert ev and ev[0]["node_id"] == node.node_id_hex

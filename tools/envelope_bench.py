@@ -367,6 +367,92 @@ def measure_chaos_serve(rt, *, load_s: float = 8.0,
             "replicas": len(before)}
 
 
+def measure_chaos_node_drain(rt, cluster, *, tasks: int = 40) -> dict:
+    """SLO: a node drained under mixed serve+task load — the drain
+    completes within its deadline, ZERO admitted serve requests fail
+    (replacement replicas warm before victims are de-routed), every
+    restartable actor lands back ALIVE on a live node, and every task
+    completes."""
+    import threading
+
+    from chaos import ChaosMonkey
+
+    from ray_tpu import serve, state_api
+
+    node = cluster.add_node(num_cpus=4)
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="drain_app")
+    assert handle.remote(0).result(timeout=30) == 0
+
+    @rt.remote(num_cpus=0.25, max_restarts=-1,
+               scheduling_strategy="SPREAD")
+    class Worker:
+        def ping(self):
+            return 1
+
+    actors = [Worker.remote() for _ in range(4)]
+    rt.get([a.ping.remote() for a in actors], timeout=120)
+
+    @rt.remote(num_cpus=0.25, scheduling_strategy="SPREAD")
+    def work(i):
+        time.sleep(0.2)
+        return i
+
+    stats = {"ok": 0, "fail": 0}
+    stop = threading.Event()
+
+    def drive():
+        i = 0
+        while not stop.is_set():
+            try:
+                assert handle.remote(i).result(timeout=60) == i
+                stats["ok"] += 1
+            except Exception:
+                stats["fail"] += 1
+            i += 1
+
+    thread = threading.Thread(target=drive, daemon=True)
+    thread.start()
+    try:
+        refs = [work.remote(i) for i in range(tasks)]
+        time.sleep(1.0)
+        monkey = ChaosMonkey(cluster)
+        t0 = time.monotonic()
+        nid = monkey.drain_node(cluster.worker_nodes.index(node),
+                                deadline_s=120.0, reason="envelope drill")
+        drained_s = None
+        while time.monotonic() - t0 < 120.0:
+            rec = state_api.drain_status().get(nid)
+            if rec is not None and rec.get("state") == "DRAINED":
+                drained_s = time.monotonic() - t0
+                break
+            time.sleep(0.25)
+        got = rt.get(refs, timeout=300)
+    finally:
+        stop.set()
+        thread.join(timeout=60)
+    # migrated actors must be ALIVE somewhere OTHER than the drained node
+    rt.get([a.ping.remote() for a in actors], timeout=120)
+    for row in state_api.list_actors(state="ALIVE"):
+        if row["class_name"] == "Worker":
+            assert row["node_id"] != nid, row
+    rec = state_api.drain_status().get(nid) or {}
+    serve.shutdown()
+    for a in actors:
+        rt.kill(a)
+    cluster.remove_node(node)
+    assert drained_s is not None, "drain missed its deadline"
+    assert stats["fail"] == 0, stats
+    assert sorted(got) == list(range(tasks)), got
+    return {"requests": stats["ok"], "failed": stats["fail"],
+            "tasks": tasks, "drain_s": round(drained_s, 2),
+            "migrated": rec.get("migrated", {})}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=16)
@@ -612,6 +698,11 @@ def main():
              "serve data plane rides a controller bounce: zero failed "
              "requests, replicas adopted not cold-started",
              lambda: measure_chaos_serve(rt))
+
+        _leg(results, "chaos_node_drain", "requests",
+             "graceful drain under mixed serve+task load: within "
+             "deadline, zero failed requests, actors re-placed live",
+             lambda: measure_chaos_node_drain(rt, cluster))
     finally:
         cluster.shutdown()
 
