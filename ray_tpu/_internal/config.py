@@ -209,6 +209,25 @@ class Config:
     # Recoveries per RecoverableDag lifetime; beyond it the failure is
     # re-raised (a crash-looping actor should fail loudly, not churn).
     dag_recovery_max_attempts: int = 8
+    # ---- serve request-path observability (core/gcs_serve_manager) ----
+    # Gates per-request waterfall recording end-to-end: the proxy mints
+    # a request id (echoed as X-Rayt-Request-Id), each stage stamps its
+    # latency, and proxy/replica publish partial records on the
+    # `serve_state` channel. Disabling removes the per-request capture
+    # cost and all report traffic (the id/header survive — they cost
+    # nothing and stay useful for log correlation).
+    serve_requests_enabled: bool = True
+    # GCS serve-manager memory bound: max retained request records;
+    # beyond it the app holding the most records evicts oldest-first
+    # with per-app dropped accounting (same contract as the
+    # task/object/DAG/event stores).
+    serve_requests_max: int = 2000
+    # Tail-biased retention: errors, sheds, stream aborts, and the
+    # slowest decile are ALWAYS retained; happy-path requests are kept
+    # at this sample rate (1.0 keeps everything; histograms derive from
+    # every finalized record BEFORE the sampling drop, so Prometheus
+    # series stay unskewed at any rate).
+    serve_request_sample: float = 1.0
     # ---- scheduling-plane observability (cluster events + traces) ----
     # Gates the cluster event log AND the lease decision tracer: node
     # managers record per-demand-shape request_lease verdicts and emit

@@ -38,6 +38,8 @@ from ray_tpu.core.gcs_event_manager import (CH_EVENTS,  # noqa: E402
                                             GcsEventManager, shape_key)
 from ray_tpu.core.gcs_object_manager import (CH_OBJECTS,  # noqa: E402
                                              GcsObjectManager)
+from ray_tpu.core.gcs_serve_manager import (CH_SERVE,  # noqa: E402
+                                            GcsServeManager)
 
 CH_NODE = "node_events"          # {"event": "added"|"removed", "node": NodeInfo}
 CH_ACTOR = "actor_events"        # ActorInfo
@@ -137,6 +139,13 @@ class GcsServer:
             stall_grace_s=cfg0.dag_stall_grace_s,
             actor_state=self._actor_state_by_hex,
             event_cb=self._dag_stall_event)
+        # serve request-path state store fed by the `serve_state`
+        # channel: coalesced per-request latency waterfalls from the
+        # ingress proxies + replicas, with tail-biased retention and
+        # engine-report delta metrics (core/gcs_serve_manager.py)
+        self.serve_manager = GcsServeManager(
+            max_requests=cfg0.serve_requests_max,
+            sample=cfg0.serve_request_sample)
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -446,6 +455,13 @@ class GcsServer:
             self.dag_manager.ingest(message)
             # report deltas derive the rayt_dag_* Prometheus family
             recs = self.dag_manager.drain_metric_records()
+            if recs:
+                self.metrics_store.ingest_many(recs)
+        elif channel == CH_SERVE:
+            self.serve_manager.ingest(message)
+            # finalized records + engine-report deltas derive the
+            # rayt_serve_{ttft,tpot,queue_wait,prefill,engine_*} family
+            recs = self.serve_manager.drain_metric_records()
             if recs:
                 self.metrics_store.ingest_many(recs)
         dead = []
@@ -1558,6 +1574,24 @@ class GcsServer:
         """State API `summarize_dags` backend: DAG counts by state,
         tick/byte/blocked-time totals, and current stalls."""
         return self.dag_manager.summarize(**dict(arg or {}))
+
+    def rpc_list_serve_requests(self, conn, arg=None):
+        """State API `list_serve_requests` backend: filtered coalesced
+        per-request latency-waterfall records (app / outcome / model id
+        / errors-only / min-e2e / slowest-first, limit) with per-app
+        eviction + sampling accounting — server-side, no full-store
+        dump to the client."""
+        return self.serve_manager.list(**dict(arg or {}))
+
+    def rpc_summarize_serve_requests(self, conn, arg=None):
+        """State API `summarize_serve_requests` backend: per-app
+        request/outcome counts + waterfall-stage and TTFT/TPOT/e2e
+        p50/p99 rollups (`rayt serve status`'s table)."""
+        return self.serve_manager.summarize(**dict(arg or {}))
+
+    def rpc_get_serve_request(self, conn, request_id: str):
+        """One request record by id (hex prefix accepted)."""
+        return self.serve_manager.get(request_id or "")
 
     def rpc_list_cluster_events(self, conn, arg=None):
         """State API `list_cluster_events` backend: filtered event-log

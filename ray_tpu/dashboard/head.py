@@ -9,6 +9,10 @@ Endpoints:
                                   histogram buckets included)
   GET  /api/cluster_status      — GCS cluster summary
   GET  /api/nodes | /api/actors | /api/jobs | /api/serve
+  GET  /api/serve/requests      — ?app=&outcome=&model_id=&errors=&slow=
+                                  &limit= per-request latency waterfalls
+                                  (GCS serve manager) + per-app stage
+                                  p50/p99 rollup
   GET  /api/metrics/names       — metric directory (name/kind/tag keys)
   GET  /api/metrics/query       — ?name=&window=&step=&agg=&merge=&tag.K=V
                                   aligned time series from the store
@@ -301,6 +305,7 @@ class DashboardHead:
         app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/serve", self._serve)
+        app.router.add_get("/api/serve/requests", self._serve_requests)
         app.router.add_get("/api/data", self._data)
         app.router.add_get("/api/metrics/names", self._metrics_names)
         app.router.add_get("/api/metrics/query", self._metrics_query)
@@ -443,6 +448,28 @@ class DashboardHead:
                 for (app, dep), entry in sorted(deployments.items())],
             "replicas_alive": replicas_alive,
         })
+
+    async def _serve_requests(self, request):
+        """Per-request latency waterfalls + per-app stage rollup (GCS
+        serve manager; the Serve tab's waterfall feed and the
+        `rayt list requests` twin). Query params mirror the CLI:
+        ?app=&outcome=&model_id=&errors=1&slow=1&limit=."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.serve_manager.list(
+                app=q.get("app") or None,
+                outcome=q.get("outcome") or None,
+                model_id=q.get("model_id") or None,
+                errors_only=q.get("errors", "") in ("1", "true", "yes"),
+                slow=q.get("slow", "") in ("1", "true", "yes"),
+                limit=int(q.get("limit", 50)))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        out["summary"] = self.gcs.serve_manager.summarize(
+            app=q.get("app") or None)
+        return web.json_response(out)
 
     async def _data(self, request):
         """Data-plane overview from the metrics pipeline: per-op exchange

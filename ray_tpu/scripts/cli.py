@@ -2,9 +2,12 @@
 util/state/state_cli.py). Invoke as `python -m ray_tpu <command>`.
 
 Commands: start, stop, status, summary [tasks], list {nodes,actors,jobs,
-pgs,workers,tasks,objects,dags,events}, dag <id>, why-pending <task_id>,
-memory, timeline, microbenchmark, job {submit,status,logs,stop,list}
-(ref analog for jobs: dashboard/modules/job/cli.py).
+pgs,workers,tasks,objects,dags,events,requests}, dag <id>, why-pending
+<task_id>, memory, timeline, microbenchmark, job
+{submit,status,logs,stop,list} (ref analog for jobs:
+dashboard/modules/job/cli.py). `list requests` renders per-request
+serve latency waterfalls; `serve status` appends the per-app stage
+p50/p99 table.
 """
 
 from __future__ import annotations
@@ -296,6 +299,59 @@ def _print_task_summary(s: dict):
                          dur(e["exec_time_mean_s"]), states))
 
 
+def _fmt_lat(v) -> str:
+    if v is None:
+        return "—"
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _print_requests(out: dict):
+    """`rayt list requests` view: one line per request with its stage
+    waterfall (proxy tiling first, then the nested replica/engine
+    breakdowns when the record has them)."""
+    reqs = out.get("requests", ())
+    fmt = "{:<12} {:<12} {:<14} {:>9} {:>9} {:>9}  {}"
+    print(fmt.format("request", "app", "outcome", "e2e", "ttft",
+                     "tpot", "waterfall"))
+    for r in reqs:
+        st = r.get("stages") or {}
+        wf = " > ".join(
+            f"{k[:-2]} {_fmt_lat(st[k])}"
+            for k in ("admission_s", "router_s", "dispatch_s",
+                      "stream_s")
+            if st.get(k) is not None)
+        rs = r.get("replica_stages") or {}
+        eng = r.get("engine") or {}
+        if rs:
+            wf += (f" | replica[queue {_fmt_lat(rs.get('queue_s'))} "
+                   f"service {_fmt_lat(rs.get('service_s'))}]")
+        if eng:
+            occ = eng.get("occupancy_mean")
+            wf += (f" | engine[queue {_fmt_lat(eng.get('queue_s'))} "
+                   f"prefill {_fmt_lat(eng.get('prefill_s'))}"
+                   f"x{eng.get('prefill_chunks', 0)} "
+                   f"ttft {_fmt_lat(eng.get('ttft_s'))} "
+                   f"tpot {_fmt_lat(eng.get('tpot_s'))}"
+                   + (f" occ {occ:.2f}" if occ is not None else "")
+                   + "]")
+        tail = ""
+        if r.get("model_id"):
+            tail = f" model={r['model_id']}"
+            if r.get("affinity"):
+                tail += f"({r['affinity']})"
+        print(fmt.format(r.get("request_id", "")[:12],
+                         (r.get("app") or "")[:12],
+                         r.get("outcome") or "ok",
+                         _fmt_lat(r.get("e2e_s")),
+                         _fmt_lat(r.get("ttft_s")),
+                         _fmt_lat(r.get("tpot_s")), wf + tail))
+    dropped = sum((out.get("dropped") or {}).values())
+    sampled = sum((out.get("sampled_out") or {}).values())
+    print(f"-- {out.get('total', 0)} matched "
+          f"({out.get('truncated', 0)} truncated, {dropped} evicted, "
+          f"{sampled} sampled out)")
+
+
 def cmd_list(args):
     from ray_tpu import state_api
 
@@ -321,6 +377,16 @@ def cmd_list(args):
             source=getattr(args, "source", None) or None,
             limit=args.limit, detail=True)
         print(json.dumps(out, indent=2, default=str))
+        return
+    if kind == "requests":
+        out = state_api.list_serve_requests(
+            app=args.app or None,
+            outcome=getattr(args, "outcome", None) or None,
+            model_id=getattr(args, "model_id", None) or None,
+            errors_only=bool(getattr(args, "errors", False)),
+            slow=bool(getattr(args, "slow", False)),
+            limit=args.limit, detail=True)
+        _print_requests(out)
         return
     if kind == "dags":
         out = state_api.list_dags(
@@ -629,6 +695,45 @@ def cmd_serve_status(args):
     for app in apps:
         out[app] = rt.get(ctl.get_deployments.remote(app), timeout=30)
     print(json.dumps(out, indent=1))
+    try:
+        from ray_tpu import state_api
+
+        _print_serve_waterfall(state_api.summarize_serve_requests())
+    except Exception:
+        pass  # pre-observability GCS / no requests yet: plain status
+
+
+def _print_serve_waterfall(summ: dict):
+    """Per-app p50/p99/mean table over the waterfall stages (from the
+    GCS serve manager's retained records)."""
+    from ray_tpu.core.gcs_serve_manager import (NESTED_STAGES,
+                                                WATERFALL_STAGES)
+
+    apps = summ.get("apps") or {}
+    if not apps:
+        return
+    fmt = "  {:<20} {:>9} {:>9} {:>9} {:>6}"
+    for app, e in apps.items():
+        oc = " ".join(f"{k}={v}"
+                      for k, v in sorted(e.get("outcomes", {}).items()))
+        print(f"\napp {app!r}: {e.get('count', 0)} requests ({oc})")
+        print(fmt.format("stage", "p50", "p99", "mean", "n"))
+        stages = e.get("stages") or {}
+        rows = [("e2e", e.get("e2e")), ("ttft", e.get("ttft")),
+                ("tpot", e.get("tpot"))]
+        rows += [(k, stages.get(k))
+                 for k in WATERFALL_STAGES + NESTED_STAGES]
+        for name, roll in rows:
+            if not roll or not roll.get("n"):
+                continue
+            print(fmt.format(name, _fmt_lat(roll.get("p50")),
+                             _fmt_lat(roll.get("p99")),
+                             _fmt_lat(roll.get("mean")), roll["n"]))
+    dropped = sum((summ.get("dropped") or {}).values())
+    sampled = sum((summ.get("sampled_out") or {}).values())
+    print(f"\n{summ.get('finalized_total', 0)} requests finalized, "
+          f"{summ.get('total_requests', 0)} retained "
+          f"({dropped} evicted, {sampled} sampled out)")
 
 
 def cmd_serve_shutdown(args):
@@ -803,7 +908,18 @@ def main(argv=None):
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
                                      "workers", "tasks", "objects",
-                                     "dags", "events"])
+                                     "dags", "events", "requests"])
+    sp.add_argument("--app", help="requests: filter by serve app")
+    sp.add_argument("--outcome",
+                    help="requests: filter by outcome (ok/error/shed/"
+                         "timeout/queue_full/no_replicas/"
+                         "stream_aborted)")
+    sp.add_argument("--model-id", dest="model_id",
+                    help="requests: filter by multiplexed model id")
+    sp.add_argument("--errors", action="store_true",
+                    help="requests: only non-ok outcomes")
+    sp.add_argument("--slow", action="store_true",
+                    help="requests: order by e2e latency descending")
     sp.add_argument("--job", help="tasks/objects/dags/events: filter "
                                   "by job id (hex)")
     sp.add_argument("--state", help="tasks: filter by lifecycle state")

@@ -259,6 +259,15 @@ class ServeController:
             self._signal_cache.pop((app_name, dep_name), None)
             self._autoscale_status.pop(f"{app_name}/{dep_name}", None)
         self.version += 1
+        # purge the app's request-observability ledger (retained
+        # records, pending partials, engine baselines) — a redeploy
+        # starts clean
+        try:
+            from ray_tpu.serve.request_context import publish_record
+
+            publish_record({"kind": "app_deleted", "app": app_name})
+        except Exception:
+            pass
         await asyncio.get_running_loop().run_in_executor(
             None, self._save_checkpoint)
         return True

@@ -21,6 +21,7 @@ exceptions with INTERNAL.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any
 
 from ray_tpu.serve.admission import (AdmissionWindow, count_admitted,
@@ -110,7 +111,32 @@ class GrpcProxyActor:
             multiplexed_model_id=model_id or None,
             queue_timeout_s=min(queue_timeout_s(),
                                 self._request_timeout()))
-        return app_name, handle, req.get("payload")
+        return app_name, handle, req.get("payload"), model_id
+
+    # --------------------------------------- request-path observability
+    @staticmethod
+    def _new_context(context) -> dict:
+        """Mint the request id (parity with the HTTP proxy's
+        X-Rayt-Request-Id: echoed to the caller as initial metadata) and
+        start the request context that rides the handle envelope."""
+        from ray_tpu.serve.request_context import mint_request_id
+
+        rid = mint_request_id()
+        try:
+            context.send_initial_metadata(
+                (("x-rayt-request-id", rid),))
+        except Exception:
+            pass
+        return {"request_id": rid, "start_ts": time.time()}
+
+    @staticmethod
+    def _record(ctx: dict, app_name: str, outcome: str, **kw):
+        """Same record shape as the HTTP side — one assembly path, so
+        `rayt list requests` / summaries treat both protos uniformly."""
+        from ray_tpu.serve.proxy import ProxyActor
+
+        ProxyActor._finish_record(ctx, app_name, outcome, proto="grpc",
+                                  **kw)
 
     def _request_timeout(self) -> float:
         if self._timeout_override is not None:
@@ -160,45 +186,106 @@ class GrpcProxyActor:
         return _Abort(grpc.StatusCode.INTERNAL, repr(e))
 
     def _predict(self, request_bytes: bytes, context) -> bytes:
+        from ray_tpu._internal.otel import (current_context_carrier,
+                                            submit_span)
+
+        t0 = time.perf_counter()
         try:
-            app_name, handle, payload = self._resolve(request_bytes)
+            app_name, handle, payload, model_id = \
+                self._resolve(request_bytes)
         except _Abort as e:
             context.abort(e.code, e.detail)
             return
-        try:
-            self._admit(app_name, handle)
-        except _Abort as e:
-            context.abort(e.code, e.detail)
-            return
-        try:
-            result = handle.remote(payload).result(
-                timeout=self._request_timeout())
-            return json.dumps(result, default=str).encode()
-        except Exception as e:
-            a = self._abort_for(app_name, e)
-            context.abort(a.code, a.detail)
-        finally:
-            self._admission.release(app_name)
+        ctx = self._new_context(context)
+        handle = handle.options(request_context=ctx)
+        with submit_span("serve.proxy.request", app=app_name,
+                         request_id=ctx["request_id"], proto="grpc"):
+            try:
+                ctx["trace"] = current_context_carrier()
+            except Exception:
+                pass
+            try:
+                self._admit(app_name, handle)
+            except _Abort as e:
+                self._record(ctx, app_name, "shed", t0=t0)
+                context.abort(e.code, e.detail)
+                return
+            t1 = time.perf_counter()
+            try:
+                result = handle.remote(payload).result(
+                    timeout=self._request_timeout())
+                self._record(ctx, app_name, "ok", t0=t0, t1=t1,
+                             model_id=model_id)
+                return json.dumps(result, default=str).encode()
+            except Exception as e:
+                from ray_tpu.serve.proxy import ProxyActor
+
+                self._record(ctx, app_name, ProxyActor._outcome_for(e),
+                             t0=t0, t1=t1, model_id=model_id)
+                a = self._abort_for(app_name, e)
+                context.abort(a.code, a.detail)
+            finally:
+                self._admission.release(app_name)
 
     def _predict_stream(self, request_bytes: bytes, context):
+        from ray_tpu._internal.otel import (current_context_carrier,
+                                            submit_span)
+
+        t0 = time.perf_counter()
         try:
-            app_name, handle, payload = self._resolve(request_bytes)
+            app_name, handle, payload, model_id = \
+                self._resolve(request_bytes)
         except _Abort as e:
             context.abort(e.code, e.detail)
             return
-        try:
-            self._admit(app_name, handle)
-        except _Abort as e:
-            context.abort(e.code, e.detail)
-            return
-        try:
-            for item in handle.options(stream=True).remote(payload):
-                yield json.dumps(item, default=str).encode()
-        except Exception as e:
-            a = self._abort_for(app_name, e)
-            context.abort(a.code, a.detail)
-        finally:
-            self._admission.release(app_name)
+        ctx = self._new_context(context)
+        handle = handle.options(request_context=ctx)
+        with submit_span("serve.proxy.request", app=app_name,
+                         request_id=ctx["request_id"], proto="grpc"):
+            try:
+                ctx["trace"] = current_context_carrier()
+            except Exception:
+                pass
+            try:
+                self._admit(app_name, handle)
+            except _Abort as e:
+                self._record(ctx, app_name, "shed", t0=t0)
+                context.abort(e.code, e.detail)
+                return
+            t1 = time.perf_counter()
+            t_first = None
+            chunks = 0
+            try:
+                for item in handle.options(stream=True).remote(payload):
+                    if t_first is None:
+                        t_first = time.perf_counter()
+                    chunks += 1
+                    yield json.dumps(item, default=str).encode()
+                t_end = time.perf_counter()
+                self._record(
+                    ctx, app_name, "ok", t0=t0, t1=t1, t_first=t_first,
+                    t_end=t_end, model_id=model_id,
+                    ttft_s=(t_first - t0) if t_first is not None
+                    else None,
+                    tpot_s=((t_end - t_first) / (chunks - 1)
+                            if t_first is not None and chunks > 1
+                            else None),
+                    chunks=chunks)
+            except Exception as e:
+                from ray_tpu.serve.proxy import ProxyActor
+
+                # before the first message the caller still gets a real
+                # status code; after it, this is a mid-stream abort —
+                # same outcome split as the HTTP SSE path
+                outcome = ("stream_aborted" if chunks
+                           else ProxyActor._outcome_for(e))
+                self._record(ctx, app_name, outcome, t0=t0, t1=t1,
+                             t_first=t_first, model_id=model_id,
+                             chunks=chunks)
+                a = self._abort_for(app_name, e)
+                context.abort(a.code, a.detail)
+            finally:
+                self._admission.release(app_name)
 
 
 class _Abort(Exception):

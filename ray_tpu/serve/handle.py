@@ -233,12 +233,18 @@ class _RouterState:
 
     def _try_pick_locked(self, model_id: str):
         """One routing attempt (callers hold the lock): returns
-        (replica, hex) or None when every candidate is saturated."""
+        (replica, hex, affinity) or None when every candidate is
+        saturated. ``affinity`` is the multiplex routing outcome —
+        "hit" (an affinity replica had a slot), "spill" (every affinity
+        target saturated, pow-2 pick joins the set), "cold" (first
+        request for the model id), "" (no model id)."""
         n = len(self.replicas)
         if n == 0:
             return None
         hex2idx = {h: i for i, h in enumerate(self.hexes)}
+        affinity = ""
         if model_id:
+            affinity = "cold"
             reps = self.model_affinity.get(model_id)
             if reps:
                 best = None
@@ -253,9 +259,10 @@ class _RouterState:
                 if best is not None:
                     self.model_affinity.move_to_end(model_id)
                     reps.move_to_end(best[2])
-                    return self.replicas[best[1]], best[2]
+                    return self.replicas[best[1]], best[2], "hit"
                 # every affinity target saturated: SPILL to pow-2 below
                 # (the spill target joins the affinity set)
+                affinity = "spill"
         if n == 1:
             i = j = 0
         else:
@@ -274,7 +281,7 @@ class _RouterState:
         hex_ = self.hexes[pick]
         if model_id:
             self._record_affinity(model_id, hex_)
-        return self.replicas[pick], hex_
+        return self.replicas[pick], hex_, affinity
 
     # ---------------------------------------------------------------- pick
     def _emit_queued(self):
@@ -289,12 +296,17 @@ class _RouterState:
         except Exception:
             pass
 
-    def pick(self, model_id: str, queue_timeout: float):
+    def pick(self, model_id: str, queue_timeout: float,
+             ctx: Optional[dict] = None):
         """Pick a replica and charge the local in-flight count; returns
         (replica, done). Parks while every replica is saturated, up to
-        ``queue_timeout`` seconds."""
+        ``queue_timeout`` seconds. When a request-context dict rides
+        along, the capacity-gate park time accumulates into its
+        ``router_s`` stage and the routed replica / multiplex affinity
+        outcome are stamped for the GCS request record."""
         from ray_tpu.serve.admission import ReplicaOverloadedError
 
+        t_pick = time.perf_counter()
         empty_deadline = time.monotonic() + 30.0
         queue_deadline = time.monotonic() + max(0.0, queue_timeout)
         parked = False
@@ -306,8 +318,17 @@ class _RouterState:
                     n = len(self.replicas)
                     got = self._try_pick_locked(model_id) if n else None
                     if got is not None:
-                        replica, hex_ = got
+                        replica, hex_, affinity = got
                         self.inflight[hex_] = self.inflight.get(hex_, 0) + 1
+                        if ctx is not None:
+                            ctx["router_s"] = (
+                                ctx.get("router_s", 0.0)
+                                + (time.perf_counter() - t_pick))
+                            ctx["replica"] = hex_
+                            if affinity:
+                                ctx["affinity"] = affinity
+                        if affinity:
+                            self._emit_affinity(affinity)
                         return replica, self._make_done(hex_)
                     now = time.monotonic()
                     if n and not parked:
@@ -335,11 +356,30 @@ class _RouterState:
                 if now - last_emit > 0.25:
                     last_emit = now
                     self._emit_queued()
+        except BaseException:
+            # a failed pick (queue timeout / no replicas) still spent
+            # wall time in the gate: attribute it, or the proxy's
+            # waterfall would show the park as unattributed dispatch
+            if ctx is not None:
+                ctx["router_s"] = (ctx.get("router_s", 0.0)
+                                   + (time.perf_counter() - t_pick))
+            raise
         finally:
             if parked:
                 with self.lock:
                     self.waiting -= 1
                 self._emit_queued()
+
+    def _emit_affinity(self, result: str):
+        """Best-effort rayt_serve_affinity_total increment — the
+        multiplex hit/spill ratio ROADMAP item 1 gates on."""
+        try:
+            from ray_tpu.util import builtin_metrics as bm
+
+            bm.serve_affinity.inc(tags={"app": self.app_name,
+                                        "result": result})
+        except Exception:
+            pass
 
     def _make_done(self, hex_: str):
         def done():
@@ -493,6 +533,7 @@ class DeploymentHandle:
                  multiplexed_model_id: str = "",
                  retry_on_replica_death: bool = True,
                  queue_timeout_s: Optional[float] = None,
+                 request_context: Optional[dict] = None,
                  _router: Optional[_RouterState] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
@@ -501,6 +542,12 @@ class DeploymentHandle:
         self.multiplexed_model_id = multiplexed_model_id
         self.retry_on_replica_death = retry_on_replica_death
         self.queue_timeout_s = queue_timeout_s
+        # per-request observability context (serve/request_context.py):
+        # the ingress stamps request id / trace carrier here, the router
+        # adds park time + affinity, and _submit_once forwards the wire
+        # subset in the call envelope. Proxies build a per-request
+        # options() clone, so one context never outlives its request.
+        self.request_context = request_context
         self._router = _router or _RouterState(deployment_name, app_name)
 
     # picklable: runtime state rebuilds lazily in the new process
@@ -514,7 +561,8 @@ class DeploymentHandle:
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
                 retry_on_replica_death: Optional[bool] = None,
-                queue_timeout_s: Optional[float] = None
+                queue_timeout_s: Optional[float] = None,
+                request_context: Optional[dict] = None
                 ) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
@@ -526,6 +574,8 @@ class DeploymentHandle:
             else retry_on_replica_death,
             self.queue_timeout_s if queue_timeout_s is None
             else queue_timeout_s,
+            self.request_context if request_context is None
+            else request_context,
             _router=self._router)  # clones share the router state
 
     # ------------------------------------------------- internals/back-compat
@@ -568,12 +618,24 @@ class DeploymentHandle:
         """Pick a replica and charge the family's in-flight count;
         returns (replica, done) where done releases the charge."""
         return self._router.pick(self.multiplexed_model_id,
-                                 self._queue_timeout())
+                                 self._queue_timeout(),
+                                 ctx=self.request_context)
+
+    def _wire_context(self) -> Optional[dict]:
+        """The envelope subset of the request context that crosses the
+        process boundary: the request id keys the replica's partial GCS
+        record, the W3C carrier stitches its span into the proxy's
+        trace. Stamp times stay proxy-local (clocks don't line up)."""
+        rc = self.request_context
+        if not rc or not rc.get("request_id"):
+            return None
+        return {"request_id": rc["request_id"], "trace": rc.get("trace")}
 
     def _submit_once(self, args, kwargs):
         replica, done = self._route()
         ref = replica.handle_request.remote(
-            self.method_name, args, kwargs, self.multiplexed_model_id)
+            self.method_name, args, kwargs, self.multiplexed_model_id,
+            self._wire_context())
         return ref, done
 
     def remote(self, *args, **kwargs):
@@ -594,7 +656,8 @@ class DeploymentHandle:
             replica, done = self._route()
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                self.method_name, args, kwargs, self.multiplexed_model_id)
+                self.method_name, args, kwargs, self.multiplexed_model_id,
+                self._wire_context())
             return DeploymentResponseGenerator(ref_gen, done)
         ref, done = self._submit_once(args, kwargs)
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -45,6 +46,11 @@ class _Request:
     temperature: float
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     loop: Optional[asyncio.AbstractEventLoop] = None
+    # phase-stamp observation dict from the serving request context
+    # (serve/request_context.py): engine threads write plain floats/ints
+    # into it (GIL-atomic stores); the replica folds it into the request
+    # record after the handler returns. None when not instrumented.
+    obs: Optional[dict] = None
 
 
 @dataclass
@@ -264,8 +270,19 @@ class LLMEngine:
                 f"prefill bucket is {limit} (raise prompt_buckets / "
                 f"max_seq_len)")
         await self.ensure_started()
+        try:
+            from ray_tpu.serve.request_context import current_request_obs
+
+            obs = current_request_obs()
+        except Exception:
+            obs = None
         req = _Request(list(tokens), int(max_new_tokens), float(temperature),
-                       loop=asyncio.get_running_loop())
+                       loop=asyncio.get_running_loop(), obs=obs)
+        if obs is not None:
+            # queue_s / ttft measure from here: the engine saw the
+            # request, whatever happens next (queue park, chunked
+            # prefill, decode) is engine-attributable time
+            obs["gen_start"] = time.perf_counter()
         await self._queue.put(req)
         while True:
             item = await req.out.get()
@@ -345,6 +362,9 @@ class LLMEngine:
 
     def _admit_locked(self, req: _Request):
         cfg = self.cfg
+        obs = req.obs
+        if obs is not None and "gen_start" in obs:
+            obs["queue_s"] = time.perf_counter() - obs["gen_start"]
         try:
             self._ensure_decode_cache()
         except Exception:
@@ -375,9 +395,14 @@ class LLMEngine:
                 bucket=bucket, pos=skip))
             return
         temps1 = jnp.asarray([[req.temperature]], np.float32)
+        t_pf = time.perf_counter()
         nxt, small, self._key = self._step(
             self.params, small, jnp.asarray(prompts), self._key, temps1)
         self.prefills += 1
+        if obs is not None:
+            obs["prefill_s"] = obs.get("prefill_s", 0.0) + (
+                time.perf_counter() - t_pf)
+            obs["prefill_chunks"] = obs.get("prefill_chunks", 0) + 1
         self._finish_prefill(req, slot, small, int(np.asarray(nxt)[0]),
                              bucket, bucket - len(toks))
 
@@ -390,10 +415,16 @@ class LLMEngine:
                 chunk = min(self.prefill_chunk, pf.bucket - pf.pos)
                 tokens = jnp.asarray(pf.prompts[:, pf.pos:pf.pos + chunk])
                 temps1 = jnp.asarray([[pf.req.temperature]], np.float32)
+                t_pf = time.perf_counter()
                 nxt, pf.small, self._key = self._step(
                     self.params, pf.small, tokens, self._key, temps1)
                 pf.pos += chunk
                 self.prefill_chunks += 1
+                obs = pf.req.obs
+                if obs is not None:
+                    obs["prefill_s"] = obs.get("prefill_s", 0.0) + (
+                        time.perf_counter() - t_pf)
+                    obs["prefill_chunks"] = obs.get("prefill_chunks", 0) + 1
                 if pf.pos < pf.bucket:
                     return
                 self._pending_prefills.pop(0)
@@ -427,6 +458,11 @@ class LLMEngine:
             req.loop.call_soon_threadsafe(req.out.put_nowait, None)
             return
         self.generated_tokens += 1
+        if req.obs is not None:
+            now = time.perf_counter()
+            req.obs["first_token"] = now
+            req.obs["last_token"] = now
+            req.obs["tokens"] = req.obs.get("tokens", 0) + 1
         req.loop.call_soon_threadsafe(req.out.put_nowait, first)
         if req.max_new_tokens <= 1:
             req.loop.call_soon_threadsafe(req.out.put_nowait, None)
@@ -490,6 +526,12 @@ class LLMEngine:
         toks = np.asarray(nxt)  # host sync: this step's sampled tokens
         self._cur = nxt  # stays on device for the next step
         self.batches += 1
+        # occupancy of THIS step, stamped into each participant's obs:
+        # mean over a request's steps = how full its decode batches ran
+        active = sum(1 for s in self._slots
+                     if s is not None and s.emitted >= 0)
+        occupancy = active / self.max_batch
+        now = time.perf_counter()
         for i, s in enumerate(self._slots):
             if s is None or s.emitted < 0:  # free or mid-prefill
                 continue
@@ -500,6 +542,12 @@ class LLMEngine:
                 continue
             s.emitted += 1
             self.generated_tokens += 1
+            if s.req.obs is not None:
+                o = s.req.obs
+                o["tokens"] = o.get("tokens", 0) + 1
+                o["decode_steps"] = o.get("decode_steps", 0) + 1
+                o["occupancy_sum"] = o.get("occupancy_sum", 0.0) + occupancy
+                o["last_token"] = now
             s.req.loop.call_soon_threadsafe(s.req.out.put_nowait, t)
             if (s.emitted >= s.req.max_new_tokens
                     or s.length >= self.cfg.max_seq_len - 1):

@@ -170,6 +170,7 @@ class ProxyActor:
     async def _dispatch(self, request):
         from aiohttp import web
 
+        t0 = time.perf_counter()
         app_name = request.match_info["app_name"]
         ingress = self._ingress.get(app_name)
         if ingress is None:
@@ -181,6 +182,15 @@ class ProxyActor:
 
             handle = DeploymentHandle(ingress, app_name)
             self._handles[app_name] = handle
+        # request id: minted once per (resolved) request, echoed on
+        # every response — 503s included — and rides the handle context
+        # into the replica so both sides' partial GCS records coalesce
+        from ray_tpu._internal.otel import (current_context_carrier,
+                                            submit_span)
+        from ray_tpu.serve.request_context import mint_request_id
+
+        rid = mint_request_id()
+        ctx = {"request_id": rid, "start_ts": time.time()}
         if request.can_read_body:
             try:
                 payload = await request.json()
@@ -195,35 +205,56 @@ class ProxyActor:
                         or "text/event-stream" in
                         request.headers.get("Accept", ""))
         loop = asyncio.get_running_loop()
-        # ---- admission: window sized from the (cached) routing-table
-        # capacity; accept/shed is sync + fast on the event loop
-        replicas, max_ongoing = await self._app_capacity(app_name, handle,
-                                                         loop)
-        if not self._admission.try_acquire(app_name, replicas, max_ongoing):
-            return self._unavailable(
-                app_name, "shed",
-                f"admission window full for app {app_name!r} (window="
-                f"{self._admission.window_for(replicas, max_ongoing)})")
-        count_admitted(app_name, "http")
-        # model multiplexing (ref: serve proxy forwards the model-id
-        # header); the router's capacity-gate park is bounded by the
-        # request timeout — a request that can't find a replica slot in
-        # time is SHED (503 queue_full), never left queueing to timeout
-        from ray_tpu.serve.admission import queue_timeout_s
+        with submit_span("serve.proxy.request", app=app_name,
+                         request_id=rid, proto="http",
+                         path=request.path):
+            try:
+                # W3C carrier captured INSIDE the proxy span: the
+                # replica's execute_span parents off it, stitching one
+                # trace across the two processes
+                ctx["trace"] = current_context_carrier()
+            except Exception:
+                pass
+            # ---- admission: window sized from the (cached) routing-
+            # table capacity; accept/shed is sync + fast on the event
+            # loop
+            replicas, max_ongoing = await self._app_capacity(
+                app_name, handle, loop)
+            if not self._admission.try_acquire(app_name, replicas,
+                                               max_ongoing):
+                resp = self._unavailable(
+                    app_name, "shed",
+                    f"admission window full for app {app_name!r} (window="
+                    f"{self._admission.window_for(replicas, max_ongoing)})")
+                resp.headers["X-Rayt-Request-Id"] = rid
+                self._finish_record(ctx, app_name, "shed", t0=t0)
+                return resp
+            t1 = time.perf_counter()
+            count_admitted(app_name, "http")
+            # model multiplexing (ref: serve proxy forwards the model-id
+            # header); the router's capacity-gate park is bounded by the
+            # request timeout — a request that can't find a replica slot
+            # in time is SHED (503 queue_full), never left queueing to
+            # timeout
+            from ray_tpu.serve.admission import queue_timeout_s
 
-        model_id = request.headers.get("serve_multiplexed_model_id", "")
-        handle = handle.options(
-            multiplexed_model_id=model_id or None,
-            queue_timeout_s=min(queue_timeout_s(),
-                                self._request_timeout()))
-        try:
-            if wants_stream:
-                return await self._dispatch_stream(request, handle,
-                                                   app_name, payload)
-            return await self._dispatch_unary(handle, app_name, payload,
-                                              loop)
-        finally:
-            self._admission.release(app_name)
+            model_id = request.headers.get("serve_multiplexed_model_id",
+                                           "")
+            handle = handle.options(
+                multiplexed_model_id=model_id or None,
+                queue_timeout_s=min(queue_timeout_s(),
+                                    self._request_timeout()),
+                request_context=ctx)
+            try:
+                if wants_stream:
+                    return await self._dispatch_stream(
+                        request, handle, app_name, payload, ctx, t0, t1,
+                        model_id)
+                return await self._dispatch_unary(
+                    handle, app_name, payload, loop, ctx, t0, t1,
+                    model_id)
+            finally:
+                self._admission.release(app_name)
 
     def _error_response(self, app_name: str, e: Exception):
         """Map a routing/replica failure onto the 503/500 split."""
@@ -242,7 +273,74 @@ class ProxyActor:
         # a replica-raised user exception: a real 500
         return web.json_response({"error": repr(e)}, status=500)
 
-    async def _dispatch_unary(self, handle, app_name, payload, loop):
+    @staticmethod
+    def _outcome_for(e: Exception) -> str:
+        """Record outcome for a failed dispatch — mirrors the
+        _error_response status mapping."""
+        from ray_tpu.core.common import GetTimeoutError
+
+        if isinstance(e, GetTimeoutError):
+            return "timeout"
+        if is_overload_error(e):
+            return "queue_full"
+        if isinstance(e, RuntimeError) and "no replicas" in str(e):
+            return "no_replicas"
+        return "error"
+
+    @staticmethod
+    def _finish_record(ctx: dict, app_name: str, outcome: str, *,
+                       t0: float, t1: float | None = None,
+                       t_first: float | None = None,
+                       t_end: float | None = None, proto: str = "http",
+                       model_id: str = "", ttft_s: float | None = None,
+                       tpot_s: float | None = None, chunks: int = 0):
+        """Assemble and publish this request's FINAL record (one publish
+        per request, batched off the hot path). The proxy stages TILE
+        the end-to-end wall time by construction: admission (t1-t0) +
+        router (accumulated by pick()) + dispatch (remainder up to first
+        output or completion) + stream (first output -> end)."""
+        try:
+            from ray_tpu.serve.request_context import publish_record
+
+            if t_end is None:
+                t_end = time.perf_counter()
+            e2e = t_end - t0
+            router_s = float(ctx.get("router_s") or 0.0)
+            if t1 is None:
+                # shed at the admission gate: the whole request was
+                # admission time, by definition
+                stages = {"admission_s": e2e}
+            else:
+                boundary = t_first if t_first is not None else t_end
+                stages = {"admission_s": t1 - t0,
+                          "router_s": router_s,
+                          "dispatch_s": max(0.0,
+                                            (boundary - t1) - router_s)}
+                if t_first is not None:
+                    stages["stream_s"] = t_end - t_first
+            rec = {"kind": "request", "side": "proxy", "final": True,
+                   "request_id": ctx["request_id"], "app": app_name,
+                   "proto": proto, "outcome": outcome, "e2e_s": e2e,
+                   "stages": stages, "pid_proxy": os.getpid(),
+                   "start_ts": ctx.get("start_ts"), "ts": time.time()}
+            if model_id:
+                rec["model_id"] = model_id
+            if ctx.get("replica"):
+                rec["replica"] = ctx["replica"]
+            if ctx.get("affinity"):
+                rec["affinity"] = ctx["affinity"]
+            if ttft_s is not None:
+                rec["ttft_s"] = ttft_s
+            if tpot_s is not None:
+                rec["tpot_s"] = tpot_s
+            if chunks:
+                rec["chunks"] = chunks
+            publish_record(rec)
+        except Exception:
+            pass  # observability must never fail the request
+
+    async def _dispatch_unary(self, handle, app_name, payload, loop,
+                              ctx, t0, t1, model_id):
         from aiohttp import web
 
         timeout = self._request_timeout()
@@ -251,13 +349,38 @@ class ProxyActor:
                 self._executor,
                 lambda: handle.remote(payload).result(timeout=timeout))
         except Exception as e:
-            return self._error_response(app_name, e)
+            resp = self._error_response(app_name, e)
+            resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+            self._finish_record(ctx, app_name, self._outcome_for(e),
+                                t0=t0, t1=t1, model_id=model_id)
+            return resp
+        self._finish_record(ctx, app_name, "ok", t0=t0, t1=t1,
+                            model_id=model_id)
         if isinstance(response, (dict, list, str, int, float, bool,
                                  type(None))):
-            return web.json_response({"result": response})
-        return web.Response(body=str(response).encode())
+            resp = web.json_response({"result": response})
+        else:
+            resp = web.Response(body=str(response).encode())
+        resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+        return resp
 
-    async def _dispatch_stream(self, request, handle, app_name, payload):
+    def _observe_stream_latency(self, app_name: str, seconds: float):
+        """Streaming requests record into the serve latency histogram
+        too (they previously bypassed it entirely — the only serve
+        latency series came from replica-side handler timing); the
+        `_proxy_stream` pseudo-deployment keeps this client-visible
+        series distinct from the replica's."""
+        try:
+            from ray_tpu.util import builtin_metrics as bm
+
+            bm.serve_request_latency.observe(
+                seconds, tags={"app": app_name,
+                               "deployment": "_proxy_stream"})
+        except Exception:
+            pass
+
+    async def _dispatch_stream(self, request, handle, app_name, payload,
+                               ctx, t0, t1, model_id):
         from aiohttp import web
 
         loop = asyncio.get_running_loop()
@@ -271,20 +394,37 @@ class ProxyActor:
                 self._executor,
                 lambda: handle.options(stream=True).remote(payload))
         except Exception as e:
-            return self._error_response(app_name, e)
+            resp = self._error_response(app_name, e)
+            resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+            self._finish_record(ctx, app_name, self._outcome_for(e),
+                                t0=t0, t1=t1, model_id=model_id)
+            return resp
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"})
+                     "Cache-Control": "no-cache",
+                     "X-Rayt-Request-Id": ctx["request_id"]})
         await resp.prepare(request)
+        # TTFT stamps at the FIRST SSE chunk, the total at stream END —
+        # a streaming request's latency is its last byte, not the
+        # instant the 200 went on the wire. A mid-stream failure or a
+        # client hang-up finalizes as `stream_aborted`, never silence.
+        t_first = None
+        chunks = 0
+        outcome = "ok"
         try:
             async for item in gen:
+                if t_first is None:
+                    t_first = time.perf_counter()
+                chunks += 1
                 await resp.write(
                     f"data: {json.dumps(item, default=str)}\n\n".encode())
         except (ConnectionResetError, ConnectionError):
-            pass  # client went away; gen.close() stops the replica
+            outcome = "stream_aborted"  # client went away;
+            # gen.close() stops the replica
         except Exception as e:
             # mid-stream failure: the 200 is already on the wire — an
             # error frame is the only channel left
+            outcome = "stream_aborted"
             try:
                 await resp.write(
                     f"event: error\ndata: "
@@ -293,6 +433,15 @@ class ProxyActor:
                 pass
         finally:
             gen.close()
+        t_end = time.perf_counter()
+        ttft = (t_first - t0) if t_first is not None else None
+        tpot = ((t_end - t_first) / (chunks - 1)
+                if t_first is not None and chunks > 1 else None)
+        self._finish_record(ctx, app_name, outcome, t0=t0, t1=t1,
+                            t_first=t_first, t_end=t_end,
+                            model_id=model_id, ttft_s=ttft, tpot_s=tpot,
+                            chunks=chunks)
+        self._observe_stream_latency(app_name, t_end - t0)
         try:
             await resp.write_eof()
         except Exception:

@@ -10,11 +10,18 @@ and controller autoscaling.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import contextvars
 import inspect
+import os
 import time
 from typing import Any, Optional
 
 import cloudpickle
+
+# cumulative engine reports piggyback on the request-recording path at
+# most this often (differenced into rates GCS-side)
+_ENGINE_REPORT_INTERVAL_S = 2.0
 
 
 class _HandleMarker:
@@ -43,6 +50,7 @@ class ReplicaActor:
         else:
             self._callable = target
         self._user_config = user_config
+        self._last_engine_report = 0.0
         if user_config is not None:
             reconfigure = getattr(self._callable, "reconfigure", None)
             if reconfigure is not None:
@@ -83,9 +91,146 @@ class ReplicaActor:
                 time.perf_counter() - t0, tags=tags)
         except Exception:
             pass
+        self._maybe_engine_report()
+
+    # --------------------------------------- request-path observability
+    def _begin_request(self, ctx: Optional[dict]):
+        """Per-request observability setup: the engine phase-stamp
+        contextvar (llm.py's generate() picks it up) and the replica
+        span, remote-parented off the proxy's W3C carrier so one trace
+        spans both pids. Returns (obs, reset_token, span_cm)."""
+        if not ctx or not ctx.get("request_id"):
+            return None, None, contextlib.nullcontext()
+        try:
+            from ray_tpu._internal.otel import execute_span
+            from ray_tpu.serve.request_context import _set_request_obs
+
+            obs: dict = {}
+            token = _set_request_obs(obs)
+            span = execute_span(
+                "serve.replica", ctx.get("trace"),
+                app=self.app_name, deployment=self.deployment_name,
+                request_id=ctx["request_id"])
+            return obs, token, span
+        except Exception:
+            return None, None, contextlib.nullcontext()
+
+    def _end_request(self, ctx: Optional[dict], obs, token, model_id: str,
+                     t0: float, t_start: Optional[float], t_end: float):
+        """Publish this side's PARTIAL record (batched; the GCS serve
+        manager coalesces it with the proxy's final by request id)."""
+        if token is not None:
+            try:
+                from ray_tpu.serve.request_context import _reset_request_obs
+
+                _reset_request_obs(token)
+            except Exception:
+                pass
+        if not ctx or not ctx.get("request_id"):
+            return
+        try:
+            from ray_tpu.serve.request_context import (engine_section,
+                                                       publish_record)
+
+            rec = {
+                "kind": "request", "side": "replica",
+                "request_id": ctx["request_id"],
+                "app": self.app_name,
+                "deployment": self.deployment_name,
+                "pid_replica": os.getpid(),
+                "ts": time.time(),
+                # queue_s = executor-dispatch wait before user code ran;
+                # service_s = user-code wall time. Nested under the
+                # record, not part of the proxy's tiling (cross-process
+                # clocks don't line up).
+                "replica_stages": {
+                    "queue_s": (t_start - t0)
+                    if t_start is not None else None,
+                    "service_s": (t_end - t_start)
+                    if t_start is not None else (t_end - t0),
+                },
+            }
+            if model_id:
+                rec["model_id"] = model_id
+            eng = engine_section(obs)
+            if eng is not None:
+                rec["engine"] = eng
+            publish_record(rec)
+        except Exception:
+            pass
+
+    def _engines(self) -> list:
+        """Duck-typed discovery of engine objects hosted by the user
+        callable: a plain ``engine`` attribute and/or the values of any
+        multiplex LRU (``_rayt_mux_cache_*``). The contract is just the
+        three cumulative counters — no llm/jax import here."""
+        found = []
+        inst = self._callable
+        eng = getattr(inst, "engine", None)
+        if eng is not None:
+            found.append(eng)
+        try:
+            for attr, val in vars(inst).items():
+                if attr.startswith("_rayt_mux_cache_") and \
+                        hasattr(val, "values"):
+                    found.extend(val.values())
+        except Exception:
+            pass
+        return [e for e in found
+                if all(isinstance(getattr(e, k, None), int)
+                       for k in ("batches", "prefills", "prefill_chunks"))]
+
+    def _engine_stats(self) -> Optional[dict]:
+        """Summed engine counters across every resident engine (one for
+        LlamaService, one per resident adapter for the multiplexed
+        service), plus instantaneous decode-slot occupancy."""
+        engines = self._engines()
+        if not engines:
+            return None
+        out = {"batches": 0, "prefills": 0, "prefill_chunks": 0,
+               "active_slots": 0, "max_batch": 0}
+        for e in engines:
+            out["batches"] += int(e.batches)
+            out["prefills"] += int(e.prefills)
+            out["prefill_chunks"] += int(e.prefill_chunks)
+            try:
+                out["active_slots"] += sum(
+                    1 for s in e._slots if s is not None)
+                out["max_batch"] += int(e.max_batch)
+            except Exception:
+                pass
+        return out
+
+    def _maybe_engine_report(self):
+        """Throttled cumulative engine-counter report on the serve
+        channel; the GCS differences consecutive reports into the
+        rayt_serve_engine_*_total counters and the occupancy gauge."""
+        now = time.monotonic()
+        if now - self._last_engine_report < _ENGINE_REPORT_INTERVAL_S:
+            return
+        self._last_engine_report = now
+        try:
+            st = self._engine_stats()
+            if st is None:
+                return
+            from ray_tpu.serve.request_context import publish_record
+
+            rec = {"kind": "engine", "app": self.app_name,
+                   "deployment": self.deployment_name,
+                   "replica": f"pid-{os.getpid()}",
+                   "prefills": st["prefills"],
+                   "prefill_chunks": st["prefill_chunks"],
+                   "decode_steps": st["batches"],
+                   "ts": time.time()}
+            if st["max_batch"]:
+                rec["occupancy"] = st["active_slots"] / st["max_batch"]
+            publish_record(rec)
+        except Exception:
+            pass
 
     async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict, model_id: str = "") -> Any:
+                             kwargs: dict, model_id: str = "",
+                             ctx: Optional[dict] = None) -> Any:
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
         self._check_capacity()
@@ -93,26 +238,41 @@ class ReplicaActor:
         self._total += 1
         t0 = time.perf_counter()
         token = _set_model_id(model_id)
+        obs, obs_token, span = self._begin_request(ctx)
+        t_start = None
         try:
-            if method_name == "__call__":
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method_name)
-            coro_fn = fn if inspect.iscoroutinefunction(fn) else getattr(
-                fn, "__call__", None)
-            if inspect.iscoroutinefunction(coro_fn):
-                return await coro_fn(*args, **kwargs)
-            loop = asyncio.get_running_loop()
-            ctx = __import__("contextvars").copy_context()
-            return await loop.run_in_executor(
-                None, lambda: ctx.run(fn, *args, **kwargs))
+            with span:
+                if method_name == "__call__":
+                    fn = self._callable
+                else:
+                    fn = getattr(self._callable, method_name)
+                coro_fn = fn if inspect.iscoroutinefunction(fn) else getattr(
+                    fn, "__call__", None)
+                if inspect.iscoroutinefunction(coro_fn):
+                    t_start = time.perf_counter()
+                    return await coro_fn(*args, **kwargs)
+                loop = asyncio.get_running_loop()
+                cvctx = contextvars.copy_context()
+                marks: dict = {}
+
+                def _run():
+                    marks["t_start"] = time.perf_counter()
+                    return cvctx.run(fn, *args, **kwargs)
+
+                try:
+                    return await loop.run_in_executor(None, _run)
+                finally:
+                    t_start = marks.get("t_start")
         finally:
             _reset_model_id(token)
             self._ongoing -= 1
             self._record_request(t0)
+            self._end_request(ctx, obs, obs_token, model_id,
+                              t0, t_start, time.perf_counter())
 
     async def handle_request_streaming(self, method_name: str, args: tuple,
-                                       kwargs: dict, model_id: str = ""):
+                                       kwargs: dict, model_id: str = "",
+                                       ctx: Optional[dict] = None):
         """Async-generator entrypoint: the user callable may be a sync
         generator, an async generator, or return either; every produced
         item streams to the caller via the core streaming-return path
@@ -124,40 +284,50 @@ class ReplicaActor:
         self._total += 1
         t0 = time.perf_counter()
         token = _set_model_id(model_id)
+        obs, obs_token, span = self._begin_request(ctx)
+        t_start = None
         try:
-            if method_name == "__call__":
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method_name)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = await result
-            if inspect.isasyncgen(result):
-                async for item in result:
-                    yield item
-            elif inspect.isgenerator(result):
-                loop = asyncio.get_running_loop()
-                sentinel = object()
-                while True:
-                    item = await loop.run_in_executor(
-                        None, next, result, sentinel)
-                    if item is sentinel:
-                        break
-                    yield item
-            else:
-                yield result
+            with span:
+                if method_name == "__call__":
+                    fn = self._callable
+                else:
+                    fn = getattr(self._callable, method_name)
+                t_start = time.perf_counter()
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                if inspect.isasyncgen(result):
+                    async for item in result:
+                        yield item
+                elif inspect.isgenerator(result):
+                    loop = asyncio.get_running_loop()
+                    sentinel = object()
+                    while True:
+                        item = await loop.run_in_executor(
+                            None, next, result, sentinel)
+                        if item is sentinel:
+                            break
+                        yield item
+                else:
+                    yield result
         finally:
             _reset_model_id(token)
             self._ongoing -= 1
             self._record_request(t0)
+            self._end_request(ctx, obs, obs_token, model_id,
+                              t0, t_start, time.perf_counter())
 
     def get_stats(self) -> dict:
         from ray_tpu.serve.multiplex import resident_model_ids
 
-        return {"ongoing": self._ongoing, "total": self._total,
-                "max_ongoing": self._max_ongoing,
-                "overloaded_rejects": self._overloaded_rejects,
-                "models": resident_model_ids(self._callable)}
+        out = {"ongoing": self._ongoing, "total": self._total,
+               "max_ongoing": self._max_ongoing,
+               "overloaded_rejects": self._overloaded_rejects,
+               "models": resident_model_ids(self._callable)}
+        eng = self._engine_stats()
+        if eng is not None:
+            out["engine"] = eng
+        return out
 
     def reconfigure(self, user_config: Any):
         fn = getattr(self._callable, "reconfigure", None)

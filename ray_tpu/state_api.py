@@ -283,6 +283,53 @@ def summarize_dags(*, job_id: Optional[str] = None) -> dict:
     return cw.io.run(cw.gcs.call("summarize_dags", filters))
 
 
+def list_serve_requests(*, app: Optional[str] = None,
+                        outcome: Optional[str] = None,
+                        model_id: Optional[str] = None,
+                        errors_only: bool = False,
+                        min_e2e_s: Optional[float] = None,
+                        slow: bool = False, limit: int = 100,
+                        detail: bool = False) -> Any:
+    """Per-request serve latency waterfalls from the GCS serve manager,
+    filtered SERVER-side. Each record is the coalesced proxy+replica
+    view of one request: the proxy's stage tiling (admission/router/
+    dispatch/stream summing to e2e), the replica's queue/service split,
+    and — for LLM apps — the engine phase breakdown (prefill incl.
+    chunk count, TTFT, TPOT, decode-batch occupancy). Retention is
+    tail-biased: errors/sheds/aborts and the slowest decile are always
+    kept, the happy path samples at RAYT_SERVE_REQUEST_SAMPLE.
+    ``slow=True`` orders by e2e descending. Records flow on the metrics
+    cadence, so the freshest requests can lag by a beat."""
+    cw = _cw()
+    filters: dict = {"limit": limit, "errors_only": errors_only,
+                     "slow": slow}
+    if app is not None:
+        filters["app"] = app
+    if outcome is not None:
+        filters["outcome"] = outcome
+    if model_id is not None:
+        filters["model_id"] = model_id
+    if min_e2e_s is not None:
+        filters["min_e2e_s"] = min_e2e_s
+    out = cw.io.run(cw.gcs.call("list_serve_requests", filters))
+    return out if detail else out["requests"]
+
+
+def summarize_serve_requests(*, app: Optional[str] = None) -> dict:
+    """Serve request-path rollup: per-app request/outcome counts and
+    p50/p99/mean per waterfall stage plus e2e/TTFT/TPOT — the data
+    behind `rayt serve status` and the dashboard Serve tab."""
+    cw = _cw()
+    filters = {"app": app} if app is not None else {}
+    return cw.io.run(cw.gcs.call("summarize_serve_requests", filters))
+
+
+def get_serve_request(request_id: str) -> Optional[dict]:
+    """One retained request record by id (hex prefix accepted)."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.call("get_serve_request", request_id))
+
+
 def list_cluster_events(*, job_id: Optional[str] = None,
                         node_id: Optional[str] = None,
                         severity: Optional[str] = None,

@@ -75,6 +75,13 @@ serve_handle_queued = Gauge(
     "replica at max_ongoing_requests); per-handle series — the "
     "controller sums them (merge) as the autoscaler's queue-depth "
     "signal", tag_keys=("app", "deployment", "handle"))
+serve_affinity = Counter(
+    "rayt_serve_affinity_total",
+    "Multiplexed-model routing outcomes at the handle: hit (an "
+    "affinity replica had the adapter resident and a free slot), spill "
+    "(every affinity target saturated — the pow-2 pick joins the "
+    "affinity set), cold (first request for the model id)",
+    tag_keys=("app", "result"))
 serve_mux_loads = Counter(
     "rayt_serve_mux_loads_total",
     "Multiplex LRU model loads (a cold adapter entering a replica's "
@@ -264,6 +271,64 @@ def sched_metric_records(node_hex: str, *, spillbacks: int = 0,
         rec("rayt_sched_queue_wait_s_total", "counter", queue_wait_s)
     if pending is not None:
         rec("rayt_sched_pending_leases", "gauge", pending)
+    return recs
+
+
+def serve_request_metric_records(app: str, *, queue_wait_s=None,
+                                 ttft_s=None, tpot_s=None,
+                                 prefill_s=None, ts: float = 0.0) -> list:
+    """Per-request serve-path histograms, derived by the GCS serve
+    manager from finalized request records (the GCS process has no core
+    worker, so — like the dag/event managers — it builds raw records
+    and feeds its own metrics store). Each record is one raw
+    observation (the store's legacy histogram path buckets it into
+    LATENCY_BOUNDS); derivation happens before tail-biased sampling, so
+    the series are unskewed by the retention rate."""
+    tags = {"app": app}
+    bounds = list(LATENCY_BOUNDS)
+    recs = []
+
+    def hist(name, value):
+        if value is not None:
+            recs.append({"name": name, "kind": "histogram",
+                         "value": float(value), "tags": tags, "ts": ts,
+                         "bounds": bounds})
+
+    hist("rayt_serve_queue_wait_s", queue_wait_s)
+    hist("rayt_serve_ttft_s", ttft_s)
+    hist("rayt_serve_tpot_s", tpot_s)
+    hist("rayt_serve_prefill_s", prefill_s)
+    return recs
+
+
+def serve_engine_metric_records(app: str, deployment: str, replica: str,
+                                *, prefills: int = 0,
+                                prefill_chunks: int = 0,
+                                decode_steps: int = 0, occupancy=None,
+                                ts: float = 0.0) -> list:
+    """Engine health metrics, derived by the GCS serve manager from the
+    DELTAS between consecutive cumulative replica engine reports
+    (counter records carry deltas; the store sums them). One counter
+    series per (app, deployment); the occupancy gauge adds the replica
+    tag so a lopsided decode batch is attributable."""
+    tags = {"app": app, "deployment": deployment}
+    recs = []
+
+    def rec(name, kind, value, tg):
+        recs.append({"name": name, "kind": kind, "value": float(value),
+                     "tags": tg, "ts": ts})
+
+    if prefills:
+        rec("rayt_serve_engine_prefills_total", "counter", prefills, tags)
+    if prefill_chunks:
+        rec("rayt_serve_engine_prefill_chunks_total", "counter",
+            prefill_chunks, tags)
+    if decode_steps:
+        rec("rayt_serve_engine_decode_steps_total", "counter",
+            decode_steps, tags)
+    if occupancy is not None:
+        rec("rayt_serve_decode_batch_occupancy", "gauge", occupancy,
+            {**tags, "replica": replica})
     return recs
 
 

@@ -458,7 +458,8 @@ def test_affinity_survives_refresh_clears_on_removal():
     st = _RouterState("dep", "app")
     st.apply_route_info(_route_info(st.key, 1, [r1, r2]))
     with st.lock:
-        _, hx = st._try_pick_locked("m1")
+        _, hx, aff = st._try_pick_locked("m1")
+    assert aff == "cold"  # first request for the model id
     assert list(st.model_affinity["m1"]) == [hx]
     # version-unchanged refresh (update None): affinity survives
     st.apply_route_info({"update": None, "load": {}, "max_ongoing": 4})
@@ -472,7 +473,7 @@ def test_affinity_survives_refresh_clears_on_removal():
     assert "m1" not in st.model_affinity
     # other models keyed to the surviving replica would have stayed
     with st.lock:
-        _, hx2 = st._try_pick_locked("m2")
+        _, hx2, _ = st._try_pick_locked("m2")
     assert hx2 == keep._actor_id.hex()
     st.apply_route_info(_route_info(st.key, 4, [keep]))
     assert "m2" in st.model_affinity
@@ -506,16 +507,17 @@ def test_affinity_spills_on_saturation_and_grows_set():
     st = _RouterState("dep", "app")
     st.apply_route_info(_route_info(st.key, 1, [r1, r2], max_ongoing=2))
     with st.lock:
-        _, hx = st._try_pick_locked("m1")
+        _, hx, aff = st._try_pick_locked("m1")
+        assert aff == "cold"
         # sticky while unsaturated, even under some load
         st.inflight[hx] = 1
-        _, hx_b = st._try_pick_locked("m1")
-        assert hx_b == hx
+        _, hx_b, aff_b = st._try_pick_locked("m1")
+        assert hx_b == hx and aff_b == "hit"
         # saturate the affinity target: the pick spills to the OTHER
         # replica and records it in the affinity set
         st.inflight[hx] = 2
-        _, hx2 = st._try_pick_locked("m1")
-        assert hx2 != hx
+        _, hx2, aff2 = st._try_pick_locked("m1")
+        assert hx2 != hx and aff2 == "spill"
         assert list(st.model_affinity["m1"]) == [hx, hx2]
         # both saturated -> no pick (the gate parks the request)
         st.inflight[hx2] = 2
