@@ -228,6 +228,28 @@ class Config:
     # every finalized record BEFORE the sampling drop, so Prometheus
     # series stay unskewed at any rate).
     serve_request_sample: float = 1.0
+    # ---- train-plane observability (core/gcs_train_manager) ----
+    # Gates per-step waterfall recording end-to-end: the controller
+    # mints a run id, each worker's StepRecorder stamps the phase
+    # timings (data_wait/h2d/step/ckpt_block tiling step wall), compile
+    # events, and device-memory snapshots, publishing on the
+    # `train_state` channel. Disabling removes the per-step capture
+    # cost and all report traffic.
+    train_state_enabled: bool = True
+    # GCS train-manager memory bound: max retained step records; beyond
+    # it the run holding the most records evicts oldest-first with
+    # per-run dropped accounting (same contract as the
+    # task/object/DAG/serve stores).
+    train_state_max: int = 5000
+    # Stall watchdog grace: a worker blocked inside ONE step phase
+    # longer than this is flagged stalled with an attribution
+    # (ingest-starved / checkpoint-blocked / collective-barrier) and a
+    # WARNING cluster event on the transition.
+    train_stall_grace_s: float = 5.0
+    # StepRecorder flush cadence: step/compile records batch in-process
+    # and ship once per interval; the blocked-phase heartbeat and the
+    # device-memory snapshot (rate-limited to 1s) ride the same cycle.
+    train_flush_interval_s: float = 1.0
     # ---- scheduling-plane observability (cluster events + traces) ----
     # Gates the cluster event log AND the lease decision tracer: node
     # managers record per-demand-shape request_lease verdicts and emit

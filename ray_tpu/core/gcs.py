@@ -40,6 +40,8 @@ from ray_tpu.core.gcs_object_manager import (CH_OBJECTS,  # noqa: E402
                                              GcsObjectManager)
 from ray_tpu.core.gcs_serve_manager import (CH_SERVE,  # noqa: E402
                                             GcsServeManager)
+from ray_tpu.core.gcs_train_manager import (CH_TRAIN,  # noqa: E402
+                                            GcsTrainManager)
 
 CH_NODE = "node_events"          # {"event": "added"|"removed", "node": NodeInfo}
 CH_ACTOR = "actor_events"        # ActorInfo
@@ -146,6 +148,15 @@ class GcsServer:
         self.serve_manager = GcsServeManager(
             max_requests=cfg0.serve_requests_max,
             sample=cfg0.serve_request_sample)
+        # train-plane state store fed by the `train_state` channel:
+        # per-run step waterfalls, compile events, device-memory
+        # snapshots, and the stall watchdog whose attributed flag
+        # transitions land in the cluster event log
+        # (core/gcs_train_manager.py)
+        self.train_manager = GcsTrainManager(
+            max_steps=cfg0.train_state_max,
+            stall_grace_s=cfg0.train_stall_grace_s,
+            event_cb=self._train_stall_event)
         # metrics time-series store fed by the `metrics` pubsub channel
         # (ref analog: metrics_agent aggregation; serves /api/metrics/*)
         from ray_tpu.core.metrics_store import MetricsStore
@@ -413,6 +424,11 @@ class GcsServer:
         self.record_event(source="dag", kind=kind, message=message,
                           severity=severity, job_id=job_id, **data)
 
+    def _train_stall_event(self, kind: str, message: str, severity: str,
+                           job_id: str, data: dict):
+        self.record_event(source="train", kind=kind, message=message,
+                          severity=severity, job_id=job_id, **data)
+
     async def _heartbeat_gap_loop(self):
         """Per-node heartbeat-gap gauges (rayt_node_heartbeat_gap_s):
         the staleness signal `rayt status` + the Cluster tab sparklines
@@ -462,6 +478,13 @@ class GcsServer:
             # finalized records + engine-report deltas derive the
             # rayt_serve_{ttft,tpot,queue_wait,prefill,engine_*} family
             recs = self.serve_manager.drain_metric_records()
+            if recs:
+                self.metrics_store.ingest_many(recs)
+        elif channel == CH_TRAIN:
+            self.train_manager.ingest(message)
+            # every step record derives the rayt_train_* histograms +
+            # compile counter + device-memory gauges, before eviction
+            recs = self.train_manager.drain_metric_records()
             if recs:
                 self.metrics_store.ingest_many(recs)
         dead = []
@@ -1016,6 +1039,9 @@ class GcsServer:
         recs = self.dag_manager.drain_metric_records()
         if recs:
             self.metrics_store.ingest_many(recs)
+        # ...and its train runs (step records, stall flags, memory
+        # snapshots — a resubmitted job starts with a clean ledger)
+        self.train_manager.on_job_finished(job_id.hex())
         # node managers relay this to their pooled workers, which drop
         # the finished job's function-table entries (pooled workers
         # outlive jobs; see core/function_table.py evict_job)
@@ -1592,6 +1618,30 @@ class GcsServer:
     def rpc_get_serve_request(self, conn, request_id: str):
         """One request record by id (hex prefix accepted)."""
         return self.serve_manager.get(request_id or "")
+
+    def rpc_list_train_runs(self, conn, arg=None):
+        """State API `list_train_runs` backend: filtered run records
+        (experiment / state, limit) with per-worker rollups, sparkline
+        history, stall flags, and device-memory snapshots — server-side,
+        no full-store dump to the client."""
+        return self.train_manager.list_runs(**dict(arg or {}))
+
+    def rpc_summarize_train_runs(self, conn, arg=None):
+        """State API `summarize_train_runs` backend: per-run step
+        counts + waterfall-stage p50/p99 rollups, compile/retrace
+        counts, stalled + starved workers, and memory totals
+        (`rayt train status`'s table)."""
+        return self.train_manager.summarize(**dict(arg or {}))
+
+    def rpc_get_train_run(self, conn, run_id: str):
+        """One train-run record by id (hex prefix accepted)."""
+        return self.train_manager.get(run_id or "")
+
+    def rpc_list_train_steps(self, conn, arg=None):
+        """State API `list_train_steps` backend: retained per-step
+        waterfall records (run / rank / min-wall / slowest-first,
+        limit) with per-run dropped accounting."""
+        return self.train_manager.list_steps(**dict(arg or {}))
 
     def rpc_list_cluster_events(self, conn, arg=None):
         """State API `list_cluster_events` backend: filtered event-log

@@ -306,6 +306,7 @@ class DashboardHead:
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/serve", self._serve)
         app.router.add_get("/api/serve/requests", self._serve_requests)
+        app.router.add_get("/api/train", self._train_state)
         app.router.add_get("/api/data", self._data)
         app.router.add_get("/api/metrics/names", self._metrics_names)
         app.router.add_get("/api/metrics/query", self._metrics_query)
@@ -469,6 +470,31 @@ class DashboardHead:
             return web.json_response({"error": str(e)}, status=400)
         out["summary"] = self.gcs.serve_manager.summarize(
             app=q.get("app") or None)
+        return web.json_response(out)
+
+    async def _train_state(self, request):
+        """Train-plane state (GCS train manager; the Train tab's feed
+        and the `rayt train status` twin): filtered run records with
+        per-worker step histories, plus recent step waterfalls and the
+        per-run summary rollup. Query params mirror the CLI:
+        ?experiment=&state=&run=&worker=&slow=1&limit=."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            out = self.gcs.train_manager.list_runs(
+                experiment=q.get("experiment") or None,
+                state=q.get("state") or None,
+                limit=int(q.get("limit", 20)))
+            out["steps"] = self.gcs.train_manager.list_steps(
+                run_id=q.get("run") or None,
+                rank=(int(q["worker"]) if q.get("worker") else None),
+                slow=q.get("slow", "") in ("1", "true", "yes"),
+                limit=int(q.get("steps_limit", 50)))["steps"]
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        out["summary"] = self.gcs.train_manager.summarize(
+            run_id=q.get("run") or None)
         return web.json_response(out)
 
     async def _data(self, request):

@@ -329,6 +329,39 @@ class IMPALALearner:
         else:
             self._update = jax.jit(update)
 
+        # step-waterfall parity with the trainer: the learner emits the
+        # same train_state records (experiment "rl:impala"/"rl:appo"),
+        # so `rayt train status` shows the data-wait vs update split of
+        # the Podracer loop and wrap_jit surfaces V-trace retraces
+        self._recorder = None
+        try:
+            from ray_tpu.train.telemetry import (StepRecorder,
+                                                 mint_run_id,
+                                                 publish_record,
+                                                 recording_enabled)
+
+            if recording_enabled():
+                exp = ("rl:appo" if self.cfg.use_appo_loss
+                       else "rl:impala")
+                self._run_id = mint_run_id()
+                self._recorder = StepRecorder(self._run_id, exp)
+                job_hex = ""
+                try:
+                    from ray_tpu.core.object_ref import get_core_worker
+
+                    job_hex = get_core_worker().job_id.hex()
+                except Exception:
+                    pass
+                publish_record({
+                    "kind": "run", "run_id": self._run_id,
+                    "experiment": exp, "job_id": job_hex,
+                    "world_size": 1, "state": "RUNNING",
+                    "ts": time.time()})
+                self._update = self._recorder.wrap_jit(
+                    self._update, "impala_update")
+        except Exception:
+            self._recorder = None
+
         from ray_tpu.rl.connectors import default_learner_pipeline
 
         self._pipeline = (self.cfg.learner_pipeline
@@ -364,14 +397,29 @@ class IMPALALearner:
     def update(self, batch: dict) -> dict:
         import jax.numpy as jnp
 
+        rec = getattr(self, "_recorder", None)
+        if rec is not None:
+            # close the inter-update data_wait armed after the last
+            # step; if it never closes the stall watchdog flags the
+            # learner ingest-starved
+            rec.end_phase()
+            rec.begin_phase("h2d")
         batch = self._pipeline(batch)
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k != "episode_returns"}
         jb = self._place_batch(jb)
+        if rec is not None:
+            rec.end_phase()
+            rec.begin_phase("step")
         self.params, self.opt_state, aux = self._update(
             self.params, self.opt_state, jb)
         self.num_updates += 1
-        return {k: float(v) for k, v in aux.items()}
+        out = {k: float(v) for k, v in aux.items()}  # blocks until ready
+        if rec is not None:
+            rec.end_phase()
+            rec.end_step(self.num_updates, loss=out.get("loss"))
+            rec.begin_phase("data_wait")
+        return out
 
     def step(self, *batch_lists) -> dict:
         """Compiled-DAG tick: consume the aggregators' ready batches

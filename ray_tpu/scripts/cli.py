@@ -352,6 +352,33 @@ def _print_requests(out: dict):
           f"{sampled} sampled out)")
 
 
+def _print_steps(out: dict):
+    """`rayt list steps` view: one line per step with its waterfall —
+    data_wait > h2d > step > ckpt_block tiling the step wall."""
+    from ray_tpu.core.gcs_train_manager import TRAIN_STAGES
+
+    fmt = "{:<10} {:<14} {:>4} {:>6} {:>9}  {}"
+    print(fmt.format("run", "experiment", "rank", "step", "wall",
+                     "waterfall"))
+    for s in out.get("steps", ()):
+        st = s.get("stages") or {}
+        wf = " > ".join(f"{k[:-2]} {_fmt_lat(st[k])}"
+                        for k in TRAIN_STAGES
+                        if st.get(k) is not None)
+        tail = ""
+        if s.get("ckpt_commit_s") is not None:
+            tail += f" | commit {_fmt_lat(s['ckpt_commit_s'])}"
+        if s.get("loss") is not None:
+            tail += f" loss={s['loss']:.4g}"
+        print(fmt.format(s.get("run_id", "")[:10],
+                         (s.get("experiment") or "")[:14],
+                         s.get("rank", 0), s.get("step", 0),
+                         _fmt_lat(s.get("wall_s")), wf + tail))
+    dropped = sum((out.get("dropped") or {}).values())
+    print(f"-- {out.get('total', 0)} matched "
+          f"({out.get('truncated', 0)} truncated, {dropped} evicted)")
+
+
 def cmd_list(args):
     from ray_tpu import state_api
 
@@ -387,6 +414,15 @@ def cmd_list(args):
             slow=bool(getattr(args, "slow", False)),
             limit=args.limit, detail=True)
         _print_requests(out)
+        return
+    if kind == "steps":
+        out = state_api.list_train_steps(
+            run_id=getattr(args, "run", None) or None,
+            rank=(int(args.worker)
+                  if getattr(args, "worker", None) is not None else None),
+            slow=bool(getattr(args, "slow", False)),
+            limit=args.limit, detail=True)
+        _print_steps(out)
         return
     if kind == "dags":
         out = state_api.list_dags(
@@ -736,6 +772,61 @@ def _print_serve_waterfall(summ: dict):
           f"({dropped} evicted, {sampled} sampled out)")
 
 
+def cmd_train_status(args):
+    """`rayt train status`: per-run waterfall table (p50/p99/mean per
+    stage), compile/retrace counts, stalled workers with attribution,
+    starved dp ranks, and device-memory totals — from the GCS train
+    manager's retained step records."""
+    _serve_connect(args)
+    from ray_tpu import state_api
+
+    _print_train_waterfall(state_api.summarize_train_runs(
+        run_id=getattr(args, "run", None) or None))
+
+
+def _print_train_waterfall(summ: dict):
+    from ray_tpu.core.gcs_train_manager import TRAIN_STAGES
+
+    runs = summ.get("runs") or {}
+    if not runs:
+        print("no train runs recorded")
+        return
+    fmt = "  {:<14} {:>9} {:>9} {:>9} {:>6}"
+    for rid, e in runs.items():
+        print(f"\nrun {rid[:12]} experiment={e.get('experiment')!r} "
+              f"state={e.get('state')} workers={e.get('world_size')} "
+              f"steps={e.get('steps')} (last step {e.get('last_step')})")
+        print(fmt.format("stage", "p50", "p99", "mean", "n"))
+        rows = [("wall", e.get("wall"))]
+        stages = e.get("stages") or {}
+        rows += [(k[:-2], stages.get(k)) for k in TRAIN_STAGES]
+        for name, roll in rows:
+            if not roll or not roll.get("n"):
+                continue
+            print(fmt.format(name, _fmt_lat(roll.get("p50")),
+                             _fmt_lat(roll.get("p99")),
+                             _fmt_lat(roll.get("mean")), roll["n"]))
+        print(f"  compiles={e.get('compile_count', 0)} "
+              f"retraces={e.get('retrace_count', 0)} "
+              f"mem_used={e.get('memory_used_bytes', 0) / 1e6:.1f}MB "
+              f"mem_peak={e.get('memory_peak_bytes', 0) / 1e6:.1f}MB")
+        for rank, stall in sorted(
+                (e.get("stalled_workers") or {}).items()):
+            print(f"  STALLED rank {rank}: {stall.get('attribution')} "
+                  f"(step {stall.get('step')} blocked "
+                  f"{stall.get('blocked_s', 0):.1f}s in "
+                  f"{stall.get('phase')})")
+        for rank, sv in sorted((e.get("starved_workers") or {}).items()):
+            print(f"  STARVED rank {rank}: ingest wait "
+                  f"{sv.get('share', 0) * 100:.0f}% of wall "
+                  f"({sv.get('data_wait_s', 0):.2f}s / "
+                  f"{sv.get('wall_s', 0):.2f}s)")
+    dropped = sum((summ.get("dropped") or {}).values())
+    print(f"\n{summ.get('steps_total', 0)} steps recorded, "
+          f"{summ.get('total_steps', 0)} retained ({dropped} evicted, "
+          f"{summ.get('stalled', 0)} workers stalled)")
+
+
 def cmd_serve_shutdown(args):
     _serve_connect(args)
     from ray_tpu import serve
@@ -870,6 +961,13 @@ def main(argv=None):
             ssp.add_argument("config_file")
         ssp.set_defaults(fn=fn)
 
+    tp = sub.add_parser("train", help="inspect training runs")
+    tsub = tp.add_subparsers(dest="train_command", required=True)
+    tsp = tsub.add_parser("status")
+    tsp.add_argument("--address", help="GCS host:port")
+    tsp.add_argument("--run", help="filter to one run id (hex prefix)")
+    tsp.set_defaults(fn=cmd_train_status)
+
     sp = sub.add_parser("client-server",
                         help="remote-driver proxy (ray-client analog)")
     sp.add_argument("--address", required=True, help="GCS host:port")
@@ -908,7 +1006,8 @@ def main(argv=None):
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors", "jobs", "pgs",
                                      "workers", "tasks", "objects",
-                                     "dags", "events", "requests"])
+                                     "dags", "events", "requests",
+                                     "steps"])
     sp.add_argument("--app", help="requests: filter by serve app")
     sp.add_argument("--outcome",
                     help="requests: filter by outcome (ok/error/shed/"
@@ -919,7 +1018,10 @@ def main(argv=None):
     sp.add_argument("--errors", action="store_true",
                     help="requests: only non-ok outcomes")
     sp.add_argument("--slow", action="store_true",
-                    help="requests: order by e2e latency descending")
+                    help="requests/steps: order by latency descending")
+    sp.add_argument("--run", help="steps: filter by train run id "
+                                  "(hex prefix)")
+    sp.add_argument("--worker", help="steps: filter by dp rank")
     sp.add_argument("--job", help="tasks/objects/dags/events: filter "
                                   "by job id (hex)")
     sp.add_argument("--state", help="tasks: filter by lifecycle state")

@@ -330,6 +330,65 @@ def get_serve_request(request_id: str) -> Optional[dict]:
     return cw.io.run(cw.gcs.call("get_serve_request", request_id))
 
 
+def list_train_runs(*, experiment: Optional[str] = None,
+                    state: Optional[str] = None, limit: int = 100,
+                    detail: bool = False) -> Any:
+    """Train-run records from the GCS train manager, filtered
+    SERVER-side (experiment / state, limit). Each record carries the
+    per-worker step rollups (stage totals, sparkline history of the
+    last 60 step waterfalls), the stall watchdog's attributed flag,
+    the latest device-memory snapshot, and the run's compile/retrace
+    events. Records flow on the ~1s flush cadence, so the freshest
+    steps can lag by a beat."""
+    cw = _cw()
+    filters: dict = {"limit": limit}
+    if experiment is not None:
+        filters["experiment"] = experiment
+    if state is not None:
+        filters["state"] = state
+    out = cw.io.run(cw.gcs.call("list_train_runs", filters))
+    return out if detail else out["runs"]
+
+
+def summarize_train_runs(*, run_id: Optional[str] = None) -> dict:
+    """Train-plane rollup: per-run step counts and p50/p99/mean for
+    each waterfall stage (data_wait/h2d/step/ckpt_block tiling step
+    wall), compile/retrace counts, stalled workers with attribution
+    (ingest-starved / checkpoint-blocked / collective-barrier), starved
+    dp ranks, and device-memory totals — the data behind
+    `rayt train status` and the dashboard Train tab."""
+    cw = _cw()
+    filters = {"run_id": run_id} if run_id is not None else {}
+    return cw.io.run(cw.gcs.call("summarize_train_runs", filters))
+
+
+def get_train_run(run_id: str) -> Optional[dict]:
+    """One train-run record by id (hex prefix accepted)."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.call("get_train_run", run_id))
+
+
+def list_train_steps(*, run_id: Optional[str] = None,
+                     rank: Optional[int] = None, slow: bool = False,
+                     min_wall_s: Optional[float] = None,
+                     limit: int = 100, detail: bool = False) -> Any:
+    """Retained per-step waterfall records (run / rank / min-wall
+    filters run SERVER-side; ``slow=True`` orders by step wall time
+    descending — the `rayt list steps --slow` view). Stages
+    data_wait_s + h2d_s + step_s + ckpt_block_s tile wall_s by
+    construction."""
+    cw = _cw()
+    filters: dict = {"limit": limit, "slow": slow}
+    if run_id is not None:
+        filters["run_id"] = run_id
+    if rank is not None:
+        filters["rank"] = rank
+    if min_wall_s is not None:
+        filters["min_wall_s"] = min_wall_s
+    out = cw.io.run(cw.gcs.call("list_train_steps", filters))
+    return out if detail else out["steps"]
+
+
 def list_cluster_events(*, job_id: Optional[str] = None,
                         node_id: Optional[str] = None,
                         severity: Optional[str] = None,

@@ -1,8 +1,9 @@
 """ray_tpu.train — distributed training on TPU slices (ref analog:
 python/ray/train; architecture per train/v2, SURVEY.md §2.3/§3.4)."""
 
-from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F401
-                                      load_pytree, save_pytree)
+from ray_tpu.train.checkpoint import (AsyncSave, Checkpoint,  # noqa: F401
+                                      CheckpointManager, load_pytree,
+                                      save_pytree, save_pytree_async)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
                                   Result, RunConfig, ScalingConfig)
 from ray_tpu.train.controller import (ElasticScalingPolicy,  # noqa: F401
@@ -14,4 +15,5 @@ from ray_tpu.train.recipes import (corpus_pretrain_loop,  # noqa: F401
                                    lora_finetune_loop)
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
                                    get_ingest, report)
+from ray_tpu.train.telemetry import StepRecorder  # noqa: F401
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

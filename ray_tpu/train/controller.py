@@ -125,6 +125,35 @@ class TrainController:
         self._group_seq = 0
         self._last_world_size = scaling.num_workers
         self._seen_checkpoints: set[str] = set()
+        # train-plane observability: the run id keys every step record /
+        # compile event / memory snapshot this run's workers publish
+        # (core/gcs_train_manager); minted here, threaded through
+        # WorkerGroup.setup into each worker's session
+        from ray_tpu.train.telemetry import mint_run_id
+
+        self.run_id = mint_run_id()
+
+    def _publish_run_state(self, state: str, world_size: int):
+        """Best-effort run lifecycle record onto the train_state
+        channel (RUNNING at group start, FINISHED/FAILED at the end) —
+        carries the job id so the GCS purges the run on job finish."""
+        import time as _time
+
+        from ray_tpu.train.telemetry import publish_record
+
+        job_hex = ""
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            if cw is not None and cw.job_id is not None:
+                job_hex = cw.job_id.hex()
+        except Exception:
+            pass
+        publish_record({"kind": "run", "run_id": self.run_id,
+                        "experiment": self.experiment_name,
+                        "job_id": job_hex, "world_size": world_size,
+                        "state": state, "ts": _time.time()})
 
     # ------------------------------------------------------------------ run
     def run(self) -> Result:
@@ -134,16 +163,19 @@ class TrainController:
             self._last_world_size = sized.num_workers
             group = WorkerGroup(
                 sized, self.run_config,
-                self.experiment_path, self.experiment_name, self._group_seq)
+                self.experiment_path, self.experiment_name, self._group_seq,
+                run_id=self.run_id)
             self._group_seq += 1
             latest = (self.checkpoint_manager.latest.path
                       if self.checkpoint_manager.latest else None)
             try:
                 group.start(latest)
+                self._publish_run_state("RUNNING", sized.num_workers)
                 run_refs = group.run_async(self.train_fn, self.config)
                 self._poll(group, run_refs)
                 self._ingest(group.drain_results())
                 group.shutdown()
+                self._publish_run_state("FINISHED", sized.num_workers)
                 return self._result(None)
             except (rt.ActorDiedError, rt.WorkerCrashedError, rt.TaskError,
                     rt.RayTpuError, TimeoutError) as e:
@@ -153,6 +185,7 @@ class TrainController:
                 if self.failure_policy.decide(e) == FailurePolicy.RETRY:
                     continue
                 error = e
+                self._publish_run_state("FAILED", sized.num_workers)
                 return self._result(error)
 
     def _poll(self, group: WorkerGroup, run_refs: list):

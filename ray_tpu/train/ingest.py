@@ -57,6 +57,7 @@ class IngestStats:
     blocks: int = 0
     tokens: int = 0
     stall_s: float = 0.0      # consumer time blocked waiting on producer
+    last_stall_s: float = 0.0  # the most recent next()'s queue wait
     load_s: float = 0.0       # producer time packing/loading batches
     wall_s: float = 0.0       # first next() to last next()
 
@@ -76,13 +77,18 @@ class CorpusIngestIterator:
 
     def __init__(self, spec: IngestSpec, *, dp_rank: int = 0,
                  world_size: int = 1, mesh=None,
-                 state: Optional[dict] = None, experiment: str = ""):
+                 state: Optional[dict] = None, experiment: str = "",
+                 recorder=None):
         from ray_tpu.data.llm_corpus import TokenCorpus
 
         self.spec = spec
         self.mesh = mesh
         self.dp_rank = dp_rank
         self.experiment = experiment
+        # optional train/telemetry.StepRecorder: the queue wait becomes
+        # the step's data_wait_s waterfall stage, and the blocked get()
+        # is a watchdog-visible phase (ingest-starved attribution)
+        self.recorder = recorder
         self.stats = IngestStats()
         self._corpus = TokenCorpus(
             spec.paths, seq_len=spec.seq_len, dp_rank=dp_rank,
@@ -153,10 +159,18 @@ class CorpusIngestIterator:
                 daemon=True)
             self._thread.start()
             self._t_first = time.perf_counter()
+        rec = self.recorder
+        if rec is not None:
+            rec.begin_phase("data_wait")
         t0 = time.perf_counter()
-        item = self._q.get()
+        try:
+            item = self._q.get()
+        finally:
+            if rec is not None:
+                rec.end_phase()
         stall = time.perf_counter() - t0
         self.stats.stall_s += stall
+        self.stats.last_stall_s = stall
         if isinstance(item, _Stop):
             self._done = True
             if item.error is not None:
@@ -169,6 +183,9 @@ class CorpusIngestIterator:
         self.stats.tokens += int(batch["tokens"].size)
         self.stats.wall_s = time.perf_counter() - self._t_first
         self._emit_metrics(batch, stall)
+        if rec is not None:
+            with rec.phase("h2d"):
+                return self._to_device(batch)
         return self._to_device(batch)
 
     def close(self) -> None:

@@ -75,6 +75,14 @@ def corpus_pretrain_loop(config: dict):
         loss, grad = jax.value_and_grad(loss_fn)(w)
         return w - lr * grad, loss
 
+    # per-step waterfall (train/telemetry): ingest stamps data_wait/h2d,
+    # the compute phase block-until-readies so step_s is honest, report
+    # stamps ckpt_block — the four stages tile step wall by construction.
+    # wrap_jit adds compile/retrace accounting on the train step.
+    rec = ctx.recorder
+    if rec is not None:
+        sgd_step = rec.wrap_jit(sgd_step, "sgd_step")
+
     trace_dir = config.get("trace_dir")
     if trace_dir:
         os.makedirs(os.path.join(trace_dir, f"rank{rank}"), exist_ok=True)
@@ -90,15 +98,24 @@ def corpus_pretrain_loop(config: dict):
                     open(marker, "w").close()
                     os._exit(1)  # simulate a hard worker kill mid-epoch
             try:
-                batch = next(it)
+                batch = next(it)  # ingest stamps data_wait (+h2d if mesh)
             except StopIteration:
                 break  # corpus exhausted before `steps`
-            tokens = jnp.asarray(batch["tokens"])
+            if rec is not None:
+                with rec.phase("h2d"):
+                    tokens = jnp.asarray(batch["tokens"])
+            else:
+                tokens = jnp.asarray(batch["tokens"])
             if trace_dir:
                 np.save(os.path.join(trace_dir, f"rank{rank}",
                                      f"step_{step:05d}.npy"),
                         np.asarray(batch["tokens"]))
-            w, loss = sgd_step(w, tokens)
+            if rec is not None:
+                with rec.phase("step"):
+                    w, loss = sgd_step(w, tokens)
+                    jax.block_until_ready(loss)
+            else:
+                w, loss = sgd_step(w, tokens)
             if (step + 1) % ckpt_every == 0 or step == steps - 1:
                 c = Checkpoint.from_dict({
                     "w": np.asarray(w), "step": step + 1,
@@ -110,6 +127,9 @@ def corpus_pretrain_loop(config: dict):
                      "ingest_load_s": it.stats.load_s},
                     checkpoint=c)
                 shutil.rmtree(c.path, ignore_errors=True)  # report copied
+            if rec is not None:
+                rec.end_step(step + 1, tokens=int(batch["tokens"].size),
+                             loss=float(loss))
     finally:
         it.close()  # a failed step must not leak the prefetch thread
     return float(loss) if loss is not None else None
@@ -220,10 +240,23 @@ def lora_finetune_loop(config: dict):
     report_every = config.get("report_every", 10)
     steps = config.get("steps", 50)
 
+    # same waterfall as corpus_pretrain_loop (h2d = shard_batch, step =
+    # block-until-ready update, ckpt_block stamped inside report)
+    rec = ctx.recorder
+    if rec is not None:
+        step = rec.wrap_jit(step, "lora_step")
+
     last_loss = first_loss = None
     for i in range(start_step, steps):
-        batch = shard_batch(make_batch(i, rank), mesh)
-        state, aux = step(state, batch)
+        if rec is not None:
+            with rec.phase("h2d"):
+                batch = shard_batch(make_batch(i, rank), mesh)
+            with rec.phase("step"):
+                state, aux = step(state, batch)
+                jax.block_until_ready(aux["loss"])
+        else:
+            batch = shard_batch(make_batch(i, rank), mesh)
+            state, aux = step(state, batch)
         if (i + 1) % report_every == 0 or i == steps - 1:
             last_loss = float(aux["loss"])
             if first_loss is None:
@@ -240,4 +273,6 @@ def lora_finetune_loop(config: dict):
                 train.report({"loss": last_loss, "first_loss": first_loss,
                               "step": i + 1},
                              checkpoint=Checkpoint(d))
+        if rec is not None:
+            rec.end_step(i + 1, loss=float(aux["loss"]))
     return last_loss

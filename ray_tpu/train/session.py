@@ -25,13 +25,29 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, experiment_path: str,
                  experiment_name: str, latest_checkpoint: Optional[str],
                  mesh_axes: Optional[dict] = None,
-                 ingest_spec=None):
+                 ingest_spec=None, run_id: Optional[str] = None,
+                 node_id: str = ""):
         self.rank = rank
         self.world_size = world_size
         self.experiment_path = experiment_path
         self.experiment_name = experiment_name
         self.mesh_axes = mesh_axes
         self.ingest_spec = ingest_spec
+        self.run_id = run_id
+        # per-step waterfall recorder (train/telemetry.py), live when
+        # the controller minted a run id and capture is enabled
+        self.recorder = None
+        if run_id:
+            try:
+                from ray_tpu.train.telemetry import (StepRecorder,
+                                                     recording_enabled)
+
+                if recording_enabled():
+                    self.recorder = StepRecorder(
+                        run_id, experiment_name, rank=rank,
+                        node_id=node_id)
+            except Exception:
+                self.recorder = None
         self._latest_checkpoint_dir = latest_checkpoint
         self._results: collections.deque = collections.deque()
         self._results_cond = threading.Condition()
@@ -91,7 +107,7 @@ class TrainContext:
         return CorpusIngestIterator(
             self.ingest_spec, dp_rank=self.rank,
             world_size=self.world_size, mesh=mesh, state=state,
-            experiment=self.experiment_name)
+            experiment=self.experiment_name, recorder=self.recorder)
 
     def _emit_metrics(self, metrics: dict):
         """Per-report training telemetry onto the cluster metrics
@@ -131,25 +147,46 @@ class TrainContext:
         entry = {"metrics": dict(metrics), "rank": self.rank,
                  "index": self._report_index, "checkpoint_dir": None}
         if checkpoint is not None:
-            step_dir = os.path.join(
-                self.experiment_path,
-                f"checkpoint_{self._report_index:06d}")
-            rank_dir = os.path.join(step_dir, f"rank_{self.rank}")
-            if os.path.abspath(checkpoint.path) != os.path.abspath(rank_dir):
-                os.makedirs(step_dir, exist_ok=True)
-                shutil.copytree(checkpoint.path, rank_dir,
-                                dirs_exist_ok=True)
-            # durable completion marker: lets the controller recover this
-            # checkpoint even if the worker dies before results are drained
-            with open(os.path.join(step_dir, f".complete-rank_{self.rank}"),
-                      "w"):
-                pass
-            entry["checkpoint_dir"] = step_dir
-            self._latest_checkpoint_dir = step_dir
+            # the synchronous slice of the save (staging the shard into
+            # run storage) is the step's ckpt_block_s waterfall stage;
+            # an async-committed checkpoint's background portion is NOT
+            # in here (see checkpoint.save_pytree_async)
+            if self.recorder is not None:
+                self.recorder.begin_phase("ckpt_block")
+            try:
+                step_dir = os.path.join(
+                    self.experiment_path,
+                    f"checkpoint_{self._report_index:06d}")
+                rank_dir = os.path.join(step_dir, f"rank_{self.rank}")
+                if os.path.abspath(checkpoint.path) != \
+                        os.path.abspath(rank_dir):
+                    os.makedirs(step_dir, exist_ok=True)
+                    shutil.copytree(checkpoint.path, rank_dir,
+                                    dirs_exist_ok=True)
+                # durable completion marker: lets the controller recover
+                # this checkpoint even if the worker dies before results
+                # are drained
+                with open(os.path.join(
+                        step_dir, f".complete-rank_{self.rank}"), "w"):
+                    pass
+                entry["checkpoint_dir"] = step_dir
+                self._latest_checkpoint_dir = step_dir
+            finally:
+                if self.recorder is not None:
+                    self.recorder.end_phase()
         self._report_index += 1
         with self._results_cond:
             self._results.append(entry)
             self._results_cond.notify_all()
+
+    def close_telemetry(self):
+        """Worker teardown: drain the recorder's buffered step records
+        synchronously so the run's tail survives the actor exit."""
+        if self.recorder is not None:
+            try:
+                self.recorder.close()
+            except Exception:
+                pass
 
     # ------------------------------------------------------ controller side
     def drain_results(self) -> list[dict]:

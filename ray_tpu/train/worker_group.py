@@ -26,13 +26,23 @@ class TrainWorker:
     def setup(self, rank: int, world_size: int, experiment_path: str,
               experiment_name: str, latest_checkpoint: Optional[str],
               mesh_axes: Optional[dict], group_name: str,
-              ingest_spec=None) -> dict:
+              ingest_spec=None, run_id: Optional[str] = None) -> dict:
         from ray_tpu.util import collective
 
         self._group_name = group_name
+        node_id = ""
+        try:
+            from ray_tpu.core.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            if cw is not None:
+                node_id = cw.node_id.hex()
+        except Exception:
+            pass
         ctx = session.TrainContext(rank, world_size, experiment_path,
                                    experiment_name, latest_checkpoint,
-                                   mesh_axes, ingest_spec=ingest_spec)
+                                   mesh_axes, ingest_spec=ingest_spec,
+                                   run_id=run_id, node_id=node_id)
         session.set_context(ctx)
         self._ctx = ctx
         # Host-plane communicator: barriers, coordinator-address exchange
@@ -44,15 +54,21 @@ class TrainWorker:
 
     def run(self, fn_blob: bytes, config: Optional[dict]) -> dict:
         fn = cloudpickle.loads(fn_blob)
-        if _wants_config(fn):
-            fn(config or {})
-        elif config:
-            raise TypeError(
-                f"train loop {getattr(fn, '__name__', fn)!r} takes no "
-                "config parameter but a non-empty train_loop_config was "
-                "given — it would be silently ignored")
-        else:
-            fn()
+        try:
+            if _wants_config(fn):
+                fn(config or {})
+            elif config:
+                raise TypeError(
+                    f"train loop {getattr(fn, '__name__', fn)!r} takes "
+                    "no config parameter but a non-empty "
+                    "train_loop_config was given — it would be silently "
+                    "ignored")
+            else:
+                fn()
+        finally:
+            # drain buffered step records before the actor can be torn
+            # down — the run's tail must reach the GCS train manager
+            self._ctx.close_telemetry()
         return {"rank": self._ctx.rank, "status": "finished"}
 
     def drain_results(self) -> list[dict]:
@@ -107,12 +123,13 @@ def _wants_config(fn: Callable) -> bool:
 class WorkerGroup:
     def __init__(self, scaling: ScalingConfig, run_config: RunConfig,
                  experiment_path: str, experiment_name: str,
-                 group_seq: int):
+                 group_seq: int, run_id: Optional[str] = None):
         self.scaling = scaling
         self.run_config = run_config
         self.experiment_path = experiment_path
         self.experiment_name = experiment_name
         self.group_seq = group_seq
+        self.run_id = run_id
         self.workers: list = []
         self.pg = None
 
@@ -133,7 +150,7 @@ class WorkerGroup:
         setup_refs = [
             w.setup.remote(i, n, self.experiment_path, self.experiment_name,
                            latest_checkpoint, self.scaling.mesh, group_name,
-                           self.scaling.ingest)
+                           self.scaling.ingest, self.run_id)
             for i, w in enumerate(self.workers)]
         return rt.get(setup_refs, timeout=120)
 

@@ -340,3 +340,59 @@ def heartbeat_gap_records(gaps: dict, *, ts: float) -> list:
     return [{"name": "rayt_node_heartbeat_gap_s", "kind": "gauge",
              "value": float(gap), "tags": {"node": node_hex}, "ts": ts}
             for node_hex, gap in gaps.items()]
+
+
+def train_step_metric_records(experiment: str, *, step_s=None,
+                              data_wait_s=None, h2d_s=None,
+                              ckpt_block_s=None, ts: float = 0.0) -> list:
+    """Per-step train waterfall histograms, derived by the GCS train
+    manager from every step record BEFORE retention/eviction decisions
+    (the GCS process has no core worker, so — like the dag/serve
+    managers — it builds raw records and feeds its own metrics store).
+    Each record is one raw observation bucketed into LATENCY_BOUNDS."""
+    tags = {"experiment": experiment}
+    bounds = list(LATENCY_BOUNDS)
+    recs = []
+
+    def hist(name, value):
+        if value is not None:
+            recs.append({"name": name, "kind": "histogram",
+                         "value": float(value), "tags": tags, "ts": ts,
+                         "bounds": bounds})
+
+    hist("rayt_train_step_s", step_s)
+    hist("rayt_train_data_wait_s", data_wait_s)
+    hist("rayt_train_h2d_s", h2d_s)
+    hist("rayt_train_ckpt_block_s", ckpt_block_s)
+    return recs
+
+
+def train_compile_metric_records(experiment: str, *, event: str,
+                                 ts: float = 0.0) -> list:
+    """One XLA compile/retrace event -> rayt_train_compiles_total delta
+    (counter records carry DELTAS; the store sums them). The ``event``
+    tag splits first-trace compiles from mid-training retraces — the
+    latter going non-zero during steady state is the perf bug."""
+    return [{"name": "rayt_train_compiles_total", "kind": "counter",
+             "value": 1.0,
+             "tags": {"experiment": experiment, "event": event},
+             "ts": ts}]
+
+
+def device_memory_gauge_records(node_hex: str, devices, *,
+                                ts: float = 0.0) -> list:
+    """Per-device memory gauges from a worker's jax memory_stats()
+    snapshot: bytes in use + peak, tagged (node, device) so one hot
+    device on one host is attributable from Prometheus alone."""
+    recs = []
+    for d in devices or ():
+        tags = {"node": node_hex, "device": str(d.get("device") or "")}
+        for name, key in (("rayt_device_memory_used_bytes",
+                           "bytes_in_use"),
+                          ("rayt_device_memory_peak_bytes",
+                           "peak_bytes")):
+            if d.get(key) is not None:
+                recs.append({"name": name, "kind": "gauge",
+                             "value": float(d[key]), "tags": tags,
+                             "ts": ts})
+    return recs

@@ -202,7 +202,7 @@ def test_index_page_serves_spa(dash_cluster):
                      "/api/cluster", "/api/events",
                      "/api/tasks", "/api/tasks/summary",
                      "/api/objects", "/api/objects/summary",
-                     "/api/dags",
+                     "/api/dags", "/api/train",
                      "/api/metrics/names", "/api/metrics/query",
                      "/api/timeline", "/metrics"):
         assert endpoint in html, endpoint
@@ -215,7 +215,8 @@ def test_index_page_serves_spa(dash_cluster):
                    "view-tasks", "task-summary", "task-err",
                    "view-objects", "object-summary", "view-data",
                    "data-exchanges", "view-dags", "dag-list",
-                   "dag-edges", "sparkline", "offset=",
+                   "dag-edges", "view-train", "train-runs",
+                   "train-steps", "sparkline", "offset=",
                    "cluster-events", "pending-demand", "event-warn",
                    "rayt_node_heartbeat_gap_s"):
         assert marker in html, marker
@@ -594,6 +595,65 @@ def test_serve_view_and_timeline_endpoints(dash_cluster):
     # cheap count-only form (what the SPA polls)
     count = json.loads(_get(port, "/api/timeline?count=1"))
     assert count["events"] >= len(events)
+
+
+def test_train_endpoint_runs_steps_and_summary(dash_cluster):
+    """/api/train (the SPA Train tab feed): filtered train-run records
+    with per-worker rollups, recent step waterfalls, and the per-run
+    summary — fed by the GCS train manager off the train_state
+    channel. Bad query params are 400s, not 500s."""
+    from ray_tpu.core.gcs_train_manager import CH_TRAIN, TRAIN_STAGES
+    from ray_tpu.core.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    recs = [{"kind": "run", "run_id": "t" * 32, "experiment": "dash",
+             "job_id": "", "world_size": 1, "state": "RUNNING",
+             "ts": time.time()}]
+    for i in range(3):
+        recs.append({
+            "kind": "step", "run_id": "t" * 32, "experiment": "dash",
+            "rank": 0, "step": i, "wall_s": 0.010 * (i + 1),
+            "stages": {"data_wait_s": 0.002, "h2d_s": 0.001,
+                       "step_s": 0.006 * (i + 1), "ckpt_block_s": 0.0},
+            "loss": 1.0 - 0.1 * i, "ts": time.time()})
+    recs.append({"kind": "compile", "run_id": "t" * 32, "rank": 0,
+                 "fn": "f", "event": "compile", "compile_s": 0.2,
+                 "shape": "(f32[8])", "prev_shape": "",
+                 "ts": time.time()})
+    cw.io.run(cw.gcs.publish(CH_TRAIN, recs))
+
+    port = dash_cluster.dashboard_port
+    deadline = time.monotonic() + 30
+    out = {}
+    while time.monotonic() < deadline:
+        out = json.loads(_get(port, "/api/train?slow=1"))
+        if any(r["run_id"] == "t" * 32 for r in out.get("runs", ())):
+            break
+        time.sleep(0.3)
+    run = next(r for r in out["runs"] if r["run_id"] == "t" * 32)
+    assert run["experiment"] == "dash" and run["state"] == "RUNNING"
+    assert run["compile_count"] == 1
+    # workers key by rank; history carries the sparkline waterfall
+    w = run["workers"]["0"] if "0" in run["workers"] \
+        else run["workers"][0]
+    assert w["steps_total"] == 3
+    # steps ride along, slowest first under ?slow=1
+    walls = [s["wall_s"] for s in out["steps"]
+             if s["run_id"] == "t" * 32]
+    assert walls == sorted(walls, reverse=True) and len(walls) == 3
+    assert all(set(s["stages"]) == set(TRAIN_STAGES)
+               for s in out["steps"])
+    # summary rollup attached
+    e = out["summary"]["runs"]["t" * 32]
+    assert e["steps"] == 3 and e["wall"]["n"] == 3
+    assert e["stages"]["step_s"]["p50"] is not None
+    # run filter narrows the steps; bad limit is a 400
+    narrowed = json.loads(_get(port, f"/api/train?run={'t' * 8}"))
+    assert len(narrowed["steps"]) == 3
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/api/train?limit=bogus")
+    assert ei.value.code == 400
 
 
 def test_data_endpoint_reports_exchange_counters(dash_cluster):
