@@ -19,9 +19,9 @@ __version__ = "0.1.0"
 from ray_tpu.api import (ActorClass, ActorHandle, PlacementGroup,  # noqa: F401
                          available_resources, cancel, cluster_resources,
                          drain_node, drain_status, get, get_actor,
-                         get_runtime_context, kill, nodes, placement_group,
-                         put, put_device, remote, remove_placement_group,
-                         wait)
+                         get_runtime_context, kill, nodes, place_gang,
+                         placement_group, put, put_device, remote,
+                         remove_placement_group, set_job_quota, wait)
 from ray_tpu.core.common import (ActorDiedError, GetTimeoutError,  # noqa: F401
                                  NodeAffinitySchedulingStrategy,
                                  NodeLabelSchedulingStrategy, ObjectLostError,

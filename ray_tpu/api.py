@@ -409,3 +409,33 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
 def remove_placement_group(pg: PlacementGroup):
     cw = _core_worker()
     cw.io.run(cw.gcs.conn.call("remove_placement_group", pg.id))
+
+
+# ------------------------------------------------------------ placement plane
+def place_gang(demands: list[dict],
+               strategy: str = "SLICE_PACK") -> list | None:
+    """Advisory gang placement through the GCS placement plane: a
+    node-id hex per demand, or None when the gang does not fit whole
+    right now. Nothing is reserved — callers that need a hard
+    reservation use placement_group() (same placer, behind the ordered
+    admission lock). RL / train worker groups use this for soft
+    co-location: pin each worker to its advised node with
+    NodeAffinitySchedulingStrategy(soft=True)."""
+    cw = _core_worker()
+    return cw.io.run(cw.gcs.conn.call(
+        "place_gang", (list(demands), strategy)))
+
+
+def set_job_quota(weight: float, floor: float = 0.0,
+                  job_id: str | None = None) -> None:
+    """Opt a job into fair-share scheduling of the governed resource
+    (RAYT_QUOTA_RESOURCE, default CPU). ``weight`` sets the job's slice
+    of the weighted cluster share; ``floor`` is an absolute minimum the
+    share never drops below. weight<=0 and floor<=0 removes the quota.
+    Defaults to the calling job. Enforcement is node-side and
+    work-conserving: an over-share job is parked only while another
+    job's lease waits on the same node."""
+    cw = _core_worker()
+    job_hex = job_id if job_id is not None else cw.job_id.hex()
+    cw.io.run(cw.gcs.conn.call(
+        "set_job_quota", (str(job_hex), float(weight), float(floor))))

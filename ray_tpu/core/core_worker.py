@@ -2206,7 +2206,8 @@ class CoreWorker:
                 self.lease_rpcs_sent += 1
                 res = await conn.call("request_lease",
                                       (demand, allow_spill, strategy,
-                                       count, spill_hop),
+                                       count, spill_hop,
+                                       self.job_id.hex()),
                                       timeout=_TASK_PUSH_TIMEOUT)
             except (ConnectionLost, RpcError, OSError):
                 if nm_addr.key() == self.node_address.key():

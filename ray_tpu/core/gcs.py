@@ -165,6 +165,24 @@ class GcsServer:
         self.metrics_store = MetricsStore(
             retention_s=cfg.metrics_retention_s,
             resolution_s=cfg.metrics_resolution_s)
+        # placement plane: topology-aware global placer + ordered gang
+        # admission + per-job fair-share quotas (core/placement.py),
+        # wired into the live stores it scores from — the resource view,
+        # the event manager's queue/usage traces (PR 11), and the dag
+        # manager's measured per-edge bytes (PR 9)
+        from ray_tpu.core.placement import PlacementPlane
+
+        self.placement_plane = PlacementPlane(
+            views_fn=lambda: {nid.hex(): self._node_view_entry(nid)
+                              for nid in self.nodes},
+            pending_fn=lambda h:
+                self.event_manager.node_sched(h)["pending"],
+            shape_stats_fn=self.event_manager.shape_stats,
+            job_usage_fn=self.event_manager.job_usage,
+            active_jobs_fn=lambda: [
+                j.hex() for j, m in self.jobs.items()
+                if m.get("status") == "RUNNING"],
+            dag_stats_fn=self.dag_manager.raw)
         # channel -> set of subscribed connections
         self.subscribers: dict[str, set[Connection]] = {}
         self.server.add_service(self)
@@ -236,6 +254,7 @@ class GcsServer:
             "jobs": self.jobs,
             "placement_groups": self.placement_groups,
             "draining": self.draining,
+            "quotas": self.placement_plane.quotas.snapshot(),
             "dedup_results": {c: dict(t)
                               for c, t in self._dedup_results.items()},
         }, pending_blobs)
@@ -289,6 +308,7 @@ class GcsServer:
         self.jobs = state.get("jobs", {})
         self.placement_groups = state.get("placement_groups", {})
         self.draining = state.get("draining", {})
+        self.placement_plane.quotas.restore(state.get("quotas", {}))
         from collections import OrderedDict
         saved = state.get("dedup_results", {})
         self._dedup_results = OrderedDict()
@@ -773,17 +793,22 @@ class GcsServer:
     def rpc_get_cluster_resources_delta(self, conn, since: int):
         """Entries changed in (since, current]; falls back to a full
         view when `since` predates the change log's horizon (fresh
-        consumer, log overflow, or GCS restart)."""
+        consumer, log overflow, or GCS restart). Every reply also
+        carries the quota view (shares + live usage) so node managers
+        enforce fair shares on the same sync cadence — empty dict when
+        no job has a quota, so the common case costs nothing."""
         v = self.resource_version
+        quota = self.placement_plane.quota_view() \
+            if self.placement_plane.quotas.quotas else {}
         if since == v:
             return {"version": v, "full": None, "changed": {},
-                    "removed": []}
+                    "removed": [], "quota": quota}
         oldest = self._resource_log[0][0] if self._resource_log else v + 1
         if since > v or since < oldest - 1:
             # version from a previous GCS incarnation, or horizon lost
             return {"version": v,
                     "full": self.rpc_get_cluster_resources(conn),
-                    "changed": {}, "removed": []}
+                    "changed": {}, "removed": [], "quota": quota}
         changed_ids = {nid for ver, nid in self._resource_log
                        if ver > since}
         changed, removed = {}, []
@@ -793,7 +818,7 @@ class GcsServer:
             else:
                 removed.append(nid.hex())
         return {"version": v, "full": None, "changed": changed,
-                "removed": removed}
+                "removed": removed, "quota": quota}
 
     def rpc_get_all_nodes(self, conn, arg=None):
         return list(self.nodes.values())
@@ -1024,6 +1049,9 @@ class GcsServer:
             self.jobs[job_id]["status"] = "FINISHED"
             self.jobs[job_id]["end_time"] = now()
             self.mark_dirty()
+        # the finished job's fair-share quota dies with it (its hex is
+        # never reused; a stale entry would dilute live jobs' shares)
+        self.placement_plane.quotas.set_quota(job_id.hex(), 0.0, 0.0)
         # the exiting driver owns the job's objects: drop their records
         self.object_manager.on_job_finished(job_id.hex())
         # ...and its event-log entries (purge FIRST so the finish event
@@ -1350,88 +1378,67 @@ class GcsServer:
         return placement
 
     async def _schedule_pg(self, pg_id, bundles, strategy, exclude=None):
-        """exclude: a node to avoid even if schedulable (the node being
-        drained — its label may not have propagated to every view yet).
-        Draining nodes never receive new bundles (same contract as the
-        lease/actor path in scheduling_policy)."""
-        alive = [(nid, info) for nid, info in self.nodes.items()
-                 if info.alive and nid != exclude
-                 and not (info.labels or {}).get("draining")]
-        if not alive:
-            return None
-        placement: list[NodeID] = []
-        tentative: dict[NodeID, dict[str, float]] = {
-            nid: dict(self.node_resources_available.get(nid, {}))
-            for nid, _ in alive}
+        """Gang placement through the placement plane: the measured-cost
+        placer decides (SLICE_PACK keeps the gang inside one ICI slice;
+        scheduling_policy.node_schedulable filters dead/draining/label
+        mismatches), then the two-phase prepare/commit reserves — the
+        WHOLE sequence inside one ordered-admission window, so two
+        concurrent gangs at partial capacity never interleave partial
+        prepares: one completes, the other backs off whole.
 
-        def fits(nid, demand):
-            avail = tentative[nid]
-            return all(avail.get(r, 0) >= amt for r, amt in demand.items())
-
-        def take(nid, demand):
-            for r, amt in demand.items():
-                tentative[nid][r] = tentative[nid].get(r, 0) - amt
-
-        node_ids = [nid for nid, _ in alive]
-        if strategy in ("STRICT_PACK", "PACK"):
-            order = node_ids
-            for demand in bundles:
-                placed = False
-                # PACK prefers reusing nodes already used
-                for nid in sorted(order, key=lambda n: -placement.count(n)):
-                    if fits(nid, demand):
-                        take(nid, demand)
-                        placement.append(nid)
-                        placed = True
-                        break
-                if not placed:
-                    return None
-            if strategy == "STRICT_PACK" and len(set(placement)) > 1:
+        exclude: a node to avoid even if schedulable (the node being
+        drained — its label may not have propagated to every view yet)."""
+        views, by_hex = {}, {}
+        for nid, info in self.nodes.items():
+            if nid == exclude:
+                continue
+            h = nid.hex()
+            by_hex[h] = nid
+            views[h] = self._node_view_entry(nid)
+        gang = pg_id.hex()
+        async with self.placement_plane.admission.admit(gang):
+            hexes = self.placement_plane.place_bundles(
+                bundles, strategy, views)
+            if hexes is None:
+                self.placement_plane.admission.note_backoff(gang)
                 return None
-        else:  # SPREAD / STRICT_SPREAD
-            for i, demand in enumerate(bundles):
-                candidates = sorted(
-                    node_ids, key=lambda n: placement.count(n))
-                placed = False
-                for nid in candidates:
-                    if strategy == "STRICT_SPREAD" and nid in placement:
-                        continue
-                    if fits(nid, demand):
-                        take(nid, demand)
-                        placement.append(nid)
-                        placed = True
-                        break
-                if not placed:
-                    return None
-        # 2-phase: prepare on each node, commit if all succeed.
-        prepared: list[tuple[NodeID, int]] = []
-        ok = True
-        for i, nid in enumerate(placement):
-            conn2 = self.node_conns.get(nid)
-            if conn2 is None:
-                ok = False
-                break
-            try:
-                good = await conn2.call(
-                    "pg_prepare", (pg_id, i, bundles[i]), timeout=10)
-            except Exception:
-                good = False
-            if not good:
-                ok = False
-                break
-            prepared.append((nid, i))
-        if not ok:
-            for nid, i in prepared:
+            placement = [by_hex[h] for h in hexes]
+            # 2-phase: prepare on each node, commit if all succeed.
+            prepared: list[tuple[NodeID, int]] = []
+            ok = True
+            for i, nid in enumerate(placement):
                 conn2 = self.node_conns.get(nid)
-                if conn2 is not None:
-                    try:
-                        await conn2.call("pg_return", (pg_id, i), timeout=10)
-                    except Exception:
-                        pass
-            return None
-        for nid, i in prepared:
-            await self.node_conns[nid].call("pg_commit", (pg_id, i), timeout=10)
-        return placement
+                if conn2 is None:
+                    ok = False
+                    break
+                try:
+                    good = await conn2.call(
+                        "pg_prepare", (pg_id, i, bundles[i]), timeout=10)
+                except Exception:
+                    good = False
+                if not good:
+                    ok = False
+                    break
+                prepared.append((nid, i))
+            if not ok:
+                # back off WHOLE: every prepared bundle is returned
+                # before the admission window closes, so the next gang
+                # in line sees no partial reservation
+                for nid, i in prepared:
+                    conn2 = self.node_conns.get(nid)
+                    if conn2 is not None:
+                        try:
+                            await conn2.call("pg_return", (pg_id, i),
+                                             timeout=10)
+                        except Exception:
+                            pass
+                self.placement_plane.admission.note_backoff(gang)
+                return None
+            for nid, i in prepared:
+                await self.node_conns[nid].call("pg_commit", (pg_id, i),
+                                                timeout=10)
+            self.placement_plane.admission.note_placed(gang)
+            return placement
 
     async def _reschedule_pg(self, pg_id,
                              exclude: NodeID | None = None) -> bool:
@@ -1525,6 +1532,59 @@ class GcsServer:
 
     def rpc_get_placement_group(self, conn, pg_id):
         return self.placement_groups.get(pg_id)
+
+    # ------------------------------------------------------ placement plane
+    def rpc_place_gang(self, conn, arg):
+        """Advisory gang placement: (demands, strategy) -> a node hex
+        per demand, or None when the gang doesn't fit whole RIGHT NOW.
+        Pure decision — nothing is reserved; callers that need a real
+        reservation go through create_placement_group (which routes the
+        same placer inside the ordered admission window). RL/train use
+        this for soft co-location of worker fleets."""
+        demands, strategy = arg
+        return self.placement_plane.place_bundles(
+            [dict(d) for d in demands], strategy or "SLICE_PACK")
+
+    def rpc_placement_advise_dag(self, conn, arg):
+        """Compile-time consult from ChannelCompiledDAG: given the DAG's
+        per-actor demands and its edges' current endpoint nodes, report
+        where the plane would put the gang (SLICE_PACK) and how many
+        edges the CURRENT placement co-locates — weighted by measured
+        per-edge bytes when dag_id names a known ring (recovery
+        recompile)."""
+        a = dict(arg or {})
+        return self.placement_plane.advise_dag(
+            demands=[dict(d) for d in a.get("demands") or ()],
+            edge_nodes=[tuple(e) for e in a.get("edge_nodes") or ()],
+            dag_id=str(a.get("dag_id") or ""))
+
+    def rpc_set_job_quota(self, conn, arg):
+        """(job_hex, weight, floor) — opt a job into fair-share
+        enforcement (weight<=0 and floor<=0 removes the quota). The
+        updated view ships to every node manager on its next heartbeat
+        sync; enforcement is node-side in the lease path."""
+        job_hex, weight, floor = arg
+        self.placement_plane.quotas.set_quota(
+            str(job_hex), float(weight), float(floor))
+        self.mark_dirty()
+        self.record_event(
+            source="gcs", kind="job_quota_set",
+            message=(f"job {str(job_hex)[:12]} quota set: "
+                     f"weight={float(weight):g} floor={float(floor):g} "
+                     f"{self.placement_plane.quotas.resource}"),
+            job_id=str(job_hex), weight=float(weight),
+            floor=float(floor))
+        return True
+
+    def rpc_placement_state(self, conn, arg=None):
+        """`rayt status` / dashboard surface for the placement plane:
+        topology map (slice/locality -> nodes), quota ledger with live
+        usage, gang-admission counters, cumulative per-job throttle
+        verdicts."""
+        st = self.placement_plane.state()
+        st["quota_throttled"] = \
+            self.event_manager.quota_throttled_totals()
+        return st
 
     # -------------------------------------------------------- task events
     def _record_task_transition(self, spec: TaskSpec, state: str,
@@ -1717,6 +1777,17 @@ class GcsServer:
             }
         out["nodes"] = node_views
         out["trace"] = self.event_manager.shape_stats(sk)
+        # fair-share check: a quota'd job past its share parks in the
+        # node-side lease queue even when nodes have room — a DISTINCT
+        # verdict from feasible_but_busy (waiting on its own share to
+        # free, not on other work to finish)
+        jq = self.placement_plane.quota_view().get(rec["job_id"])
+        over_share = (
+            jq is not None and
+            jq["used"] + demand.get(jq["resource"], 0.0)
+            > jq["share"] + 1e-9)
+        if jq is not None:
+            out["quota"] = jq
         if not fit_ever:
             missing = {r: {"need": demand[r],
                            "cluster_max": short[r]}
@@ -1730,6 +1801,15 @@ class GcsServer:
                 + ", ".join(f"{r} (need {v['need']:g}, largest node "
                             f"has {v['cluster_max']:g})"
                             for r, v in missing.items()))
+        elif over_share:
+            out["verdict"] = "quota_throttled"
+            out["explanation"] = (
+                f"QUOTA THROTTLED: job {rec['job_id'][:12]} holds "
+                f"{jq['used']:g} {jq['resource']} of a "
+                f"{jq['share']:g} fair share "
+                f"(weight {jq['weight']:g}, floor {jq['floor']:g}); "
+                f"{sk} waits behind under-share tenants until the "
+                f"job's own leases return — not a capacity problem")
         elif not fit_now:
             depth = sum(v["pending_leases"]
                         for h, v in node_views.items() if h in fit_ever)
@@ -1901,6 +1981,10 @@ class GcsServer:
                 for pg_id, pg in self.placement_groups.items()],
             "drains": {nid.hex(): dict(rec)
                        for nid, rec in self.draining.items()},
+            # fair-share ledger (empty when no job opted into quotas)
+            "quotas": self.placement_plane.quota_view(),
+            "quota_throttled":
+                self.event_manager.quota_throttled_totals(),
         }
         # monitor-in-head: head_main attaches the autoscaler so `rayt
         # status` can show the instance lifecycle (ref: `ray status`
@@ -1999,6 +2083,10 @@ class GcsClient:
         "list_cluster_events", "summarize_scheduling", "why_pending",
         "get_pending_demand", "cluster_status", "heartbeat", "subscribe",
         "get_drain_status",
+        # placement plane reads: advisory placement decisions reserve
+        # nothing, so replaying across a GCS restart is harmless
+        "place_gang", "placement_advise_dag", "placement_state",
+        "get_cluster_resources_delta",
         # periodic overwrite-style reports: replaying is harmless, and
         # routing them through the dedup envelope would churn the LRU
         "report_task_demand", "add_task_events",

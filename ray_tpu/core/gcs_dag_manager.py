@@ -101,6 +101,9 @@ class GcsDagManager:
                 "kind": e.get("kind", "shm"),
                 # shm|dcn beneath a device edge (same as kind otherwise)
                 "transport": e.get("transport", e.get("kind", "shm")),
+                # the kind this edge WANTS (co-located device/shm; see
+                # core/placement.py preferred_kind_summary)
+                "preferred": e.get("preferred", ""),
                 "channel": e.get("channel", ""),
                 "n_slots": int(e.get("n_slots", 0)),
                 "slot_size": int(e.get("slot_size", 0)),
@@ -133,9 +136,19 @@ class GcsDagManager:
             # ring and recovered_from names the dag_id it replaced
             "epoch": int(report.get("epoch", 0)),
             "recovered_from": report.get("recovered_from", ""),
+            # placement quality at compile time: fraction of edges on
+            # their preferred (co-located) channel kind
+            "preferred_kind_ratio": report.get("preferred_kind_ratio"),
             "edges": edges,
         }
         self._by_job.setdefault(job, {})[dag_id] = None
+        ratio = report.get("preferred_kind_ratio")
+        if ratio is not None:
+            from ray_tpu.util.builtin_metrics import \
+                dag_preferred_kind_record
+
+            self._metric_records.append(dag_preferred_kind_record(
+                dag_id, float(ratio), ts=ts))
         self._maybe_evict()
 
     def _ingest_report(self, report: dict):
@@ -376,6 +389,7 @@ class GcsDagManager:
             "channel_kinds": dict(rec["channel_kinds"]),
             "epoch": rec.get("epoch", 0),
             "recovered_from": rec.get("recovered_from", ""),
+            "preferred_kind_ratio": rec.get("preferred_kind_ratio"),
             "num_edges": len(rec["edges"]),
             "ticks": ticks,
             "bytes": sum(e["bytes"] for e in rec["edges"].values()),
@@ -461,3 +475,9 @@ class GcsDagManager:
 
     def num_dags(self) -> int:
         return len(self._dags)
+
+    def raw(self, dag_id: str) -> Optional[dict]:
+        """Internal record by exact dag id — the placement plane's
+        measured-edge-bytes input (core/placement.py advise_dag); stays
+        a reference, callers must not mutate."""
+        return self._dags.get(dag_id)

@@ -143,6 +143,13 @@ class GcsEventManager:
         self._shapes: dict[str, dict] = {}
         # node hex -> {"pending": n, "pending_shapes": {...}, "ts": s}
         self._node_sched: dict[str, dict] = {}
+        # node hex -> {job_hex: {res: amt}} — each node's ABSOLUTE leased
+        # usage by job, shipped with its sched report; the cluster-wide
+        # aggregate feeds the placement plane's quota accounting
+        self._node_job_usage: dict[str, dict] = {}
+        # cumulative per-job quota-throttle verdicts (deltas ingested
+        # from sched reports, like the shape counters)
+        self._quota_throttled: collections.Counter = collections.Counter()
         self._reports_ingested = 0
         # metric records derived from sched-report deltas, drained by
         # the GCS publish handler into the metrics store (this process
@@ -216,6 +223,9 @@ class GcsEventManager:
         manager purge contract."""
         for eid in self._by_job.pop(job_hex, ()):
             self._events.pop(eid, None)
+        self._quota_throttled.pop(job_hex, None)
+        for usage in self._node_job_usage.values():
+            usage.pop(job_hex, None)
 
     # ------------------------------------------------------------ queries
     def _iter_filtered(self, job_id=None, node_id=None, severity=None,
@@ -290,6 +300,18 @@ class GcsEventManager:
                 for k, v in (report.get("pending_shapes") or {}).items()},
             "ts": ts,
         }
+        if report.get("job_usage") is not None:
+            usage = {str(j): {r: float(a) for r, a in (u or {}).items()}
+                     for j, u in report["job_usage"].items()}
+            if usage:
+                self._node_job_usage[node] = usage
+            else:
+                self._node_job_usage.pop(node, None)
+        throttled = {str(j): max(0, int(n)) for j, n in
+                     (report.get("quota_throttled") or {}).items()
+                     if int(n) > 0}
+        for j, n in throttled.items():
+            self._quota_throttled[j] += n
         d_spill = d_infeas = 0
         d_qwait = 0.0
         for sk, d in (report.get("decisions") or {}).items():
@@ -319,12 +341,16 @@ class GcsEventManager:
             d_spill += max(0, int(d.get("spillback", 0)))
             d_infeas += max(0, int(d.get("infeasible", 0)))
             d_qwait += max(0.0, float(d.get("queue_wait_s", 0.0)))
-        from ray_tpu.util.builtin_metrics import sched_metric_records
+        from ray_tpu.util.builtin_metrics import (quota_throttled_records,
+                                                  sched_metric_records)
 
         self._metric_records.extend(sched_metric_records(
             node, spillbacks=d_spill, infeasible=d_infeas,
             queue_wait_s=d_qwait,
             pending=self._node_sched[node]["pending"], ts=ts))
+        if throttled:
+            self._metric_records.extend(
+                quota_throttled_records(node, throttled, ts=ts))
 
     def drain_metric_records(self) -> list[dict]:
         out, self._metric_records = self._metric_records, []
@@ -339,6 +365,23 @@ class GcsEventManager:
         by the node itself: purge it so `rayt status` / the autoscaler
         don't read phantom demand forever."""
         self._node_sched.pop(node_hex, None)
+        self._node_job_usage.pop(node_hex, None)
+
+    def job_usage(self) -> dict:
+        """Cluster-wide leased usage by job: {job_hex: {res: amt}},
+        summed over the nodes' absolute per-report ledgers. This is the
+        quota plane's 'used' input (core/placement.py)."""
+        out: dict[str, dict[str, float]] = {}
+        for usage in self._node_job_usage.values():
+            for j, res in usage.items():
+                agg = out.setdefault(j, {})
+                for r, amt in res.items():
+                    agg[r] = agg.get(r, 0.0) + amt
+        return out
+
+    def quota_throttled_totals(self) -> dict:
+        """Cumulative quota-throttle verdicts per job hex."""
+        return dict(self._quota_throttled)
 
     def pending_demand(self) -> dict:
         """Cluster-wide aggregate pending lease demand by shape:
@@ -394,5 +437,7 @@ class GcsEventManager:
             "pending_total": sum(st.get("pending", 0)
                                  for st in self._node_sched.values()),
             "totals": totals,
+            "quota_throttled": dict(self._quota_throttled),
+            "job_usage": self.job_usage(),
             "reports_ingested": self._reports_ingested,
         }

@@ -45,6 +45,8 @@ class _Worker:
         self.busy = False
         self.actor_id: ActorID | None = None
         self.lease_resources: dict[str, float] | None = None
+        # job hex the current lease is charged to (fair-share ledger)
+        self.lease_job: str = ""
         self.last_idle = time.monotonic()
         # set by the memory monitor before it terminates the worker:
         # (mem_fraction, rss_bytes) — the reap path turns it into a
@@ -235,7 +237,15 @@ class NodeManager:
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.gcs_address = gcs_address
-        self.labels = labels or {}
+        # explicit labels win; topology labels (ici-slice from the
+        # slice-head custom resource or RAYT_ICI_SLICE, dcn-locality
+        # from RAYT_DCN_LOCALITY) fill the gaps so every node advertises
+        # its position to the placement plane (core/placement.py)
+        from ray_tpu.core.placement import topology_labels
+
+        self.labels = dict(labels or {})
+        for k, v in topology_labels(self.resources_total).items():
+            self.labels.setdefault(k, v)
         self.server = RpcServer()
         self.server.add_service(self)
         self.address: Address | None = None
@@ -252,7 +262,16 @@ class NodeManager:
         self._spill_count = 0
         self._restore_count = 0
         self._oom_kills = 0
-        self._pending_leases: list[tuple[dict, asyncio.Future]] = []
+        # (demand, future, job_hex) — job_hex "" when the caller
+        # predates the quota-aware lease wire format
+        self._pending_leases: list[
+            tuple[dict, asyncio.Future, str]] = []
+        # fair-share quota view synced from the GCS with the resource
+        # view: {job_hex: {"resource","share","used","weight","floor"}}
+        self._quota_view: dict[str, dict] = {}
+        # per-job quota-throttle verdict deltas since the last
+        # successful sched-report publish
+        self._quota_throttled_deltas: dict[str, int] = {}
         self._pg_reserved: dict[tuple, dict[str, float]] = {}
         self._pg_prepared: dict[tuple, dict[str, float]] = {}
         self._cluster_view: dict = {}
@@ -621,7 +640,7 @@ class NodeManager:
             return
         pending_shapes: dict[str, dict] = {}
         n_pending = 0
-        for demand, fut in self._pending_leases:
+        for demand, fut, _job in self._pending_leases:
             if fut.done():
                 continue
             n_pending += 1
@@ -629,17 +648,28 @@ class NodeManager:
             entry = pending_shapes.setdefault(
                 sk, {"count": 0, "demand": dict(demand)})
             entry["count"] += 1
-        pend = {"pending": n_pending, "pending_shapes": pending_shapes}
+        # absolute per-job leased usage on this node (base resource
+        # keys — PG-scoped keys fold back so quota math sees CPU, not
+        # CPU_pg_<hex>_<i>); the GCS event manager aggregates these
+        # node ledgers into the quota plane's cluster-wide "used"
+        pend = {"pending": n_pending, "pending_shapes": pending_shapes,
+                "job_usage": self._job_usage_ledger()}
         if not self._sched_dirty \
                 and pend == self._sched_pending_published:
             return
         decisions, self._sched_decisions = self._sched_decisions, {}
+        throttled = self._quota_throttled_deltas
+        self._quota_throttled_deltas = {}
         self._sched_dirty = False
         msg = {"type": "sched_report", "node": self.node_id.hex(),
-               "ts": time.time(), "decisions": decisions, **pend}
+               "ts": time.time(), "decisions": decisions,
+               "quota_throttled": throttled, **pend}
         try:
             await self.gcs_conn.call("publish", (CH_EVENTS, msg))
         except Exception:
+            for j, n in throttled.items():
+                self._quota_throttled_deltas[j] = \
+                    self._quota_throttled_deltas.get(j, 0) + n
             # deltas not delivered: merge back and retry next tick
             for sk, d in decisions.items():
                 cur = self._sched_decisions.get(sk)
@@ -663,6 +693,9 @@ class NodeManager:
     async def _refresh_view(self):
         resp = await self.gcs_conn.call("get_cluster_resources_delta",
                                         self._view_version)
+        # quota view rides every delta reply (empty when no job has a
+        # quota) — fair-share enforcement tracks the same sync cadence
+        self._quota_view = resp.get("quota") or {}
         if resp["full"] is not None:
             self._cluster_view = resp["full"]
         else:
@@ -934,6 +967,54 @@ class NodeManager:
         return all(self.resources_total.get(r, 0.0) >= amt - 1e-9
                    for r, amt in demand.items())
 
+    def _job_usage_ledger(self) -> dict[str, dict[str, float]]:
+        """Absolute per-job leased usage on this node, derived from the
+        live worker table (no incremental bookkeeping to drift): every
+        busy worker's lease is charged to its job, PG-scoped resource
+        keys folded back to their base resource."""
+        usage: dict[str, dict[str, float]] = {}
+        for w in self.workers.values():
+            if not (w.busy and w.lease_resources and w.lease_job):
+                continue
+            agg = usage.setdefault(w.lease_job, {})
+            for r, amt in w.lease_resources.items():
+                base = r.split("_pg_", 1)[0]
+                agg[base] = round(agg.get(base, 0.0) + amt, 4)
+        return usage
+
+    def _quota_over_share(self, job_hex: str,
+                          demand: dict[str, float]) -> bool:
+        """Would granting `demand` put this job past its fair share?
+        Only jobs with an entry in the synced quota view are governed.
+        Cluster-wide usage comes from the view (sync-cadence fresh);
+        this node's LIVE ledger wins when larger — local grants since
+        the last report must count against the share immediately, or a
+        tight grant loop overshoots by a full sync period."""
+        if not job_hex or not self._quota_view:
+            return False
+        q = self._quota_view.get(job_hex)
+        if q is None:
+            return False
+        res = q.get("resource", "CPU")
+        need = demand.get(res, 0.0)
+        if need <= 0:
+            return False
+        local = self._job_usage_ledger().get(job_hex, {}).get(res, 0.0)
+        used = max(float(q.get("used", 0.0)), local)
+        return used + need > float(q.get("share", 0.0)) + 1e-9
+
+    def _quota_throttled(self, job_hex: str,
+                         demand: dict[str, float]) -> bool:
+        """Park this request behind the job's share? Work-conserving:
+        an over-share job still gets idle capacity — it throttles only
+        while some OTHER job's lease is waiting here (the contended
+        case where bursting past the share means starving a tenant
+        that's under its floor)."""
+        if not self._quota_over_share(job_hex, demand):
+            return False
+        return any(j != job_hex for _d, f, j in self._pending_leases
+                   if not f.done())
+
     def _draining_self(self) -> bool:
         """Whether the GCS has marked THIS node draining, read from the
         synced cluster view (the label is GCS-applied; the sync cadence
@@ -991,8 +1072,16 @@ class NodeManager:
         queue-wait, hop, candidate views) shipped on the heartbeat
         cadence — see _record_decision / gcs_event_manager.py.
         """
-        count, batched, hop = 1, False, 0
-        if len(arg) == 5:
+        count, batched, hop, job_hex = 1, False, 0, ""
+        if len(arg) == 6:
+            # quota-aware form: the caller's job id rides along so the
+            # grant is charged to the right fair-share ledger
+            demand, allow_spill, strategy, count, hop, job_hex = arg
+            batched = True
+            count = max(1, int(count))
+            hop = max(0, int(hop))
+            job_hex = str(job_hex or "")
+        elif len(arg) == 5:
             demand, allow_spill, strategy, count, hop = arg
             batched = True
             count = max(1, int(count))
@@ -1009,7 +1098,7 @@ class NodeManager:
         try:
             res = await self._request_lease(
                 conn, demand, allow_spill, strategy, count, batched,
-                hop, trace)
+                hop, trace, job_hex)
         except asyncio.CancelledError:
             self._record_decision(demand, strategy, "cancelled",
                                   reason="lease handler cancelled",
@@ -1022,7 +1111,7 @@ class NodeManager:
         return res
 
     async def _request_lease(self, conn, demand, allow_spill, strategy,
-                             count, batched, hop, trace):
+                             count, batched, hop, trace, job_hex=""):
         from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
                                          NodeLabelSchedulingStrategy)
 
@@ -1116,8 +1205,23 @@ class NodeManager:
                     return spill(target)
             return infeasible(
                 f"node cannot ever satisfy {demand} (total={self.resources_total})")
-        if not self._try_acquire(demand):
-            if allow_spill:
+        # fair-share gate BEFORE the acquire: an over-share job with a
+        # contending tenant parks even when resources are free right
+        # now. It does NOT spill — the quota view is cluster-global, so
+        # a peer node would reach the same verdict and the request
+        # would just ping-pong.
+        throttled = self._quota_throttled(job_hex, demand)
+        if throttled:
+            self._quota_throttled_deltas[job_hex] = \
+                self._quota_throttled_deltas.get(job_hex, 0) + 1
+            self._sched_dirty = True
+            q = self._quota_view.get(job_hex, {})
+            trace["reason"] = (
+                f"quota_throttled: job {job_hex[:12]} at "
+                f"{q.get('used', 0):g}/{q.get('share', 0):g} "
+                f"{q.get('resource', 'CPU')} fair share")
+        if throttled or not self._try_acquire(demand):
+            if allow_spill and not throttled:
                 target = await self._pick_spillback_fresh(demand, strategy)
                 if target is not None:
                     return spill(target)
@@ -1128,7 +1232,7 @@ class NodeManager:
             # grant whose reply can't be delivered would leak the
             # worker + resources forever.
             fut = asyncio.get_running_loop().create_future()
-            self._pending_leases.append((demand, fut))
+            self._pending_leases.append((demand, fut, job_hex))
             trace["candidates"] = self._candidate_views(demand)
             t_park = time.monotonic()
 
@@ -1149,8 +1253,8 @@ class NodeManager:
                 # still parked: _maybe_grant_pending drops done futures,
                 # but sweep explicitly so the slot releases NOW
                 self._pending_leases = [
-                    (d, f) for d, f in self._pending_leases
-                    if f is not fut]
+                    e for e in self._pending_leases
+                    if e[1] is not fut]
                 trace["reason"] = "caller gone while queued"
                 return ("cancelled", trace["reason"])
             if conn.closed:
@@ -1173,6 +1277,7 @@ class NodeManager:
                 return infeasible(f"worker startup failed: {e}")
             w.busy = True
             w.lease_resources = dict(demand)
+            w.lease_job = job_hex
             granted.append((w.info, w.info.worker_id.hex()))
             # grant further batch members only while resources are
             # immediately acquirable — never queue mid-batch (the first
@@ -1192,18 +1297,35 @@ class NodeManager:
         if w.lease_resources:
             self._release_resources(w.lease_resources)
             w.lease_resources = None
+        w.lease_job = ""
         w.busy = False
         w.last_idle = time.monotonic()
         self._maybe_grant_pending()
         return True
 
     def _maybe_grant_pending(self):
-        still = []
-        for demand, fut in self._pending_leases:
-            if not fut.done() and self._try_acquire(demand):
+        """Two-pass FIFO grant: under-share (and unquota'd) waiters
+        first; over-share waiters take what's left ONLY when no one
+        else is still waiting — the fair-share ordering that lets a
+        serve tenant reclaim its floor from a bursting shuffle job as
+        leases churn. Over-share leftovers requeue behind the rest."""
+        still, deferred = [], []
+        for entry in self._pending_leases:
+            demand, fut, job = entry
+            if fut.done():
+                continue
+            if self._quota_over_share(job, demand):
+                deferred.append(entry)
+            elif self._try_acquire(demand):
                 fut.set_result(True)
-            elif not fut.done():
-                still.append((demand, fut))
+            else:
+                still.append(entry)
+        for entry in deferred:
+            demand, fut, job = entry
+            if not still and self._try_acquire(demand):
+                fut.set_result(True)
+            else:
+                still.append(entry)
         self._pending_leases = still
 
     # --------------------------------------------------------------- actors
@@ -1248,6 +1370,7 @@ class NodeManager:
         w.busy = True
         w.actor_id = spec.actor_id
         w.lease_resources = dict(demand)
+        w.lease_job = spec.job_id.hex() if spec.job_id else ""
         logger.info("start_actor %s: pushing create to worker pid=%s",
                     spec.actor_id, w.proc.pid)
         try:

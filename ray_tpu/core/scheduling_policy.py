@@ -25,18 +25,39 @@ TOP_K = 3
 SPREAD_THRESHOLD = 0.5
 
 
-def feasible(view: dict, demand: dict[str, float]) -> bool:
-    if not view.get("alive") or view.get("labels", {}).get("draining"):
+def node_schedulable(view: dict,
+                     topology: dict[str, str] | None = None) -> bool:
+    """THE shared liveness/label filter every policy (and the placement
+    plane) routes through: a node takes new work only if it is alive and
+    not draining, and — when a topology constraint is given — its
+    topology labels (``ici-slice`` / ``dcn-locality``, advertised by the
+    node manager; see core/placement.py) match exactly."""
+    if not view.get("alive"):
+        return False
+    labels = view.get("labels") or {}
+    if labels.get("draining"):
+        return False
+    if topology:
+        for k, v in topology.items():
+            if labels.get(k) != v:
+                return False
+    return True
+
+
+def feasible(view: dict, demand: dict[str, float],
+             topology: dict[str, str] | None = None) -> bool:
+    if not node_schedulable(view, topology):
         return False
     avail = view.get("available", {})
     return all(avail.get(r, 0.0) >= amt - 1e-9 for r, amt in demand.items())
 
 
-def capacity_feasible(view: dict, demand: dict[str, float]) -> bool:
+def capacity_feasible(view: dict, demand: dict[str, float],
+                      topology: dict[str, str] | None = None) -> bool:
     """Could this node EVER run the demand (total capacity, ignoring
     current usage)? Used to route constrained tasks to a busy-but-matching
     node's lease queue instead of declaring them infeasible."""
-    if not view.get("alive") or view.get("labels", {}).get("draining"):
+    if not node_schedulable(view, topology):
         return False
     total = view.get("total", {})
     return all(total.get(r, 0.0) >= amt - 1e-9 for r, amt in demand.items())

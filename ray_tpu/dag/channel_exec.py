@@ -558,6 +558,10 @@ class ChannelCompiledDAG:
         self.dag_id = uuid.uuid4().hex[:16]
         my_node = self._cw.node_id
         placement = self._actor_placement(compute)   # id(actor) -> node_id
+        # kept for the register report: per-edge endpoint nodes + the
+        # compile-time placement-plane consult (core/placement.py)
+        self._node_of = placement
+        self._my_node = my_node
 
         # ---- plan per-actor schedules + channels -------------------------
         # Channels are PLANNED first (schedules hold plan indices) and
@@ -740,6 +744,13 @@ class ChannelCompiledDAG:
             "dcn": sum(p.kind == "dcn" and not p.device for p in plans),
             "device": sum(p.device for p in plans),
         }
+        # placement-quality metric (core/placement.py): fraction of
+        # edges whose compiled transport avoided the DCN fallback
+        from ray_tpu.core.placement import preferred_kind_summary
+        _pk = preferred_kind_summary(
+            [{"transport": p.kind, "device": p.device} for p in plans])
+        self.preferred_kind_ratio = _pk["ratio"]
+        self._preferred_kinds = _pk["preferred"]
 
         # schedules now carry real specs instead of plan indices
         for sched in scheds.values():
@@ -786,6 +797,30 @@ class ChannelCompiledDAG:
         # exist before the first report/stall can reference an edge
         report_state = bool(self._cfg.dag_state_enabled)
         self._register_dag(plans, plan_ends, actors, report_state)
+
+        # best-effort compile-time consult of the GCS placement plane:
+        # records where the plane would have packed this gang and how
+        # many edges the CURRENT placement co-locates (`rayt dag <id>`
+        # and the envelope bench read it; compile never blocks on it)
+        self.plane_advice = None
+        try:
+            n_actors = len({k for pair in plan_ends for k in pair
+                            if k is not None})
+            edge_nodes = [
+                (self._my_node if prod is None
+                 else self._node_of.get(prod, ""),
+                 self._my_node if cons is None
+                 else self._node_of.get(cons, ""))
+                for prod, cons in plan_ends]
+            self.plane_advice = self._cw.io.run(
+                self._cw.gcs.call("placement_advise_dag", {
+                    "demands": [{"CPU": 1.0}] * n_actors,
+                    "edge_nodes": edge_nodes,
+                    "dag_id": self.dag_id}),
+                timeout=5.0)
+        except Exception:
+            logger.debug("dag %s placement-plane consult failed",
+                         self.dag_id, exc_info=True)
 
         # ---- launch the actor loops ------------------------------------
         self._loop_refs = []
@@ -887,13 +922,19 @@ class ChannelCompiledDAG:
         if not enabled:
             return
 
+        def node_of(key):
+            return self._my_node if key is None else \
+                self._node_of.get(key, "")
+
         def endpoint(key):
             if key is None:
-                return {"actor": "", "label": "driver"}
+                return {"actor": "", "label": "driver",
+                        "node": self._my_node}
             h = actors[key]
             hexid = h._actor_id.hex()
             cls = getattr(h, "_class_name", "") or "actor"
-            return {"actor": hexid, "label": f"{cls}:{hexid[:8]}"}
+            return {"actor": hexid, "label": f"{cls}:{hexid[:8]}",
+                    "node": node_of(key)}
 
         edges = []
         for i, (p, (prod, cons)) in enumerate(zip(plans, plan_ends)):
@@ -903,6 +944,7 @@ class ChannelCompiledDAG:
                 "edge": f"e{i}", "channel": _chan_key(p.spec),
                 "kind": "device" if p.device else p.kind,
                 "transport": p.kind,   # shm|dcn beneath a device edge
+                "preferred": self._preferred_kinds[i],
                 "n_slots": p.n_slots,
                 "slot_size": p.slot_size, "role": role,
                 "producer": endpoint(prod), "consumer": endpoint(cons),
@@ -912,6 +954,7 @@ class ChannelCompiledDAG:
                "driver": self._cw.worker_info.worker_id.hex(),
                "ts": time.time(), "edges": edges,
                "channel_kinds": dict(self.channel_kinds),
+               "preferred_kind_ratio": self.preferred_kind_ratio,
                "epoch": self.epoch,
                "recovered_from": self.recovered_from}
         try:

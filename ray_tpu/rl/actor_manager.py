@@ -13,6 +13,34 @@ import ray_tpu as rt
 logger = logging.getLogger("ray_tpu.rl")
 
 
+def gang_placement_options(n: int, resources: Optional[dict] = None,
+                           strategy: str = "SLICE_PACK") -> list[dict]:
+    """Best-effort soft co-location of an n-actor fleet through the GCS
+    placement plane: asks `place_gang` (advisory — nothing reserved)
+    where the gang fits whole, and returns one actor-options dict per
+    member carrying a SOFT NodeAffinity to its advised node. When the
+    plane can't fit the gang (or isn't reachable), returns empty dicts
+    and scheduling falls back to the per-lease local policies — fleets
+    must boot even on clusters that can't co-locate them."""
+    opts: list[dict] = [{} for _ in range(n)]
+    try:
+        nodes = rt.place_gang(
+            [dict(resources or {"CPU": 1.0}) for _ in range(n)],
+            strategy)
+    except Exception:
+        logger.debug("gang placement advise failed", exc_info=True)
+        return opts
+    if not nodes or len(nodes) != n:
+        return opts
+    from ray_tpu._internal.ids import NodeID
+    from ray_tpu.core.common import NodeAffinitySchedulingStrategy
+
+    for i, h in enumerate(nodes):
+        opts[i] = {"scheduling_strategy": NodeAffinitySchedulingStrategy(
+            NodeID(bytes.fromhex(h)), soft=True)}
+    return opts
+
+
 class FaultTolerantActorManager:
     def __init__(self, actors: list, *, probe_method: str = "ping"):
         self._actors = list(actors)

@@ -513,13 +513,20 @@ class IMPALA:
         self._runner_cls = runner_cls
         self._module_blob = module_blob
         self._spawned_runners = config.num_env_runners
+        # placement-plane consult: soft co-location of the runner fleet
+        # (one ICI slice when the cluster is labeled) keeps the compiled
+        # DAG's runner edges off the DCN fallback
+        from ray_tpu.rl.actor_manager import gang_placement_options
+
+        gang_opts = gang_placement_options(config.num_env_runners)
         runners = []
         wave = config.boot_wave or config.num_env_runners
         for lo in range(0, config.num_env_runners, wave):
             batch = [
-                runner_cls.remote(config.env, config.num_envs_per_runner,
-                                  config.seed + i, module_blob,
-                                  self._connector_blob)
+                runner_cls.options(**gang_opts[i]).remote(
+                    config.env, config.num_envs_per_runner,
+                    config.seed + i, module_blob,
+                    self._connector_blob)
                 for i in range(lo, min(lo + wave, config.num_env_runners))]
             if config.boot_wave:
                 # stagger fleet boot: each wave's workers finish importing

@@ -90,9 +90,15 @@ class PPO:
         self._runner_cls = runner_cls
         self._module_blob = module_blob
         self._spawned_runners = config.num_env_runners
+        # placement-plane consult: soft co-location of the runner fleet
+        # (see rl/actor_manager.gang_placement_options)
+        from ray_tpu.rl.actor_manager import gang_placement_options
+
+        gang_opts = gang_placement_options(config.num_env_runners)
         self._runners = FaultTolerantActorManager([
-            runner_cls.remote(config.env, config.num_envs_per_runner,
-                              config.seed + i, module_blob)
+            runner_cls.options(**gang_opts[i]).remote(
+                config.env, config.num_envs_per_runner,
+                config.seed + i, module_blob)
             for i in range(config.num_env_runners)])
 
         n_learn = config.num_learners

@@ -178,10 +178,10 @@ def _print_cluster_status(status: dict):
     summary lines alone)."""
     nodes = status.get("nodes")
     if nodes:
-        fmt = "{:<14} {:<8} {:>8} {:>8}  {}"
+        fmt = "{:<14} {:<8} {:>8} {:>8} {:<18}  {}"
         print("nodes:")
         print(fmt.format("node", "state", "hb-age", "pending",
-                         "resources (avail/total)"))
+                         "labels", "resources (avail/total)"))
         for n in nodes:
             res = " ".join(
                 f"{k}={n['resources_available'].get(k, 0):g}/"
@@ -190,10 +190,16 @@ def _print_cluster_status(status: dict):
                 if k != "memory")
             hb = n.get("heartbeat_age_s")
             state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
+            labels = n.get("labels") or {}
+            # topology first (ici-slice, dcn-locality), then the rest
+            lab = " ".join(
+                f"{k}={labels[k]}" for k in sorted(
+                    labels, key=lambda k: (
+                        k not in ("ici-slice", "dcn-locality"), k)))
             print(fmt.format(
                 n["node_id"][:14], state,
                 "—" if hb is None else f"{hb:.1f}s",
-                str(n.get("pending_leases", 0)), res))
+                str(n.get("pending_leases", 0)), lab[:18] or "—", res))
     drains = status.get("drains") or {}
     active = {h: r for h, r in drains.items()
               if r.get("state") in ("DRAINING", "DRAINED")}
@@ -210,6 +216,19 @@ def _print_cluster_status(status: dict):
                 took = (rec.get("completed", 0) or 0) - \
                     (rec.get("started", 0) or 0)
                 print(f"  {h[:14]}  DRAINED in {took:.1f}s  [{mig_s}]")
+    quotas = status.get("quotas") or {}
+    if quotas:
+        throttled = status.get("quota_throttled") or {}
+        print("job quotas (fair share):")
+        qfmt = "  {:<14} {:>8} {:>10} {:>10} {:>10}"
+        print(qfmt.format("job", "weight", "share", "used",
+                          "throttled"))
+        for j, q in sorted(quotas.items()):
+            share = (f"{q['share']:g} {q['resource']}"
+                     if q.get("resource") else f"{q['share']:g}")
+            print(qfmt.format(
+                j[:14], f"{q['weight']:g}", share,
+                f"{q['used']:g}", str(throttled.get(j, 0))))
     pending = status.get("pending_demand") or {}
     if pending:
         print("pending lease demand by shape:")
@@ -617,6 +636,11 @@ def _print_why_pending(out: dict):
     print(head)
     print(f"verdict: {out.get('verdict', '—')}")
     print(out.get("explanation", ""))
+    q = out.get("quota")
+    if q:
+        print(f"quota: weight={q['weight']:g} floor={q['floor']:g} "
+              f"share={q['share']:g} used={q['used']:g} "
+              f"{q.get('resource', '')}")
     if out.get("pending"):
         nodes = out.get("nodes") or {}
         if nodes:

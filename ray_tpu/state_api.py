@@ -125,6 +125,31 @@ def drain_status() -> dict:
     return cw.io.run(cw.gcs.conn.call("get_drain_status")) or {}
 
 
+def placement_state() -> dict:
+    """Placement-plane surface: topology map (ici-slice / dcn-locality
+    -> node hexes), per-job quota ledger with live usage, gang-admission
+    counters, and cumulative quota-throttle verdicts per job."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.conn.call("placement_state")) or {}
+
+
+def place_gang(demands: list[dict],
+               strategy: str = "SLICE_PACK") -> Optional[list]:
+    """Advisory (non-reserving) gang placement: node hex per demand, or
+    None when the gang does not fit whole right now."""
+    cw = _cw()
+    return cw.io.run(cw.gcs.conn.call(
+        "place_gang", (list(demands), strategy)))
+
+
+def set_job_quota(job_id: str, weight: float, floor: float = 0.0) -> None:
+    """Set (or with weight<=0, floor<=0 remove) a job's fair-share
+    quota of the governed resource."""
+    cw = _cw()
+    cw.io.run(cw.gcs.conn.call(
+        "set_job_quota", (str(job_id), float(weight), float(floor))))
+
+
 def summary() -> dict:
     """`ray summary`-style rollup."""
     nodes = list_nodes()

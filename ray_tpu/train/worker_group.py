@@ -137,8 +137,7 @@ class WorkerGroup:
         n = self.scaling.num_workers
         actor_cls = rt.remote(TrainWorker)
         if n > 1:
-            self.pg = rt.placement_group(self.scaling.bundles(),
-                                         strategy=self.scaling.placement_strategy)
+            self.pg = self._reserve_gang()
         res = self.scaling.worker_resources()
         group_name = f"train-{self.experiment_name}-{self.group_seq}"
         self.workers = []
@@ -153,6 +152,26 @@ class WorkerGroup:
                            self.scaling.ingest, self.run_id)
             for i, w in enumerate(self.workers)]
         return rt.get(setup_refs, timeout=120)
+
+    def _reserve_gang(self):
+        """Gang-reserve the workers through the placement plane. TPU
+        groups (use_tpu or a topology hint) first try SLICE_PACK — the
+        whole gang inside one ICI slice, so collectives stay on-mesh and
+        DAG edges to these workers compile co-located — and fall back to
+        the configured strategy when no single slice fits the gang
+        (e.g. an unlabeled dev cluster smaller than the request)."""
+        bundles = self.scaling.bundles()
+        if (self.scaling.use_tpu or self.scaling.topology) and \
+                self.scaling.placement_strategy in ("PACK",
+                                                    "SLICE_PACK"):
+            try:
+                return rt.placement_group(bundles,
+                                          strategy="SLICE_PACK",
+                                          timeout=30.0)
+            except TimeoutError:
+                pass
+        return rt.placement_group(
+            bundles, strategy=self.scaling.placement_strategy)
 
     def run_async(self, train_fn: Callable, config: Optional[dict]):
         from ray_tpu._internal.serialization import dumps_code

@@ -274,6 +274,29 @@ def sched_metric_records(node_hex: str, *, spillbacks: int = 0,
     return recs
 
 
+def quota_throttled_records(node_hex: str, throttled: dict, *,
+                            ts: float = 0.0) -> list:
+    """Per-job quota-throttle verdict counters, derived by the GCS event
+    manager from node managers' sched-report deltas (counter records
+    carry DELTAS; the store sums them). One series per (node, job) —
+    bounded by jobs actually throttled, not by all jobs."""
+    return [{"name": "rayt_sched_quota_throttled_total", "kind": "counter",
+             "value": float(n),
+             "tags": {"node": node_hex, "job": job_hex}, "ts": ts}
+            for job_hex, n in throttled.items() if n]
+
+
+def dag_preferred_kind_record(dag_hex: str, ratio: float, *,
+                              ts: float = 0.0) -> dict:
+    """The placement-quality gauge (defined in core/placement.py): the
+    fraction of a DAG's compiled edges whose transport avoided the DCN
+    fallback — device/shm where the payload prefers it. Derived by the
+    GCS dag manager from DAG register reports."""
+    return {"name": "rayt_dag_edges_preferred_kind_ratio",
+            "kind": "gauge", "value": float(ratio),
+            "tags": {"dag": dag_hex}, "ts": ts}
+
+
 def serve_request_metric_records(app: str, *, queue_wait_s=None,
                                  ttft_s=None, tpot_s=None,
                                  prefill_s=None, ts: float = 0.0) -> list:

@@ -14,8 +14,8 @@ from ray_tpu.cluster_utils import Cluster
 from ray_tpu.core.common import (NodeAffinitySchedulingStrategy,
                                  NodeLabelSchedulingStrategy)
 from ray_tpu.core.scheduling_policy import (critical_utilization, feasible,
-                                            hybrid_pick, pick_node,
-                                            spread_pick)
+                                            hybrid_pick, node_schedulable,
+                                            pick_node, spread_pick)
 
 
 def _view(total, avail, alive=True, labels=None):
@@ -32,6 +32,24 @@ def test_feasibility_and_draining():
                         {"CPU": 1})
     assert not feasible(_view({"CPU": 4}, {"CPU": 4},
                               labels={"draining": "1"}), {"CPU": 1})
+
+
+def test_node_schedulable_shared_predicate_and_topology_filter():
+    """The one predicate every feasibility path shares: alive, not
+    draining, and (optionally) exact topology-label match."""
+    ok = _view({"CPU": 4}, {"CPU": 4}, labels={"ici-slice": "s0"})
+    assert node_schedulable(ok)
+    assert not node_schedulable(_view({"CPU": 4}, {"CPU": 4},
+                                      alive=False))
+    assert not node_schedulable(
+        _view({"CPU": 4}, {"CPU": 4}, labels={"draining": "1"}))
+    # topology labels are hard filters through the same code path
+    assert node_schedulable(ok, topology={"ici-slice": "s0"})
+    assert not node_schedulable(ok, topology={"ici-slice": "s1"})
+    assert not node_schedulable(ok, topology={"dcn-locality": "r1"})
+    # and feasible() routes through it
+    assert feasible(ok, {"CPU": 1}, topology={"ici-slice": "s0"})
+    assert not feasible(ok, {"CPU": 1}, topology={"ici-slice": "s1"})
 
 
 def test_critical_utilization_is_max_over_resources():

@@ -217,6 +217,176 @@ def measure_sched(rt, cluster, target_nodes: int = 8,
     }
 
 
+# ------------------------------------------------------ placement leg
+# Multi-tenant fair-share drill (placement plane, core/placement.py):
+# three DRIVERS — each its own job, hence its own quota — run
+# concurrently: a serve-shaped tenant (small latency-sensitive tasks,
+# quota floor), a train-shaped tenant (gang placed through the plane,
+# compiled-DAG ticks, quota floor), and an unfloored shuffle tenant
+# bursting wide task waves. The gate: the floored tenants keep making
+# progress while the burst saturates the cluster, and the train gang's
+# DAG compiles onto preferred (non-DCN) channel kinds.
+
+_SERVE_TENANT = """
+import json, sys, time
+import ray_tpu as rt
+addr, T = sys.argv[1], float(sys.argv[2])
+rt.init(address=addr)
+rt.set_job_quota(weight=2.0, floor=1.0)
+@rt.remote(num_cpus=0.5)
+def handle(i):
+    time.sleep(0.02)
+    return i
+t0 = time.monotonic(); done = 0
+while time.monotonic() - t0 < T:
+    done += len(rt.get([handle.remote(i) for i in range(2)],
+                       timeout=300))
+print(json.dumps({"done": done,
+                  "wall_s": round(time.monotonic() - t0, 2),
+                  "job": rt.get_runtime_context().get_job_id()}))
+rt.shutdown()
+"""
+
+_TRAIN_TENANT = """
+import json, sys, time
+import ray_tpu as rt
+from ray_tpu.dag import InputNode
+from ray_tpu.core.common import NodeAffinitySchedulingStrategy
+from ray_tpu._internal.ids import NodeID
+addr, T = sys.argv[1], float(sys.argv[2])
+rt.init(address=addr)
+rt.set_job_quota(weight=2.0, floor=1.0)
+@rt.remote(num_cpus=1)
+class Stage:
+    def step(self, x):
+        return x + 1
+advised = rt.place_gang([{"CPU": 1.0}] * 2, "SLICE_PACK") or []
+opts = [{"scheduling_strategy": NodeAffinitySchedulingStrategy(
+             NodeID(bytes.fromhex(h)), soft=True)} for h in advised]
+if len(opts) != 2:
+    opts = [{}, {}]
+a = Stage.options(**opts[0]).remote()
+b = Stage.options(**opts[1]).remote()
+with InputNode() as inp:
+    out = b.step.bind(a.step.bind(inp))
+dag = out.experimental_compile()
+t0 = time.monotonic(); ticks = 0
+while time.monotonic() - t0 < T:
+    assert dag.execute(ticks).get(timeout=300) == ticks + 2
+    ticks += 1
+print(json.dumps({"ticks": ticks,
+                  "wall_s": round(time.monotonic() - t0, 2),
+                  "advised_one_node": len(set(advised)) == 1,
+                  "preferred_kind_ratio": dag.preferred_kind_ratio,
+                  "job": rt.get_runtime_context().get_job_id()}))
+dag.teardown()
+rt.shutdown()
+"""
+
+_SHUFFLE_TENANT = """
+import json, sys, time
+import ray_tpu as rt
+addr, T = sys.argv[1], float(sys.argv[2])
+rt.init(address=addr)
+rt.set_job_quota(weight=0.25)   # burst tenant: low weight, NO floor
+@rt.remote(num_cpus=1)
+def chunk(i):
+    time.sleep(0.05)
+    return i
+t0 = time.monotonic(); done = 0
+while time.monotonic() - t0 < T:
+    done += len(rt.get([chunk.remote(i) for i in range(12)],
+                       timeout=600))
+print(json.dumps({"done": done,
+                  "wall_s": round(time.monotonic() - t0, 2),
+                  "job": rt.get_runtime_context().get_job_id()}))
+rt.shutdown()
+"""
+
+
+def measure_placement(rt, cluster, *, seconds: float = 8.0) -> dict:
+    """Multi-tenant placement-plane leg: serve + train + shuffle drivers
+    (distinct jobs -> distinct quotas) concurrent on a small labeled
+    cluster. Records per-tenant throughput, the quota ledger observed
+    mid-run, cumulative quota-throttle verdicts, and the train DAG's
+    preferred-channel-kind fraction."""
+    import subprocess
+
+    from ray_tpu import state_api
+
+    # a labeled slice so SLICE_PACK has real topology to group by (the
+    # earlier legs' nodes are unlabeled -> one anonymous slice)
+    view = cluster._cluster_view()
+    if not any((v.get("labels") or {}).get("ici-slice")
+               for v in view.values()):
+        cluster.add_node(num_cpus=2,
+                         labels={"ici-slice": "bench-slice"})
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    def spawn(script):
+        return subprocess.Popen(
+            [sys.executable, "-c", script, cluster.address,
+             str(seconds)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+
+    # train first: its gang placement + DAG compile run against an idle
+    # cluster (the measured-cost order is then deterministic — pending
+    # depth from an already-running burst would shove the gang off the
+    # driver's node and the preferred-kind fraction would measure the
+    # race, not the placer)
+    procs = {"train": spawn(_TRAIN_TENANT)}
+    time.sleep(2.0)
+    procs["serve"] = spawn(_SERVE_TENANT)
+    procs["shuffle"] = spawn(_SHUFFLE_TENANT)
+
+    # poll the plane WHILE tenants run: job-finish scrubs a job's quota
+    # + throttle ledger, so the mid-run view is the evidence
+    quotas_seen: dict = {}
+    throttled_seen: dict = {}
+    deadline = time.monotonic() + seconds + 120.0
+    while any(p.poll() is None for p in procs.values()) \
+            and time.monotonic() < deadline:
+        try:
+            st = state_api.placement_state()
+            for j, q in (st.get("quotas") or {}).items():
+                quotas_seen[j] = q
+            for j, n in (st.get("quota_throttled") or {}).items():
+                throttled_seen[j] = max(throttled_seen.get(j, 0), n)
+        except Exception:
+            pass
+        time.sleep(0.5)
+
+    tenants = {}
+    for name, p in procs.items():
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0, f"{name} tenant driver failed"
+        tenants[name] = json.loads(out.strip().splitlines()[-1])
+
+    # floors: the quota'd serve/train tenants kept making progress while
+    # the burst saturated the cluster
+    assert tenants["serve"]["done"] >= 2 * seconds, tenants["serve"]
+    assert tenants["train"]["ticks"] >= seconds / 2, tenants["train"]
+    assert tenants["shuffle"]["done"] > 0, tenants["shuffle"]
+
+    per_s = {n: round(
+        (t.get("done", t.get("ticks", 0))) / t.get("wall_s", seconds),
+        2) for n, t in tenants.items()}
+    return {
+        "seconds": seconds,
+        "serve": {**tenants["serve"], "per_s": per_s["serve"]},
+        "train": {**tenants["train"], "per_s": per_s["train"]},
+        "shuffle": {**tenants["shuffle"], "per_s": per_s["shuffle"]},
+        "preferred_kind_ratio":
+            tenants["train"].get("preferred_kind_ratio"),
+        "quotas_mid_run": quotas_seen,
+        "quota_throttled": throttled_seen,
+    }
+
+
 # ---------------------------------------------------------- chaos legs
 # Recovery SLOs under injected faults (tools/chaos.py; ref analog: the
 # nightly chaos suites — kill things on a cadence under load, assert
@@ -647,6 +817,13 @@ def main():
              "lease verdicts coalesced per demand shape: grant/queue/"
              "spill/infeasible + queue-wait percentiles + hop chains",
              lambda: measure_sched(rt, cluster))
+
+        _leg(results, "placement_multi_tenant_fair_share", "tenants",
+             "placement plane: quota'd serve/train tenants hold their "
+             "floors while an unfloored shuffle tenant bursts; train "
+             "gang placed via SLICE_PACK compiles preferred channel "
+             "kinds",
+             lambda: measure_placement(rt, cluster))
 
         def broadcast():
             arr = np.zeros(args.broadcast_mib << 20, np.uint8)
