@@ -36,7 +36,8 @@ import random
 import time
 from typing import Optional
 
-from ray_tpu.util.builtin_metrics import (serve_engine_metric_records,
+from ray_tpu.util.builtin_metrics import (serve_data_plane_metric_records,
+                                          serve_engine_metric_records,
                                           serve_request_metric_records)
 
 # channel convention: the owning manager defines its channel name and
@@ -124,7 +125,14 @@ class GcsServeManager:
             if k in ("kind", "side", "final"):
                 continue
             if isinstance(v, dict):
-                rec.setdefault(k, {}).update(v)
+                # key-wise, None never overwrites — a disagg request's
+                # decode partial (prefill_s: None) and prefill partial
+                # (decode keys absent) coalesce into ONE engine
+                # waterfall whichever flush lands first
+                dst = rec.setdefault(k, {})
+                for kk, vv in v.items():
+                    if vv is not None:
+                        dst[kk] = vv
             elif v is not None:
                 rec[k] = v
 
@@ -172,6 +180,20 @@ class GcsServeManager:
                           + float((rec.get("stages") or {})
                                   .get("router_s") or 0.0)),
             ttft_s=rec.get("ttft_s"), tpot_s=rec.get("tpot_s"), ts=ts))
+        eng = rec.get("engine") or {}
+        # data-plane counters: router-level prefix classification
+        # (hit|spill|cold — the engine's own hit/cold is the fallback
+        # when the record predates the router stamp) and per-proxy
+        # admission attribution (sheds never held a window slot). KV
+        # handoff bytes derive at replica-partial INGEST instead
+        # (_emit_replica_metrics) — a disagg replica's flush may land
+        # after the proxy final
+        self._metric_buf.extend(serve_data_plane_metric_records(
+            app,
+            prefix_outcome=(rec.get("prefix_cache")
+                            or eng.get("prefix_cache")),
+            proxy=(rec.get("proxy") if outcome != "shed" else None),
+            ts=ts))
         win = self._e2e.get(app)
         if win is None:
             win = self._e2e[app] = collections.deque(maxlen=_E2E_WINDOW)
@@ -221,10 +243,16 @@ class GcsServeManager:
         eng = part.get("engine") or {}
         if not eng:
             return
+        app = rec.get("app") or part.get("app") or ""
+        ts = float(part.get("ts") or time.time())
         self._metric_buf.extend(serve_request_metric_records(
-            rec.get("app") or part.get("app") or "",
-            prefill_s=eng.get("prefill_s"),
-            ts=float(part.get("ts") or time.time())))
+            app, prefill_s=eng.get("prefill_s"), ts=ts))
+        # KV handoff volume (disagg): only the prefill pool's partial
+        # carries the bytes, so ingest-time derivation counts each
+        # handoff exactly once whatever the flush order
+        self._metric_buf.extend(serve_data_plane_metric_records(
+            app, kv_bytes=int(eng.get("kv_handoff_bytes") or 0),
+            edge_kind=str(eng.get("kv_handoff_edge") or ""), ts=ts))
 
     def _apply_engine(self, m: dict):
         """Cumulative engine counters from a replica report → deltas

@@ -358,6 +358,18 @@ def _print_requests(out: dict):
             tail = f" model={r['model_id']}"
             if r.get("affinity"):
                 tail += f"({r['affinity']})"
+        if r.get("proxy"):
+            tail += f" proxy={r['proxy']}"
+        # Engine outcome wins: the proxy stamps its routing-affinity view,
+        # but only the engine knows whether cached KV was actually grafted.
+        pc = eng.get("prefix_cache") or r.get("prefix_cache")
+        if pc:
+            tail += f" prefix={pc}"
+            if eng.get("prefix_hit_tokens"):
+                tail += f"(+{eng['prefix_hit_tokens']}tok)"
+        if eng.get("kv_handoff_bytes"):
+            tail += (f" kv={eng['kv_handoff_bytes']}B/"
+                     f"{eng.get('kv_handoff_edge') or 'shm'}")
         print(fmt.format(r.get("request_id", "")[:12],
                          (r.get("app") or "")[:12],
                          r.get("outcome") or "ok",

@@ -16,10 +16,28 @@ from ray_tpu.serve.multiplex import (get_multiplexed_model_id,  # noqa: F401
                                      multiplexed)
 from ray_tpu.serve.schema import build_app, deploy_config  # noqa: F401
 
+# HTTP ingress fleet: [(actor, port, proxy_id)], sized by start()'s
+# num_proxies / RAYT_SERVE_NUM_PROXIES. _proxy/_proxy_port alias the
+# first member (single-proxy callers keep working unchanged).
+_proxies: list = []
 _proxy = None
 _proxy_port: Optional[int] = None
 _grpc_proxy = None
 _grpc_port: Optional[int] = None
+
+NUM_PROXIES_ENV = "RAYT_SERVE_NUM_PROXIES"
+
+
+def proxy_ports() -> list[int]:
+    """Bound ports of the live HTTP ingress fleet (fan clients across
+    these; any port serves any app)."""
+    return [port for _, port, _ in _proxies]
+
+
+def proxy_name(index: int) -> str:
+    """Actor name of HTTP proxy ``index`` (chaos drills kill by name).
+    Index 0 keeps the historical single-proxy name."""
+    return "serve_proxy" if index == 0 else f"serve_proxy_{index}"
 
 
 def _controller(create: bool = True):
@@ -98,8 +116,8 @@ def run(app: Application, *, name: str = "default",
                     timeout=timeout + 10)
         if not ok:
             raise TimeoutError(f"app {name!r} did not become ready")
-    if _proxy is not None:
-        rt.get(_proxy.register_app.remote(name, ingress), timeout=30)
+    for proxy, _, _ in _proxies:
+        rt.get(proxy.register_app.remote(name, ingress), timeout=30)
     if _grpc_proxy is not None:
         rt.get(_grpc_proxy.register_app.remote(name, ingress), timeout=30)
     return DeploymentHandle(ingress, name)
@@ -121,31 +139,56 @@ def delete(name: str = "default"):
 
     controller = _controller(create=False)
     rt.get(controller.delete_application.remote(name), timeout=60)
-    if _proxy is not None:
-        rt.get(_proxy.unregister_app.remote(name), timeout=30)
+    for proxy, _, _ in _proxies:
+        try:
+            rt.get(proxy.unregister_app.remote(name), timeout=30)
+        except Exception:
+            pass  # a chaos-killed fleet member must not fail delete()
     if _grpc_proxy is not None:
         rt.get(_grpc_proxy.unregister_app.remote(name), timeout=30)
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 0,
           request_timeout_s: Optional[float] = None,
-          admission_headroom: Optional[float] = None) -> int:
-    """Start the HTTP ingress proxy; returns the bound port (ref:
-    proxy-per-node in the reference; one proxy here — single-head).
+          admission_headroom: Optional[float] = None,
+          num_proxies: Optional[int] = None) -> int:
+    """Start the HTTP ingress fleet; returns the FIRST proxy's bound
+    port (``proxy_ports()`` lists them all). ``num_proxies`` (default
+    RAYT_SERVE_NUM_PROXIES, else 1) shards the ingress: every proxy
+    serves every app behind the shared routing table, each admitting
+    its share of the cluster window (serve/admission.py), stamping
+    ``X-Rayt-Proxy-Id``, and heartbeating the controller so a dead
+    member's share redistributes within one table refresh.
     ``request_timeout_s`` / ``admission_headroom`` override the
     RAYT_SERVE_REQUEST_TIMEOUT_S / RAYT_SERVE_ADMISSION_HEADROOM env
     defaults (the env is read in the PROXY process, which inherits the
     driver's environment at cluster init)."""
     global _proxy, _proxy_port
+    import os
+
     import ray_tpu as rt
     from ray_tpu.serve.proxy import ProxyActor
 
+    if num_proxies is None:
+        try:
+            num_proxies = int(os.environ.get(NUM_PROXIES_ENV, "1"))
+        except (TypeError, ValueError):
+            num_proxies = 1
+    num_proxies = max(1, num_proxies)
     _controller()
-    if _proxy is None:
-        _proxy = rt.remote(ProxyActor).options(
-            name="serve_proxy", num_cpus=0).remote(
-            http_host, http_port, request_timeout_s, admission_headroom)
-        _proxy_port = rt.get(_proxy.start.remote(), timeout=60)
+    while len(_proxies) < num_proxies:
+        i = len(_proxies)
+        proxy_id = f"http-{i}"
+        # explicit ports step from the base; port 0 lets each bind its
+        # own ephemeral port
+        port = http_port + i if http_port else 0
+        proxy = rt.remote(ProxyActor).options(
+            name=proxy_name(i), num_cpus=0).remote(
+            http_host, port, request_timeout_s, admission_headroom,
+            proxy_id)
+        bound = rt.get(proxy.start.remote(), timeout=60)
+        _proxies.append((proxy, bound, proxy_id))
+    _proxy, _proxy_port = _proxies[0][0], _proxies[0][1]
     return _proxy_port
 
 
@@ -179,7 +222,7 @@ def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
 
 
 def shutdown():
-    global _proxy, _proxy_port, _grpc_proxy, _grpc_port
+    global _proxies, _proxy, _proxy_port, _grpc_proxy, _grpc_port
     import ray_tpu as rt
 
     try:
@@ -200,9 +243,9 @@ def shutdown():
         _internal_kv_del(CKPT_KEY, namespace=CKPT_NAMESPACE)
     except Exception:
         pass
-    if _proxy is not None:
+    for proxy, _, _ in _proxies:
         try:
-            rt.kill(_proxy)
+            rt.kill(proxy)
         except Exception:
             pass
     if _grpc_proxy is not None:
@@ -212,5 +255,6 @@ def shutdown():
             pass
     _grpc_proxy = None
     _grpc_port = None
+    _proxies = []
     _proxy = None
     _proxy_port = None

@@ -3,9 +3,17 @@ python/ray/serve/_private/proxy.py request management and the
 max_ongoing_requests backpressure story in replica_scheduler/).
 
 The ingress proxies (HTTP + gRPC) size a per-app ADMISSION WINDOW from
-the routing table::
+the routing table. With a sharded ingress (N proxy replicas behind the
+shared table) each proxy admits a SHARE of cluster capacity::
 
-    window = ceil(num_replicas * max_ongoing_requests * headroom)
+    cluster_window = num_replicas * max_ongoing_requests * headroom
+    window         = ceil(cluster_window / live_proxies)
+
+``live_proxies`` rides the same routing-table refresh as replica
+capacity (controller counts heartbeating proxies), so a dead proxy's
+share redistributes to the survivors within one table refresh — no
+extra control traffic, no proxy-to-proxy coordination. The per-proxy
+windows sum to the cluster window (within ceil rounding).
 
 Requests beyond the window are SHED immediately (HTTP 503 +
 ``Retry-After``; gRPC RESOURCE_EXHAUSTED) instead of queueing until the
@@ -149,28 +157,45 @@ class AdmissionWindow:
     with no extra control traffic.
     """
 
-    def __init__(self, headroom: float | None = None):
+    def __init__(self, headroom: float | None = None,
+                 proxy_id: str = ""):
         if headroom is None:
             try:
                 headroom = float(os.environ.get(HEADROOM_ENV, "2.0"))
             except (TypeError, ValueError):
                 headroom = 2.0
         self.headroom = max(1.0, float(headroom))
+        self.proxy_id = proxy_id
         self._lock = threading.Lock()
         self._admitted: dict[str, int] = {}
         self._windows: dict[str, int] = {}
+        self._cluster_windows: dict[str, int] = {}
         self._shed_total: dict[str, int] = {}
         self._admitted_total: dict[str, int] = {}
+        self._live_proxies = 1
 
-    def window_for(self, num_replicas: int, max_ongoing: int) -> int:
+    def cluster_window_for(self, num_replicas: int,
+                           max_ongoing: int) -> int:
         return max(1, int(math.ceil(
             max(1, num_replicas) * max(1, max_ongoing) * self.headroom)))
 
+    def window_for(self, num_replicas: int, max_ongoing: int,
+                   live_proxies: int = 1) -> int:
+        """This proxy's share of the cluster admission window. ceil
+        keeps every share >= 1 so a proxy never starves; the shares sum
+        to the cluster window within (live_proxies - 1) of rounding."""
+        cluster = (max(1, num_replicas) * max(1, max_ongoing)
+                   * self.headroom)
+        return max(1, int(math.ceil(cluster / max(1, live_proxies))))
+
     def try_acquire(self, app: str, num_replicas: int,
-                    max_ongoing: int) -> bool:
-        window = self.window_for(num_replicas, max_ongoing)
+                    max_ongoing: int, live_proxies: int = 1) -> bool:
+        window = self.window_for(num_replicas, max_ongoing, live_proxies)
         with self._lock:
             self._windows[app] = window
+            self._cluster_windows[app] = self.cluster_window_for(
+                num_replicas, max_ongoing)
+            self._live_proxies = max(1, int(live_proxies))
             if self._admitted.get(app, 0) >= window:
                 self._shed_total[app] = self._shed_total.get(app, 0) + 1
                 return False
@@ -185,11 +210,15 @@ class AdmissionWindow:
             self._admitted[app] = max(0, n - 1)
 
     def snapshot(self) -> dict:
+        """Per-app admission accounting. ``window`` is THIS proxy's
+        share; ``cluster_window`` the whole fleet's (shares x live
+        proxies sum back to it within ceil rounding)."""
         with self._lock:
             return {
                 app: {
                     "admitted": self._admitted.get(app, 0),
                     "window": self._windows.get(app, 0),
+                    "cluster_window": self._cluster_windows.get(app, 0),
                     "admitted_total": self._admitted_total.get(app, 0),
                     "shed_total": self._shed_total.get(app, 0),
                 }
@@ -197,3 +226,10 @@ class AdmissionWindow:
                             | set(self._shed_total)
                             | set(self._admitted_total))
             }
+
+    def fleet_snapshot(self) -> dict:
+        """Top-level identity block merged into the /-/admission
+        response (kept out of snapshot() so per-app keys stay flat)."""
+        with self._lock:
+            return {"proxy_id": self.proxy_id,
+                    "live_proxies": self._live_proxies}

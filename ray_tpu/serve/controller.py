@@ -29,6 +29,11 @@ RECONCILE_ERR_LOG_INTERVAL_S = 30.0
 CKPT_NAMESPACE = "serve"
 CKPT_KEY = "controller:checkpoint"
 
+# ingress-proxy liveness: a proxy heartbeats ~1/s; one missing for this
+# long is declared dead and its admission-window share redistributes to
+# the survivors on their next routing-table refresh (~1s capacity TTL)
+PROXY_TTL_S = 3.0
+
 
 class ServeController:
     def __init__(self):
@@ -72,6 +77,83 @@ class ServeController:
         # last autoscale decision per key (introspection: tests, bench,
         # dashboard): {"desired", "target", "live", "signals", "ts"}
         self._autoscale_status: dict[str, dict] = {}
+        # ingress-proxy fleet membership: proxy_id -> {"proto", "port",
+        # "last_seen" (controller-local monotonic)}. The live count rides
+        # get_route_info so every proxy sizes its admission-window share
+        # from the same view the routing table comes from.
+        self._proxies: dict[str, dict] = {}
+
+    # ------------------------------------------------- proxy fleet
+    def register_proxy(self, proxy_id: str, proto: str = "http",
+                       port: int = 0) -> bool:
+        fresh = proxy_id not in self._proxies
+        self._proxies[proxy_id] = {"proto": proto, "port": int(port),
+                                   "last_seen": time.monotonic()}
+        if fresh:
+            try:
+                from ray_tpu.core.gcs_event_manager import \
+                    emit_cluster_event
+
+                emit_cluster_event(
+                    source="serve", kind="serve_proxy_joined",
+                    message=(f"ingress proxy {proxy_id} ({proto}, port "
+                             f"{port}) joined the fleet "
+                             f"({self._live_proxy_count()} live)"),
+                    proxy=proxy_id, proto=proto, port=int(port))
+            except Exception:
+                pass
+        return True
+
+    def proxy_heartbeat(self, proxy_id: str, proto: str = "http",
+                        port: int = 0) -> bool:
+        rec = self._proxies.get(proxy_id)
+        if rec is None:  # controller bounced: heartbeat re-registers
+            return self.register_proxy(proxy_id, proto, port)
+        rec["last_seen"] = time.monotonic()
+        return True
+
+    def deregister_proxy(self, proxy_id: str) -> bool:
+        return self._proxies.pop(proxy_id, None) is not None
+
+    def _live_proxy_ids(self) -> list[str]:
+        now = time.monotonic()
+        return [pid for pid, rec in self._proxies.items()
+                if now - rec["last_seen"] <= PROXY_TTL_S]
+
+    def _live_proxy_count(self) -> int:
+        return max(1, len(self._live_proxy_ids()))
+
+    def list_proxies(self) -> dict:
+        """Fleet view for introspection (dashboard / bench): per-proxy
+        proto, port, liveness, and seconds since the last heartbeat."""
+        now = time.monotonic()
+        return {pid: {"proto": rec["proto"], "port": rec["port"],
+                      "age_s": round(now - rec["last_seen"], 3),
+                      "live": now - rec["last_seen"] <= PROXY_TTL_S}
+                for pid, rec in self._proxies.items()}
+
+    def _proxy_tick(self):
+        """Prune proxies past the liveness TTL (one WARNING event per
+        death; the share redistribution itself needs no action here —
+        live_proxies is recomputed on every get_route_info)."""
+        now = time.monotonic()
+        for pid, rec in list(self._proxies.items()):
+            if now - rec["last_seen"] > PROXY_TTL_S:
+                del self._proxies[pid]
+                try:
+                    from ray_tpu.core.gcs_event_manager import \
+                        emit_cluster_event
+
+                    emit_cluster_event(
+                        source="serve", kind="serve_proxy_dead",
+                        severity="WARNING",
+                        message=(f"ingress proxy {pid} missed heartbeats "
+                                 f"for {PROXY_TTL_S}s — removed from the "
+                                 "fleet; its admission share "
+                                 "redistributes on the next refresh"),
+                        proxy=pid)
+                except Exception:
+                    pass
 
     async def ensure_loop(self) -> bool:
         if self._loop_task is None:
@@ -108,6 +190,9 @@ class ServeController:
             "scale_marks": {k: now - first
                             for k, first in self._scale_marks.items()},
             "autoscale_status": dict(self._autoscale_status),
+            "proxies": {pid: {"proto": rec["proto"], "port": rec["port"],
+                              "age_s": now - rec["last_seen"]}
+                        for pid, rec in self._proxies.items()},
         }
 
     def _save_checkpoint(self):
@@ -158,6 +243,14 @@ class ServeController:
             self._scale_marks = {k: now - age for k, age in
                                  state.get("scale_marks", {}).items()}
             self._autoscale_status = state.get("autoscale_status", {})
+            # adopt the proxy fleet too: ages carry over so a proxy that
+            # died while the controller was down still expires on time;
+            # live ones refresh within one heartbeat anyway
+            self._proxies = {
+                pid: {"proto": rec.get("proto", "http"),
+                      "port": int(rec.get("port", 0)),
+                      "last_seen": now - float(rec.get("age_s", 0.0))}
+                for pid, rec in state.get("proxies", {}).items()}
             adopted = sum(len(v) for v in self.replicas.values())
             from ray_tpu.core.gcs_event_manager import emit_cluster_event
 
@@ -302,7 +395,8 @@ class ServeController:
         spec = self.apps.get(app, {}).get(dep, {})
         return {"update": self.get_routing_table(known_version),
                 "load": self._replica_load.get((app, dep), {}),
-                "max_ongoing": int(spec.get("max_ongoing_requests", 16))}
+                "max_ongoing": int(spec.get("max_ongoing_requests", 16)),
+                "live_proxies": self._live_proxy_count()}
 
     def get_autoscale_status(self) -> dict:
         """Last autoscale decision per 'app/dep' (desired demand, the
@@ -340,6 +434,10 @@ class ServeController:
                 await self._self_evacuate_tick()
             except Exception:
                 self._log_reconcile_error("self-evacuate")
+            try:
+                self._proxy_tick()
+            except Exception:
+                self._log_reconcile_error("proxy-fleet")
             await asyncio.sleep(0.5)
 
     def _log_reconcile_error(self, phase: str):

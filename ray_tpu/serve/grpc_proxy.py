@@ -34,14 +34,17 @@ _SERVICE = "rayt.serve.Serve"
 class GrpcProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float | None = None,
-                 admission_headroom: float | None = None):
+                 admission_headroom: float | None = None,
+                 proxy_id: str = "grpc-0"):
         self.host = host
         self.port = port
+        self.proxy_id = proxy_id
         self._handles: dict[str, Any] = {}
         self._ingress: dict[str, str] = {}
         self._server = None
         self._timeout_override = request_timeout_s
-        self._admission = AdmissionWindow(admission_headroom)
+        self._admission = AdmissionWindow(admission_headroom, proxy_id)
+        self._hb_thread = None
 
     # ------------------------------------------------------------- control
     def register_app(self, app_name: str, ingress_deployment: str) -> bool:
@@ -55,7 +58,8 @@ class GrpcProxyActor:
         return True
 
     def admission_snapshot(self) -> dict:
-        return self._admission.snapshot()
+        return {**self._admission.snapshot(),
+                **self._admission.fleet_snapshot()}
 
     async def start(self) -> int:
         import grpc
@@ -80,7 +84,34 @@ class GrpcProxyActor:
         self.port = self._server.add_insecure_port(
             f"{self.host}:{self.port}")
         self._server.start()
+        self._start_heartbeat()
         return self.port
+
+    def _start_heartbeat(self):
+        """Daemon-thread controller heartbeat (the gRPC server runs on
+        plain threads, no event loop): same fleet-membership beat as the
+        HTTP proxy, so the gRPC ingress counts toward live_proxies and
+        admits its share of the shared cluster window."""
+        import threading
+
+        from ray_tpu.serve.proxy import HEARTBEAT_PERIOD_S
+
+        def _loop():
+            import ray_tpu as rt
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            while True:
+                try:
+                    controller = rt.get_actor(CONTROLLER_NAME)
+                    rt.get(controller.proxy_heartbeat.remote(
+                        self.proxy_id, "grpc", self.port), timeout=5)
+                except Exception:
+                    pass  # controller bouncing: keep serving
+                time.sleep(HEARTBEAT_PERIOD_S)
+
+        self._hb_thread = threading.Thread(
+            target=_loop, name="grpc-proxy-heartbeat", daemon=True)
+        self._hb_thread.start()
 
     async def stop(self):
         if self._server is not None:
@@ -104,30 +135,37 @@ class GrpcProxyActor:
             self._handles[app_name] = handle
         model_id = req.get("model_id") or ""
         from ray_tpu.serve.admission import queue_timeout_s
+        from ray_tpu.serve.handle import derive_prefix_key
 
+        payload = req.get("payload")
         # bound the capacity-gate park by the request timeout (shed as
-        # backpressure instead of queueing into a deadline)
+        # backpressure instead of queueing into a deadline); prefix key
+        # mirrors the HTTP proxy's prefix-cache-aware routing
         handle = handle.options(
             multiplexed_model_id=model_id or None,
             queue_timeout_s=min(queue_timeout_s(),
-                                self._request_timeout()))
-        return app_name, handle, req.get("payload"), model_id
+                                self._request_timeout()),
+            prefix_key=derive_prefix_key(payload) or None)
+        return app_name, handle, payload, model_id
 
     # --------------------------------------- request-path observability
-    @staticmethod
-    def _new_context(context) -> dict:
+    def _new_context(self, context) -> dict:
         """Mint the request id (parity with the HTTP proxy's
-        X-Rayt-Request-Id: echoed to the caller as initial metadata) and
-        start the request context that rides the handle envelope."""
+        X-Rayt-Request-Id: echoed to the caller as initial metadata,
+        alongside x-rayt-proxy-id naming the fleet member that served
+        it) and start the request context that rides the handle
+        envelope."""
         from ray_tpu.serve.request_context import mint_request_id
 
         rid = mint_request_id()
         try:
             context.send_initial_metadata(
-                (("x-rayt-request-id", rid),))
+                (("x-rayt-request-id", rid),
+                 ("x-rayt-proxy-id", self.proxy_id)))
         except Exception:
             pass
-        return {"request_id": rid, "start_ts": time.time()}
+        return {"request_id": rid, "start_ts": time.time(),
+                "proxy": self.proxy_id}
 
     @staticmethod
     def _record(ctx: dict, app_name: str, outcome: str, **kw):
@@ -149,17 +187,17 @@ class GrpcProxyActor:
         import grpc
 
         try:
-            replicas, max_ongoing = handle.capacity()
+            replicas, max_ongoing, live = handle.capacity_info()
         except Exception:
-            replicas, max_ongoing = 1, 16
+            replicas, max_ongoing, live = 1, 16, 1
         if not self._admission.try_acquire(app_name, replicas,
-                                           max_ongoing):
-            count_shed(app_name, "grpc", "shed")
+                                           max_ongoing, live):
+            count_shed(app_name, self.proxy_id, "shed")
             raise _Abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"admission window full for app {app_name!r}; "
                 f"retry after {retry_after_s()}s")
-        count_admitted(app_name, "grpc")
+        count_admitted(app_name, self.proxy_id)
 
     def _abort_for(self, app_name: str, e: Exception) -> "_Abort":
         """Mirror the HTTP 503/500 split onto gRPC codes."""
@@ -168,20 +206,20 @@ class GrpcProxyActor:
         from ray_tpu.core.common import GetTimeoutError
 
         if isinstance(e, GetTimeoutError):
-            count_shed(app_name, "grpc", "timeout")
+            count_shed(app_name, self.proxy_id, "timeout")
             return _Abort(
                 grpc.StatusCode.UNAVAILABLE,
                 f"request exceeded {self._request_timeout():.0f}s "
                 f"(RAYT_SERVE_REQUEST_TIMEOUT_S); retry after "
                 f"{retry_after_s()}s")
         if is_overload_error(e):
-            count_shed(app_name, "grpc", "queue_full")
+            count_shed(app_name, self.proxy_id, "queue_full")
             return _Abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"replicas at capacity: {e!r}; retry after "
                 f"{retry_after_s()}s")
         if isinstance(e, RuntimeError) and "no replicas" in str(e):
-            count_shed(app_name, "grpc", "no_replicas")
+            count_shed(app_name, self.proxy_id, "no_replicas")
             return _Abort(grpc.StatusCode.UNAVAILABLE, repr(e))
         return _Abort(grpc.StatusCode.INTERNAL, repr(e))
 

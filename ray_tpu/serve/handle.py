@@ -46,6 +46,8 @@ control plane's version counter is not comparable to ours.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import random
 import threading
 import time
@@ -53,12 +55,48 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Optional
 
+PREFIX_BLOCK_ENV = "RAYT_SERVE_PREFIX_BLOCK"
+
 
 def _get_controller():
     import ray_tpu as rt
     from ray_tpu.serve.controller import CONTROLLER_NAME
 
     return rt.get_actor(CONTROLLER_NAME)
+
+
+def prefix_block_tokens(default: int = 16) -> int:
+    """Prefix-routing block size in tokens (0 disables prefix keys)."""
+    try:
+        return int(os.environ.get(PREFIX_BLOCK_ENV, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def derive_prefix_key(payload, block: int | None = None) -> str:
+    """Hash a prompt's LEADING token block into a routing key.
+
+    Requests whose prompts share the first ``block`` tokens (a system
+    prompt, a shared document header) map to the same key and route to
+    replicas whose engine already holds that prefix's KV rows. The key
+    is first-block granularity on purpose: the ENGINE extends the match
+    to the longest block-aligned prefix it has cached (llm.py), the
+    router only needs a stable bucket. Prompts shorter than one block
+    get no key ("") — nothing worth reusing."""
+    if block is None:
+        block = prefix_block_tokens()
+    if block <= 0 or not isinstance(payload, dict):
+        return ""
+    tokens = payload.get("tokens")
+    if isinstance(tokens, str):
+        tokens = list(tokens.encode())
+    if not isinstance(tokens, (list, tuple)) or len(tokens) < block:
+        return ""
+    try:
+        head = ",".join(str(int(t)) for t in tokens[:block])
+    except (TypeError, ValueError):
+        return ""
+    return hashlib.sha1(head.encode()).hexdigest()[:16]
 
 
 class _RouterState:
@@ -69,6 +107,10 @@ class _RouterState:
 
     MAX_MODELS = 1024             # affinity LRU: model-id entries
     MAX_REPLICAS_PER_MODEL = 4    # affinity LRU: replicas per model id
+    MAX_PREFIXES = 4096           # prefix LRU: (model, prefix) entries
+    MAX_REPLICAS_PER_PREFIX = 2   # prefix LRU: keep the warm set tight
+    # (a prefix's KV lives in at most a couple of engines — spreading
+    # wider than the engine prefix caches can hold just evicts them)
 
     def __init__(self, deployment_name: str, app_name: str):
         self.deployment_name = deployment_name
@@ -90,6 +132,13 @@ class _RouterState:
         # model id -> OrderedDict[replica hex] (most-recent last)
         self.model_affinity: OrderedDict[str, OrderedDict[str, None]] = \
             OrderedDict()
+        # (model id, prefix key) -> OrderedDict[replica hex]: the
+        # prefix-cache extension of the multiplex LRU — same double-LRU
+        # mechanics, same churn semantics (benign refresh keeps entries,
+        # replica removal drops exactly the dead hexes)
+        self.prefix_affinity: OrderedDict[tuple, OrderedDict[str, None]] \
+            = OrderedDict()
+        self.live_proxies = 1     # fleet size from the last table refresh
         self.handle_hex = uuid.uuid4().hex[:8]
         self.waiting = 0                  # requests parked in the gate
         self._last_heal = 0.0             # controller re-resolve throttle
@@ -190,6 +239,7 @@ class _RouterState:
             self.table_ts = time.monotonic() if now is None else now
             self.load = dict(info.get("load") or {})
             self.max_ongoing = int(info.get("max_ongoing") or 16)
+            self.live_proxies = max(1, int(info.get("live_proxies") or 1))
             if update is None:
                 return
             self.table_version = update["version"]
@@ -207,6 +257,12 @@ class _RouterState:
                     del reps[h]
                 if not reps:
                     del self.model_affinity[mid]
+            for pk in list(self.prefix_affinity):
+                reps = self.prefix_affinity[pk]
+                for h in [h for h in reps if h not in live]:
+                    del reps[h]
+                if not reps:
+                    del self.prefix_affinity[pk]
             self.capacity_freed.notify_all()  # new table may have slots
 
     # ------------------------------------------------------------- scoring
@@ -231,35 +287,77 @@ class _RouterState:
         while len(self.model_affinity) > self.MAX_MODELS:
             self.model_affinity.popitem(last=False)
 
-    def _try_pick_locked(self, model_id: str):
+    def _record_prefix_affinity(self, pkey: tuple, hex_: str):
+        reps = self.prefix_affinity.get(pkey)
+        if reps is None:
+            reps = self.prefix_affinity[pkey] = OrderedDict()
+        reps[hex_] = None
+        reps.move_to_end(hex_)
+        while len(reps) > self.MAX_REPLICAS_PER_PREFIX:
+            reps.popitem(last=False)
+        self.prefix_affinity.move_to_end(pkey)
+        while len(self.prefix_affinity) > self.MAX_PREFIXES:
+            self.prefix_affinity.popitem(last=False)
+
+    def _best_affine(self, reps, hex2idx):
+        """Least-loaded UNSATURATED replica of an affinity set, or
+        None when every member is saturated (callers hold the lock)."""
+        best = None
+        for h in reps:
+            i = hex2idx.get(h)
+            if i is None:
+                continue
+            s = self._score(i, h)
+            if s < self.max_ongoing and (best is None or s < best[0]):
+                best = (s, i, h)
+        return best
+
+    def _try_pick_locked(self, model_id: str, prefix_key: str = ""):
         """One routing attempt (callers hold the lock): returns
-        (replica, hex, affinity) or None when every candidate is
+        (replica, hex, affinity, prefix) or None when every candidate is
         saturated. ``affinity`` is the multiplex routing outcome —
         "hit" (an affinity replica had a slot), "spill" (every affinity
         target saturated, pow-2 pick joins the set), "cold" (first
-        request for the model id), "" (no model id)."""
+        request for the model id), "" (no model id). ``prefix`` is the
+        same classification for the (model_id, prefix_key) warm set —
+        a prefix "hit" lands on a replica whose engine holds the
+        prompt's leading KV rows; prefix routing takes precedence over
+        model affinity (a prefix entry implies the model is resident
+        there too: the same replica served that exact workload)."""
         n = len(self.replicas)
         if n == 0:
             return None
         hex2idx = {h: i for i, h in enumerate(self.hexes)}
         affinity = ""
+        prefix = ""
+        if prefix_key:
+            prefix = "cold"
+            pkey = (model_id, prefix_key)
+            preps = self.prefix_affinity.get(pkey)
+            if preps:
+                best = self._best_affine(preps, hex2idx)
+                if best is not None:
+                    self.prefix_affinity.move_to_end(pkey)
+                    preps.move_to_end(best[2])
+                    if model_id:
+                        self._record_affinity(model_id, best[2])
+                    return (self.replicas[best[1]], best[2],
+                            "hit" if model_id else "", "hit")
+                # warm replicas saturated: SPILL — the pow-2 pick below
+                # joins the prefix set and warms up on this request
+                prefix = "spill"
         if model_id:
             affinity = "cold"
             reps = self.model_affinity.get(model_id)
             if reps:
-                best = None
-                for h in reps:
-                    i = hex2idx.get(h)
-                    if i is None:
-                        continue
-                    s = self._score(i, h)
-                    if s < self.max_ongoing and (
-                            best is None or s < best[0]):
-                        best = (s, i, h)
+                best = self._best_affine(reps, hex2idx)
                 if best is not None:
                     self.model_affinity.move_to_end(model_id)
                     reps.move_to_end(best[2])
-                    return self.replicas[best[1]], best[2], "hit"
+                    if prefix_key:
+                        self._record_prefix_affinity(
+                            (model_id, prefix_key), best[2])
+                    return self.replicas[best[1]], best[2], "hit", prefix
                 # every affinity target saturated: SPILL to pow-2 below
                 # (the spill target joins the affinity set)
                 affinity = "spill"
@@ -281,7 +379,9 @@ class _RouterState:
         hex_ = self.hexes[pick]
         if model_id:
             self._record_affinity(model_id, hex_)
-        return self.replicas[pick], hex_, affinity
+        if prefix_key:
+            self._record_prefix_affinity((model_id, prefix_key), hex_)
+        return self.replicas[pick], hex_, affinity, prefix
 
     # ---------------------------------------------------------------- pick
     def _emit_queued(self):
@@ -297,7 +397,7 @@ class _RouterState:
             pass
 
     def pick(self, model_id: str, queue_timeout: float,
-             ctx: Optional[dict] = None):
+             ctx: Optional[dict] = None, prefix_key: str = ""):
         """Pick a replica and charge the local in-flight count; returns
         (replica, done). Parks while every replica is saturated, up to
         ``queue_timeout`` seconds. When a request-context dict rides
@@ -316,9 +416,10 @@ class _RouterState:
                 self.refresh()
                 with self.capacity_freed:
                     n = len(self.replicas)
-                    got = self._try_pick_locked(model_id) if n else None
+                    got = (self._try_pick_locked(model_id, prefix_key)
+                           if n else None)
                     if got is not None:
-                        replica, hex_, affinity = got
+                        replica, hex_, affinity, prefix = got
                         self.inflight[hex_] = self.inflight.get(hex_, 0) + 1
                         if ctx is not None:
                             ctx["router_s"] = (
@@ -327,6 +428,8 @@ class _RouterState:
                             ctx["replica"] = hex_
                             if affinity:
                                 ctx["affinity"] = affinity
+                            if prefix:
+                                ctx["prefix"] = prefix
                         if affinity:
                             self._emit_affinity(affinity)
                         return replica, self._make_done(hex_)
@@ -534,6 +637,7 @@ class DeploymentHandle:
                  retry_on_replica_death: bool = True,
                  queue_timeout_s: Optional[float] = None,
                  request_context: Optional[dict] = None,
+                 prefix_key: str = "",
                  _router: Optional[_RouterState] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
@@ -542,6 +646,9 @@ class DeploymentHandle:
         self.multiplexed_model_id = multiplexed_model_id
         self.retry_on_replica_death = retry_on_replica_death
         self.queue_timeout_s = queue_timeout_s
+        # prompt-prefix routing key (derive_prefix_key): requests
+        # sharing it prefer replicas whose engine holds the warm KV
+        self.prefix_key = prefix_key
         # per-request observability context (serve/request_context.py):
         # the ingress stamps request id / trace carrier here, the router
         # adds park time + affinity, and _submit_once forwards the wire
@@ -562,7 +669,8 @@ class DeploymentHandle:
                 multiplexed_model_id: Optional[str] = None,
                 retry_on_replica_death: Optional[bool] = None,
                 queue_timeout_s: Optional[float] = None,
-                request_context: Optional[dict] = None
+                request_context: Optional[dict] = None,
+                prefix_key: Optional[str] = None
                 ) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
@@ -576,6 +684,7 @@ class DeploymentHandle:
             else queue_timeout_s,
             self.request_context if request_context is None
             else request_context,
+            self.prefix_key if prefix_key is None else prefix_key,
             _router=self._router)  # clones share the router state
 
     # ------------------------------------------------- internals/back-compat
@@ -613,13 +722,26 @@ class DeploymentHandle:
             return (max(1, len(self._router.replicas)),
                     self._router.max_ongoing)
 
+    def capacity_info(self) -> tuple[int, int, int]:
+        """(num_replicas, max_ongoing_requests, live_proxies): the
+        sharded-ingress capacity read — a proxy's admission window is
+        the cluster window over live_proxies, recomputed per request
+        from this (a dead proxy's share redistributes within one table
+        refresh because the survivors read a smaller divisor here)."""
+        self._router.refresh()
+        with self._router.lock:
+            return (max(1, len(self._router.replicas)),
+                    self._router.max_ongoing,
+                    self._router.live_proxies)
+
     # ---------------------------------------------------------------- call
     def _route(self):
         """Pick a replica and charge the family's in-flight count;
         returns (replica, done) where done releases the charge."""
         return self._router.pick(self.multiplexed_model_id,
                                  self._queue_timeout(),
-                                 ctx=self.request_context)
+                                 ctx=self.request_context,
+                                 prefix_key=self.prefix_key)
 
     def _wire_context(self) -> Optional[dict]:
         """The envelope subset of the request context that crosses the

@@ -51,6 +51,12 @@ class _Request:
     # into it (GIL-atomic stores); the replica folds it into the request
     # record after the handler returns. None when not instrumented.
     obs: Optional[dict] = None
+    # disaggregated prefill/decode (generate_prefilled): KV rows that
+    # were prefilled in ANOTHER pool — admit by grafting, skip prefill
+    prefilled: Optional[dict] = None
+    # prefill-pool side (prefill_only): deliver the finished small
+    # cache as the result instead of decoding from it
+    handoff_out: bool = False
 
 
 @dataclass
@@ -95,6 +101,7 @@ class LLMEngine:
                  max_batch: int = 4, max_seq_len: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 128, 512, 1024),
                  prefill_chunk: int = 256,
+                 prefix_cache_entries: int = 8,
                  eos_token_id: int | None = None,
                  params: Any = None, seed: int = 0):
         devices = jax.devices()
@@ -207,11 +214,29 @@ class LLMEngine:
         self._temps = jnp.zeros((max_batch, 1), jnp.float32)
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         self._pending_prefills: list[_PendingPrefill] = []
+        # prefix KV cache: completed prefills park their small-cache
+        # rows here (LRU, `prefix_cache_entries` deep) keyed by the
+        # prompt's first token block; a new prompt sharing a block-
+        # aligned prefix grafts the stored rows and prefills only the
+        # tail. Block size follows the router's prefix key derivation
+        # (RAYT_SERVE_PREFIX_BLOCK) so routed prefix hits land where
+        # the warm rows actually are. 0 entries disables.
+        from collections import OrderedDict
+
+        from ray_tpu.serve.handle import prefix_block_tokens
+
+        self.prefix_cache_entries = int(prefix_cache_entries)
+        self._prefix_block = prefix_block_tokens()
+        self._prefix_store: "OrderedDict[tuple, dict]" = OrderedDict()
         # perf counters (for the serve bench)
         self.generated_tokens = 0
         self.batches = 0       # decode steps executed
         self.prefills = 0
         self.prefill_chunks = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0   # prefill tokens skipped via reuse
+        self.kv_handoffs = 0         # disagg rows admitted via channel
 
     # ------------------------------------------------------------ serving
     async def ensure_started(self):
@@ -282,6 +307,67 @@ class LLMEngine:
             # queue_s / ttft measure from here: the engine saw the
             # request, whatever happens next (queue park, chunked
             # prefill, decode) is engine-attributable time
+            obs["gen_start"] = time.perf_counter()
+        await self._queue.put(req)
+        while True:
+            item = await req.out.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    async def prefill_only(self, tokens: list[int], *,
+                           temperature: float = 0.0) -> dict:
+        """Run ONLY the prefill (chunked as configured, prefix reuse
+        included) and return the KV handoff payload instead of decoding:
+        ``{"k", "v", "first", "bucket", "start"}``. This is the
+        prefill-pool half of a disaggregated deployment — feed the
+        payload to a decode pool's `generate_prefilled`."""
+        limit = max(self.prompt_buckets)
+        if len(tokens) > limit:
+            raise ValueError(
+                f"prompt is {len(tokens)} tokens; this engine's largest "
+                f"prefill bucket is {limit}")
+        await self.ensure_started()
+        try:
+            from ray_tpu.serve.request_context import current_request_obs
+
+            obs = current_request_obs()
+        except Exception:
+            obs = None
+        req = _Request(list(tokens), 1, float(temperature),
+                       loop=asyncio.get_running_loop(), obs=obs,
+                       handoff_out=True)
+        if obs is not None:
+            obs["gen_start"] = time.perf_counter()
+        await self._queue.put(req)
+        item = await req.out.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    async def generate_prefilled(self, tokens: list[int], handoff: dict,
+                                 *, max_new_tokens: int = 32,
+                                 temperature: float = 0.0):
+        """Async generator over decode-only generation from KV rows
+        prefilled in ANOTHER pool (`prefill_only`'s payload, typically
+        arriving as one device-channel tick). The first token was
+        sampled by the prefill pool and streams out immediately; this
+        engine never runs the prompt — long prefills can no longer dip
+        its decode-batch occupancy."""
+        await self.ensure_started()
+        try:
+            from ray_tpu.serve.request_context import current_request_obs
+
+            obs = current_request_obs()
+        except Exception:
+            obs = None
+        req = _Request(list(tokens), int(max_new_tokens),
+                       float(temperature),
+                       loop=asyncio.get_running_loop(), obs=obs,
+                       prefilled=dict(handoff))
+        if obs is not None:
             obs["gen_start"] = time.perf_counter()
         await self._queue.put(req)
         while True:
@@ -371,22 +457,66 @@ class LLMEngine:
             self._decode_cache = None
             raise
         slot = next(i for i, s in enumerate(self._slots) if s is None)
+        if req.prefilled is not None:
+            # disaggregated handoff: the prefill pool already produced
+            # these KV rows — graft them and go straight to decode
+            self._admit_prefilled_locked(req, slot)
+            return
         toks = req.tokens  # generate() enforces len <= max bucket
         bucket = _bucket(len(toks), self.prompt_buckets)
+        start = bucket - len(toks)
         prompts = np.zeros((1, bucket), np.int32)
-        prompts[0, bucket - len(toks):] = toks
+        prompts[0, start:] = toks
 
         small = llama.init_kv_cache(cfg, 1, max_len=bucket)
-        small["start"] = jnp.asarray([bucket - len(toks)], jnp.int32)
+        small["start"] = jnp.asarray([start], jnp.int32)
         small = jax.device_put(small, self._cache_sharding)
+        entry, matched = self._prefix_lookup(toks)
+        if matched:
+            # prefix hit: graft the stored rows at this prompt's start
+            # offset (KV content is start-RELATIVE — models/llama.py
+            # rope positions — so rows are reusable across layouts) and
+            # resume the prefill at the first un-cached token
+            pos0 = start + matched
+            small = self._graft_prefix(small, entry, pos0 - matched,
+                                       matched)
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += matched
+            if obs is not None:
+                obs["prefix_cache"] = "hit"
+                obs["prefix_hit_tokens"] = matched
+            if self.prefill_chunk and \
+                    bucket - pos0 > self.prefill_chunk:
+                self._slots[slot] = _Slot(req, emitted=-1, length=0)
+                self._pending_prefills.append(_PendingPrefill(
+                    req=req, slot=slot, prompts=prompts, small=small,
+                    bucket=bucket, pos=pos0))
+                return
+            temps1 = jnp.asarray([[req.temperature]], np.float32)
+            t_pf = time.perf_counter()
+            nxt, small, self._key = self._step(
+                self.params, small, jnp.asarray(prompts[:, pos0:]),
+                self._key, temps1)
+            self.prefills += 1
+            if obs is not None:
+                obs["prefill_s"] = obs.get("prefill_s", 0.0) + (
+                    time.perf_counter() - t_pf)
+                obs["prefill_chunks"] = obs.get("prefill_chunks", 0) + 1
+            self._finish_prefill(req, slot, small,
+                                 int(np.asarray(nxt)[0]), bucket, start)
+            return
+        if (self.prefix_cache_entries and self._prefix_block
+                and len(toks) > self._prefix_block):
+            self.prefix_misses += 1
+            if obs is not None:
+                obs["prefix_cache"] = "cold"
         if self.prefill_chunk and bucket > self.prefill_chunk:
             # long prompt: reserve the slot, prefill chunk-by-chunk
             # between decode steps (engine loop drives _advance_prefill).
             # Left-pad chunks are skipped entirely: they carry no
             # information (masked by `start`), so begin at the last
             # chunk boundary before the first real token.
-            skip = ((bucket - len(toks)) // self.prefill_chunk
-                    ) * self.prefill_chunk
+            skip = (start // self.prefill_chunk) * self.prefill_chunk
             if skip:
                 small["length"] = jnp.int32(skip)
             self._slots[slot] = _Slot(req, emitted=-1, length=0)
@@ -404,7 +534,74 @@ class LLMEngine:
                 time.perf_counter() - t_pf)
             obs["prefill_chunks"] = obs.get("prefill_chunks", 0) + 1
         self._finish_prefill(req, slot, small, int(np.asarray(nxt)[0]),
-                             bucket, bucket - len(toks))
+                             bucket, start)
+
+    # ----------------------------------------------- prefix KV reuse
+    def _prefix_lookup(self, toks: list) -> tuple[Optional[dict], int]:
+        """Longest block-aligned reusable prefix for `toks` among the
+        stored entries (callers hold _mutex). Returns (entry, matched);
+        matched is a multiple of the prefix block, capped one short of
+        the full prompt so the tail prefill always has >= 1 token to
+        produce the first sampled logits from."""
+        block = self._prefix_block
+        if (not self.prefix_cache_entries or not block
+                or len(toks) <= block):
+            return None, 0
+        entry = self._prefix_store.get(tuple(toks[:block]))
+        if entry is None:
+            return None, 0
+        self._prefix_store.move_to_end(tuple(toks[:block]))
+        etoks = entry["tokens"]
+        limit = min(len(etoks), len(toks) - 1)
+        n = 0
+        while n < limit and etoks[n] == toks[n]:
+            n += 1
+        matched = (n // block) * block
+        return (entry, matched) if matched >= block else (None, 0)
+
+    def _graft_prefix(self, small, entry: dict, off: int,
+                      matched: int) -> dict:
+        """Copy `matched` stored KV rows into the fresh per-request
+        cache at absolute position `off` and advance its write cursor.
+        Runs op-by-op outside jit (concrete sizes; one dispatch pair per
+        distinct (bucket, matched) — bounded by the block grid)."""
+        e_off = int(entry["start"])
+        for key_ in ("k", "v"):
+            src = entry[key_]
+            seg = jax.lax.dynamic_slice(
+                src, (0, 0, e_off, 0, 0),
+                (src.shape[0], 1, matched, src.shape[3], src.shape[4]))
+            small[key_] = jax.lax.dynamic_update_slice(
+                small[key_], seg, (0, 0, off, 0, 0))
+        small["length"] = jnp.int32(off + matched)
+        return small
+
+    def _prefix_put(self, tokens: list, small, bucket: int):
+        """Park a finished prefill's rows in the LRU (callers hold
+        _mutex). Entries key on the first token block; a same-key store
+        replaces (latest wins — the warm set stays small and fresh)."""
+        block = self._prefix_block
+        if (not self.prefix_cache_entries or not block
+                or len(tokens) <= block):
+            return
+        key = tuple(tokens[:block])
+        self._prefix_store[key] = {
+            "tokens": list(tokens), "k": small["k"], "v": small["v"],
+            "start": bucket - len(tokens), "bucket": bucket}
+        self._prefix_store.move_to_end(key)
+        while len(self._prefix_store) > self.prefix_cache_entries:
+            self._prefix_store.popitem(last=False)
+
+    def _admit_prefilled_locked(self, req: _Request, slot: int):
+        h = req.prefilled
+        kv = jax.device_put(
+            {"k": h["k"], "v": h["v"]},
+            {"k": self._cache_sharding["k"],
+             "v": self._cache_sharding["v"]})
+        self.kv_handoffs += 1
+        self._finish_prefill(req, slot, kv, int(h["first"]),
+                             int(h["bucket"]), int(h["start"]),
+                             store=False)
 
     def _advance_prefill(self, epoch: int):
         with self._mutex:
@@ -451,9 +648,24 @@ class LLMEngine:
                 raise
 
     def _finish_prefill(self, req: _Request, slot: int, small, first: int,
-                        bucket: int, start: int):
+                        bucket: int, start: int, store: bool = True):
         """Deliver the prefill's sampled token and graft the KV rows
         into the slot (callers hold _mutex)."""
+        if store:
+            # park the rows for prefix reuse BEFORE any donation can
+            # touch them (insert_row leaves small's arrays alive; the
+            # store holds its own refs)
+            self._prefix_put(req.tokens, small, bucket)
+        if req.handoff_out:
+            # prefill-pool side of a disaggregated deployment: the
+            # result IS the KV handoff payload — the decode pool grafts
+            # it via generate_prefilled. No slot, no insert, no decode.
+            req.loop.call_soon_threadsafe(
+                req.out.put_nowait,
+                {"k": small["k"], "v": small["v"], "first": int(first),
+                 "bucket": int(bucket), "start": int(start)})
+            req.loop.call_soon_threadsafe(req.out.put_nowait, None)
+            return
         if self.eos_token_id is not None and first == self.eos_token_id:
             req.loop.call_soon_threadsafe(req.out.put_nowait, None)
             return
@@ -558,6 +770,11 @@ class LLMEngine:
                 "batches": self.batches,
                 "prefills": self.prefills,
                 "prefill_chunks": self.prefill_chunks,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefix_entries": len(self._prefix_store),
+                "kv_handoffs": self.kv_handoffs,
                 "active_slots": sum(1 for s in self._slots
                                     if s is not None),
                 "tp": self.mesh.shape.get("tensor", 1)}
@@ -685,3 +902,201 @@ def lora_llm_app(preset: str = "debug", *, num_replicas: int = 1,
     return dep.bind(preset,
                     max_adapters_per_replica=max_adapters_per_replica,
                     **engine_kw)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode serving
+# ---------------------------------------------------------------------------
+
+PREFILL_REPLICAS_ENV = "RAYT_SERVE_PREFILL_REPLICAS"
+DECODE_REPLICAS_ENV = "RAYT_SERVE_DECODE_REPLICAS"
+
+
+def _pool_size(env: str, default: int) -> int:
+    import os
+
+    try:
+        return max(1, int(os.environ.get(env, default)))
+    except (TypeError, ValueError):
+        return default
+
+
+def _edge_kind(channel, spec) -> str:
+    """Classify a KV-handoff edge for accounting: ``device`` when both
+    sides share a jax client (same-process handoff, buffers never leave
+    the device plane), ``dcn`` when the transport spec rides the
+    cross-host DCN store, ``shm`` for the same-host shared-memory ring."""
+    from ray_tpu.dag.device_channel import DeviceChannel
+
+    if isinstance(channel, DeviceChannel):
+        return "device"
+    try:
+        from ray_tpu.dag.dcn_channel import DcnChannelSpec
+
+        if isinstance(getattr(spec, "inner", None), DcnChannelSpec):
+            return "dcn"
+    except Exception:
+        pass
+    return "shm"
+
+
+class PrefillWorker:
+    """Prefill half of a disaggregated llm deployment (deploy via
+    ``disagg_llm_app``). One call = one prompt's prefill: run it
+    (chunked, prefix-cache included), then hand the finished KV rows to
+    the caller's decode pool as ONE device-channel tick — raw shard
+    bytes over the framing in dag/device_channel.py, never a generic
+    pickle of the arrays.
+
+    Payload: ``{"tokens": [ids], "temperature": float,
+    "chan": DeviceChannelSpec}`` — the decode side owns the channel and
+    is already blocked on the read. Returns a handoff summary
+    ``{"bytes", "edge_kind", "n_arrays", "bucket", "start"}``.
+    """
+
+    def __init__(self, preset: str = "debug", **engine_kw):
+        self.engine = LLMEngine(preset, **engine_kw)
+
+    async def __call__(self, payload: dict) -> dict:
+        from ray_tpu.dag.dcn_channel import attach_channel
+        from ray_tpu.dag.device_channel import tree_nbytes
+
+        spec = payload["chan"]
+        tokens = [int(t) for t in payload["tokens"]]
+        handoff = await self.engine.prefill_only(
+            tokens, temperature=float(payload.get("temperature", 0.0)))
+        nbytes = int(tree_nbytes({"k": handoff["k"], "v": handoff["v"]}))
+        loop = asyncio.get_running_loop()
+        ch = await loop.run_in_executor(None, attach_channel, spec)
+        kind = _edge_kind(ch, spec)
+        try:
+            # one tick, written from an executor thread (the ring may
+            # block until the decode side frees a slot)
+            await loop.run_in_executor(
+                None, lambda: ch.write(dict(handoff), timeout=30.0))
+            n_arrays = int(getattr(ch, "device_arrays", 0))
+        finally:
+            ch.close()
+        try:
+            from ray_tpu.serve.request_context import current_request_obs
+
+            obs = current_request_obs()
+        except Exception:
+            obs = None
+        if obs is not None:
+            obs["pool"] = "prefill"
+            obs["kv_handoff_bytes"] = nbytes
+            obs["kv_handoff_edge"] = kind
+        return {"bytes": nbytes, "edge_kind": kind,
+                "n_arrays": n_arrays, "bucket": int(handoff["bucket"]),
+                "start": int(handoff["start"])}
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+
+class DecodeLlamaService:
+    """Decode half of a disaggregated llm deployment: same request
+    payload as LlamaService, but the prompt never runs here. Per
+    request it creates a private shm ring, asks the prefill pool to
+    fill it (the request id and trace carrier ride the composed handle
+    call, so both pools' partial records coalesce into ONE waterfall),
+    reads the KV rows as one tick, and decodes from them — long
+    prefills can no longer dip this pool's decode-batch occupancy.
+    """
+
+    def __init__(self, prefill, preset: str = "debug", **engine_kw):
+        self.engine = LLMEngine(preset, **engine_kw)
+        self._prefill = prefill  # DeploymentHandle (composed app node)
+        cfg = self.engine.cfg
+        bucket = max(self.engine.prompt_buckets)
+        # one tick = one prompt's k+v rows (+ pickle framing): assume
+        # <=4-byte elements and pad 25% + 64KiB so the slot always fits
+        kv = 2 * cfg.n_layers * bucket * cfg.n_kv_heads * cfg.head_dim * 4
+        self._slot_size = kv + kv // 4 + (1 << 16)
+
+    def _request_context(self, obs) -> Optional[dict]:
+        if not obs or not obs.get("request_id"):
+            return None
+        return {"request_id": obs["request_id"], "trace": obs.get("trace")}
+
+    async def __call__(self, payload: dict):
+        from ray_tpu.dag.channel import ShmChannel
+        from ray_tpu.dag.device_channel import (DeviceChannelSpec,
+                                                DeviceTransportChannel)
+
+        tokens = payload["tokens"]
+        if isinstance(tokens, str):  # raw byte-level "tokenizer"
+            tokens = [b % self.engine.cfg.vocab_size
+                      for b in tokens.encode()]
+        try:
+            from ray_tpu.serve.request_context import current_request_obs
+
+            obs = current_request_obs()
+        except Exception:
+            obs = None
+        loop = asyncio.get_running_loop()
+        # per-request ring: the shm channel is strictly SPSC, so each
+        # handoff gets its own (decode owns it and unlinks on close)
+        shm = await loop.run_in_executor(
+            None, lambda: ShmChannel.create(
+                slot_size=self._slot_size, n_slots=2))
+        spec = DeviceChannelSpec(name=shm.spec.name, inner=shm.spec)
+        ch = DeviceTransportChannel(shm, spec)
+        try:
+            handle = self._prefill
+            rctx = self._request_context(obs)
+            if rctx is not None:
+                handle = handle.options(request_context=rctx)
+            req = {"tokens": tokens, "chan": spec,
+                   "temperature": float(payload.get("temperature", 0.0))}
+            # summary first (it surfaces prefill errors with their real
+            # traceback), then the tick — which is already in the ring,
+            # the prefill side writes it before returning
+            summary = await loop.run_in_executor(
+                None, lambda: handle.remote(req).result(timeout=120.0))
+            tick = await loop.run_in_executor(
+                None, lambda: ch.read(timeout=30.0))
+        finally:
+            ch.close()
+        if obs is not None:
+            # kv_handoff_* stays OFF this side's record: the prefill
+            # partial carries it, and the GCS derives the bytes counter
+            # at partial ingest — a second stamp would double-count
+            obs["pool"] = "decode"
+        async for tok in self.engine.generate_prefilled(
+                tokens,
+                {k: tick[k] for k in ("k", "v", "first", "bucket",
+                                      "start")},
+                max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                temperature=float(payload.get("temperature", 0.0))):
+            yield {"token": int(tok)}
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+
+def disagg_llm_app(preset: str = "debug", *,
+                   prefill_replicas: int | None = None,
+                   decode_replicas: int | None = None,
+                   max_ongoing_requests: int = 64, **engine_kw):
+    """Serve application with disaggregated prefill/decode pools: the
+    decode pool is the ingress; each request's prefill runs in the
+    prefill pool and hands its KV rows over a device-channel edge. Pool
+    sizes default from RAYT_SERVE_PREFILL_REPLICAS /
+    RAYT_SERVE_DECODE_REPLICAS (1 each). Both pools build identical
+    weights (same preset + seed), so KV rows graft across them."""
+    from ray_tpu.serve.deployment import deployment
+
+    if prefill_replicas is None:
+        prefill_replicas = _pool_size(PREFILL_REPLICAS_ENV, 1)
+    if decode_replicas is None:
+        decode_replicas = _pool_size(DECODE_REPLICAS_ENV, 1)
+    prefill_dep = deployment(
+        PrefillWorker, num_replicas=prefill_replicas,
+        max_ongoing_requests=max_ongoing_requests)
+    decode_dep = deployment(
+        DecodeLlamaService, num_replicas=decode_replicas,
+        max_ongoing_requests=max_ongoing_requests)
+    return decode_dep.bind(prefill_dep.bind(preset, **engine_kw),
+                           preset, **engine_kw)

@@ -43,13 +43,20 @@ PROXY_THREADS_ENV = "RAYT_SERVE_PROXY_THREADS"
 # scaling within this bound without an RPC per request
 CAPACITY_TTL_S = 1.0
 
+# controller heartbeat cadence: liveness TTL is 3x this (see
+# controller.PROXY_TTL_S), so a dead proxy's window share redistributes
+# to the survivors within one capacity refresh after the TTL lapses
+HEARTBEAT_PERIOD_S = 1.0
+
 
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
                  request_timeout_s: float | None = None,
-                 admission_headroom: float | None = None):
+                 admission_headroom: float | None = None,
+                 proxy_id: str = "http-0"):
         self.host = host
         self.port = port
+        self.proxy_id = proxy_id
         self._handles: dict[str, Any] = {}
         self._ingress: dict[str, str] = {}
         self._runner = None
@@ -57,9 +64,10 @@ class ProxyActor:
         self._aux_executor = None   # capacity refreshes (never starved
         # by admitted requests parking on results)
         self._timeout_override = request_timeout_s
-        self._admission = AdmissionWindow(admission_headroom)
-        self._capacity: dict[str, tuple[int, int, float]] = {}
+        self._admission = AdmissionWindow(admission_headroom, proxy_id)
+        self._capacity: dict[str, tuple[int, int, int, float]] = {}
         self._cap_refreshing: set[str] = set()
+        self._hb_task = None
 
     async def start(self) -> int:
         from concurrent.futures import ThreadPoolExecutor
@@ -84,7 +92,31 @@ class ProxyActor:
         for s in site._server.sockets:
             self.port = s.getsockname()[1]
             break
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
         return self.port
+
+    async def _heartbeat_loop(self):
+        """Announce liveness to the controller ~1/s. ``live_proxies``
+        rides the routing table back to every proxy's window math, so
+        this beat is all the fleet coordination there is: a member that
+        stops beating ages out after controller.PROXY_TTL_S and its
+        admission share redistributes on the next capacity refresh."""
+        import ray_tpu as rt
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        loop = asyncio.get_running_loop()
+
+        def _beat():
+            try:
+                controller = rt.get_actor(CONTROLLER_NAME)
+                rt.get(controller.proxy_heartbeat.remote(
+                    self.proxy_id, "http", self.port), timeout=5)
+            except Exception:
+                pass  # controller bouncing: keep serving, beat again
+
+        while True:
+            await loop.run_in_executor(self._aux_executor, _beat)
+            await asyncio.sleep(HEARTBEAT_PERIOD_S)
 
     def register_app(self, app_name: str, ingress_deployment: str) -> bool:
         self._ingress[app_name] = ingress_deployment
@@ -111,7 +143,8 @@ class ProxyActor:
     async def _admission_endpoint(self, request):
         from aiohttp import web
 
-        return web.json_response(self._admission.snapshot())
+        return web.json_response({**self._admission.snapshot(),
+                                  **self._admission.fleet_snapshot()})
 
     def _request_timeout(self) -> float:
         if self._timeout_override is not None:
@@ -124,48 +157,52 @@ class ProxyActor:
         from aiohttp import web
 
         retry = retry_after_s()
-        count_shed(app_name, "http", reason)
+        count_shed(app_name, self.proxy_id, reason)
         return web.json_response(
             {"error": detail, "reason": reason, "retry_after_s": retry},
             status=503,
             headers={"Retry-After": str(retry),
-                     "X-Rayt-Reason": reason})
+                     "X-Rayt-Reason": reason,
+                     "X-Rayt-Proxy-Id": self.proxy_id})
 
     async def _app_capacity(self, app_name: str, handle,
-                            loop) -> tuple[int, int]:
-        """(replicas, max_ongoing) from the ~1s cache. Only the COLD
-        read (first request for an app) waits on an RPC — and on the
-        aux executor, not the request executor, so a saturated proxy
-        still sheds instantly. Stale entries refresh in the background
-        while the current value keeps serving decisions."""
+                            loop) -> tuple[int, int, int]:
+        """(replicas, max_ongoing, live_proxies) from the ~1s cache.
+        Only the COLD read (first request for an app) waits on an RPC —
+        and on the aux executor, not the request executor, so a
+        saturated proxy still sheds instantly. Stale entries refresh in
+        the background while the current value keeps serving decisions.
+        live_proxies riding this same refresh is what redistributes a
+        dead proxy's admission share within one table refresh."""
         cap = self._capacity.get(app_name)
         now = time.monotonic()
         if cap is None:
             try:
-                replicas, max_ongoing = await loop.run_in_executor(
-                    self._aux_executor, handle.capacity)
+                replicas, max_ongoing, live = await loop.run_in_executor(
+                    self._aux_executor, handle.capacity_info)
             except Exception:
-                replicas, max_ongoing = 1, 16  # table warming up
-            self._capacity[app_name] = (replicas, max_ongoing,
+                replicas, max_ongoing, live = 1, 16, 1  # table warming up
+            self._capacity[app_name] = (replicas, max_ongoing, live,
                                         time.monotonic())
-            return replicas, max_ongoing
-        replicas, max_ongoing, ts = cap
+            return replicas, max_ongoing, live
+        replicas, max_ongoing, live, ts = cap
         if now - ts > CAPACITY_TTL_S and \
                 app_name not in self._cap_refreshing:
             self._cap_refreshing.add(app_name)
 
             def _refresh():
                 try:
-                    r, m = handle.capacity()
-                    self._capacity[app_name] = (r, m, time.monotonic())
+                    r, m, lp = handle.capacity_info()
+                    self._capacity[app_name] = (r, m, lp,
+                                                time.monotonic())
                 except Exception:
                     self._capacity[app_name] = (replicas, max_ongoing,
-                                                time.monotonic())
+                                                live, time.monotonic())
                 finally:
                     self._cap_refreshing.discard(app_name)
 
             self._aux_executor.submit(_refresh)
-        return replicas, max_ongoing
+        return replicas, max_ongoing, live
 
     async def _dispatch(self, request):
         from aiohttp import web
@@ -190,7 +227,8 @@ class ProxyActor:
         from ray_tpu.serve.request_context import mint_request_id
 
         rid = mint_request_id()
-        ctx = {"request_id": rid, "start_ts": time.time()}
+        ctx = {"request_id": rid, "start_ts": time.time(),
+               "proxy": self.proxy_id}
         if request.can_read_body:
             try:
                 payload = await request.json()
@@ -217,20 +255,22 @@ class ProxyActor:
                 pass
             # ---- admission: window sized from the (cached) routing-
             # table capacity; accept/shed is sync + fast on the event
-            # loop
-            replicas, max_ongoing = await self._app_capacity(
+            # loop. This proxy admits its SHARE of the cluster window
+            # (cluster / live_proxies) — see serve/admission.py.
+            replicas, max_ongoing, live = await self._app_capacity(
                 app_name, handle, loop)
             if not self._admission.try_acquire(app_name, replicas,
-                                               max_ongoing):
+                                               max_ongoing, live):
                 resp = self._unavailable(
                     app_name, "shed",
                     f"admission window full for app {app_name!r} (window="
-                    f"{self._admission.window_for(replicas, max_ongoing)})")
+                    f"{self._admission.window_for(replicas, max_ongoing, live)}"
+                    f", live_proxies={live})")
                 resp.headers["X-Rayt-Request-Id"] = rid
                 self._finish_record(ctx, app_name, "shed", t0=t0)
                 return resp
             t1 = time.perf_counter()
-            count_admitted(app_name, "http")
+            count_admitted(app_name, self.proxy_id)
             # model multiplexing (ref: serve proxy forwards the model-id
             # header); the router's capacity-gate park is bounded by the
             # request timeout — a request that can't find a replica slot
@@ -240,11 +280,18 @@ class ProxyActor:
 
             model_id = request.headers.get("serve_multiplexed_model_id",
                                            "")
+            # prefix-cache-aware routing: hash the prompt's leading
+            # token block into a key the router's prefix-affinity LRU
+            # steers toward replicas holding the warm KV state
+            from ray_tpu.serve.handle import derive_prefix_key
+
+            prefix_key = derive_prefix_key(payload)
             handle = handle.options(
                 multiplexed_model_id=model_id or None,
                 queue_timeout_s=min(queue_timeout_s(),
                                     self._request_timeout()),
-                request_context=ctx)
+                request_context=ctx,
+                prefix_key=prefix_key or None)
             try:
                 if wants_stream:
                     return await self._dispatch_stream(
@@ -329,6 +376,10 @@ class ProxyActor:
                 rec["replica"] = ctx["replica"]
             if ctx.get("affinity"):
                 rec["affinity"] = ctx["affinity"]
+            if ctx.get("proxy"):
+                rec["proxy"] = ctx["proxy"]
+            if ctx.get("prefix"):
+                rec["prefix_cache"] = ctx["prefix"]
             if ttft_s is not None:
                 rec["ttft_s"] = ttft_s
             if tpot_s is not None:
@@ -351,6 +402,7 @@ class ProxyActor:
         except Exception as e:
             resp = self._error_response(app_name, e)
             resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+            resp.headers["X-Rayt-Proxy-Id"] = self.proxy_id
             self._finish_record(ctx, app_name, self._outcome_for(e),
                                 t0=t0, t1=t1, model_id=model_id)
             return resp
@@ -362,6 +414,7 @@ class ProxyActor:
         else:
             resp = web.Response(body=str(response).encode())
         resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+        resp.headers["X-Rayt-Proxy-Id"] = self.proxy_id
         return resp
 
     def _observe_stream_latency(self, app_name: str, seconds: float):
@@ -396,13 +449,15 @@ class ProxyActor:
         except Exception as e:
             resp = self._error_response(app_name, e)
             resp.headers["X-Rayt-Request-Id"] = ctx["request_id"]
+            resp.headers["X-Rayt-Proxy-Id"] = self.proxy_id
             self._finish_record(ctx, app_name, self._outcome_for(e),
                                 t0=t0, t1=t1, model_id=model_id)
             return resp
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
                      "Cache-Control": "no-cache",
-                     "X-Rayt-Request-Id": ctx["request_id"]})
+                     "X-Rayt-Request-Id": ctx["request_id"],
+                     "X-Rayt-Proxy-Id": self.proxy_id})
         await resp.prepare(request)
         # TTFT stamps at the FIRST SSE chunk, the total at stream END —
         # a streaming request's latency is its last byte, not the

@@ -105,7 +105,14 @@ class ReplicaActor:
             from ray_tpu._internal.otel import execute_span
             from ray_tpu.serve.request_context import _set_request_obs
 
-            obs: dict = {}
+            # the request's identity rides in obs so a composed callable
+            # can forward it across its own handle calls (disagg
+            # decode->prefill: same id, both sides coalesce into ONE
+            # waterfall); engine_section() whitelists its output keys,
+            # so identity never leaks into the engine record
+            obs: dict = {"request_id": ctx["request_id"]}
+            if ctx.get("trace"):
+                obs["trace"] = ctx["trace"]
             token = _set_request_obs(obs)
             span = execute_span(
                 "serve.replica", ctx.get("trace"),

@@ -190,4 +190,15 @@ def engine_section(obs: Optional[dict]) -> Optional[dict]:
     steps = out["decode_steps"]
     if steps:
         out["occupancy_mean"] = obs.get("occupancy_sum", 0.0) / steps
+    for k in ("prefix_cache", "prefix_hit_tokens", "kv_handoff_bytes",
+              "kv_handoff_edge"):
+        if obs.get(k) is not None:
+            out[k] = obs[k]
+    if obs.get("pool"):
+        # one half of a disagg prefill/decode pair: this side's
+        # structural zeros (decode counters on the prefill record,
+        # chunk counts on the decode record) would clobber the other
+        # half's real values at GCS coalesce time — merge order is
+        # flush-cadence luck, so ship only the phases this pool ran
+        out = {k: v for k, v in out.items() if v not in (None, 0)}
     return out

@@ -355,6 +355,32 @@ def serve_engine_metric_records(app: str, deployment: str, replica: str,
     return recs
 
 
+def serve_data_plane_metric_records(app: str, *, prefix_outcome=None,
+                                    proxy=None, kv_bytes: int = 0,
+                                    edge_kind: str = "",
+                                    ts: float = 0.0) -> list:
+    """Serve data-plane counters, derived by the GCS serve manager from
+    every finalized request record (before tail-biased sampling):
+    prefix-cache routing outcome (hit / spill / cold), per-proxy
+    admission attribution across the sharded ingress fleet, and KV
+    handoff volume per edge kind for disaggregated prefill/decode."""
+    recs = []
+    if prefix_outcome:
+        recs.append({"name": "rayt_serve_prefix_cache_total",
+                     "kind": "counter", "value": 1.0,
+                     "tags": {"app": app, "outcome": str(prefix_outcome)},
+                     "ts": ts})
+    if proxy:
+        recs.append({"name": "rayt_serve_proxy_admitted_total",
+                     "kind": "counter", "value": 1.0,
+                     "tags": {"proxy": str(proxy)}, "ts": ts})
+    if kv_bytes:
+        recs.append({"name": "rayt_serve_kv_handoff_bytes_total",
+                     "kind": "counter", "value": float(kv_bytes),
+                     "tags": {"edge_kind": edge_kind or "shm"}, "ts": ts})
+    return recs
+
+
 def heartbeat_gap_records(gaps: dict, *, ts: float) -> list:
     """Per-node heartbeat-gap gauges (seconds since the node's last
     heartbeat reached the GCS) — the liveness staleness `rayt status`

@@ -458,7 +458,7 @@ def test_affinity_survives_refresh_clears_on_removal():
     st = _RouterState("dep", "app")
     st.apply_route_info(_route_info(st.key, 1, [r1, r2]))
     with st.lock:
-        _, hx, aff = st._try_pick_locked("m1")
+        _, hx, aff, _ = st._try_pick_locked("m1")
     assert aff == "cold"  # first request for the model id
     assert list(st.model_affinity["m1"]) == [hx]
     # version-unchanged refresh (update None): affinity survives
@@ -473,7 +473,7 @@ def test_affinity_survives_refresh_clears_on_removal():
     assert "m1" not in st.model_affinity
     # other models keyed to the surviving replica would have stayed
     with st.lock:
-        _, hx2, _ = st._try_pick_locked("m2")
+        _, hx2, _, _ = st._try_pick_locked("m2")
     assert hx2 == keep._actor_id.hex()
     st.apply_route_info(_route_info(st.key, 4, [keep]))
     assert "m2" in st.model_affinity
@@ -507,21 +507,82 @@ def test_affinity_spills_on_saturation_and_grows_set():
     st = _RouterState("dep", "app")
     st.apply_route_info(_route_info(st.key, 1, [r1, r2], max_ongoing=2))
     with st.lock:
-        _, hx, aff = st._try_pick_locked("m1")
+        _, hx, aff, _ = st._try_pick_locked("m1")
         assert aff == "cold"
         # sticky while unsaturated, even under some load
         st.inflight[hx] = 1
-        _, hx_b, aff_b = st._try_pick_locked("m1")
+        _, hx_b, aff_b, _ = st._try_pick_locked("m1")
         assert hx_b == hx and aff_b == "hit"
         # saturate the affinity target: the pick spills to the OTHER
         # replica and records it in the affinity set
         st.inflight[hx] = 2
-        _, hx2, aff2 = st._try_pick_locked("m1")
+        _, hx2, aff2, _ = st._try_pick_locked("m1")
         assert hx2 != hx and aff2 == "spill"
         assert list(st.model_affinity["m1"]) == [hx, hx2]
         # both saturated -> no pick (the gate parks the request)
         st.inflight[hx2] = 2
         assert st._try_pick_locked("m1") is None
+
+
+def test_prefix_affinity_survives_table_churn_clears_on_removal():
+    """Regression (tentpole): the (model, prefix) warm-set LRU under
+    routing-table version churn with a sharded ingress — every proxy's
+    router refreshes the table independently, so a benign refresh
+    (version bump, same replica set) must keep warm prefix entries and
+    the fleet's live_proxies count, while removing a warm replica
+    evicts exactly its entries."""
+    from ray_tpu.serve.handle import _RouterState
+
+    r1, r2 = _FakeReplica("aa"), _FakeReplica("bb")
+    # two ingress proxies = two independent router states over the SAME
+    # routing table (each admits its share of the cluster window)
+    st, st2 = _RouterState("dep", "app"), _RouterState("dep", "app")
+    info = _route_info(st.key, 1, [r1, r2])
+    info["live_proxies"] = 2
+    st.apply_route_info(dict(info))
+    st2.apply_route_info(dict(info))
+    assert st.live_proxies == 2 and st2.live_proxies == 2
+    with st.lock:
+        _, hx, _, pfx = st._try_pick_locked("", prefix_key="pk1")
+    assert pfx == "cold"  # first request for the prefix
+    assert list(st.prefix_affinity[("", "pk1")]) == [hx]
+    # the other proxy's router is independently cold for the prefix
+    with st2.lock:
+        _, _, _, pfx_other = st2._try_pick_locked("", prefix_key="pk1")
+    assert pfx_other == "cold"
+    # benign churn: version-unchanged refresh, then a version bump with
+    # the same replica set — warm entries survive both
+    st.apply_route_info({"update": None, "load": {},
+                         "max_ongoing": 4, "live_proxies": 2})
+    st.apply_route_info({**_route_info(st.key, 2, [r1, r2]),
+                         "live_proxies": 2})
+    assert list(st.prefix_affinity[("", "pk1")]) == [hx]
+    with st.lock:
+        _, hx_b, _, pfx_b = st._try_pick_locked("", prefix_key="pk1")
+    assert hx_b == hx and pfx_b == "hit"
+    # saturate the warm replica: the pick spills and the spill target
+    # joins the prefix's warm set
+    with st.lock:
+        st.inflight[hx] = 4
+        _, hx2, _, pfx2 = st._try_pick_locked("", prefix_key="pk1")
+    assert hx2 != hx and pfx2 == "spill"
+    assert list(st.prefix_affinity[("", "pk1")]) == [hx, hx2]
+    # a proxy death redistributes the window on the NEXT refresh — no
+    # table change, so warm entries are untouched
+    st.apply_route_info({"update": None, "load": {},
+                         "max_ongoing": 4, "live_proxies": 1})
+    assert st.live_proxies == 1
+    assert list(st.prefix_affinity[("", "pk1")]) == [hx, hx2]
+    # removing one warm replica evicts exactly its entry...
+    keep = r1 if hx2 == "aa" else r2
+    st.apply_route_info({**_route_info(st.key, 3, [keep]),
+                         "live_proxies": 1})
+    assert list(st.prefix_affinity[("", "pk1")]) == \
+        [keep._actor_id.hex()]
+    # ...and removing the last one drops the prefix key entirely
+    st.apply_route_info({**_route_info(st.key, 4, []),
+                         "live_proxies": 1})
+    assert ("", "pk1") not in st.prefix_affinity
 
 
 def test_multiplex_lru_instance_override_and_residency():

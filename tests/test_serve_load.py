@@ -14,8 +14,17 @@ capacity — and floors:
 * Prometheus counters: rayt_serve_{shed,admitted}_total and the
   autoscale decision gauge are emitting cluster-wide.
 
-CLI twin refreshing SERVE_BENCH.json:
-``python tools/serve_bench.py --leg sustained``.
+ISSUE 19 adds the ``multi_proxy`` floor gates: sharded-ingress fan-out
+with a mid-burst proxy kill (admitted QPS floor, zero admitted
+failures, per-proxy window shares summing to the cluster window within
+5%, redistribution within one liveness TTL), prefix KV-reuse (hit-rate
+and hit-TTFT-vs-cold floors), and disaggregated prefill/decode (decode
+occupancy must not dip vs fused; KV handoff rides the shm/device edge
+with zero pickle fallbacks).
+
+CLI twins refreshing SERVE_BENCH.json:
+``python tools/serve_bench.py --leg sustained`` /
+``--leg multi_proxy``.
 """
 
 from __future__ import annotations
@@ -133,3 +142,88 @@ def test_request_latency_floors_and_waterfall_tiling():
     # within the same order of magnitude
     assert wf.get("replica_service_mean_ms") is not None, wf
     assert wf.get("ttft_mean_ms") is not None, wf
+
+
+# multi_proxy leg (ISSUE 19) floors. Committed SERVE_BENCH.json on this
+# class of box: fanout 236 admitted qps across 3 proxies with 0
+# timeouts/500s, share error 3.1% before / 0% after the kill,
+# redistribution 3.6s; prefix hit_rate 0.6, warm TTFT 0.32x cold;
+# disagg occupancy 1.0 vs fused 0.989.
+FANOUT_QPS_FLOOR = 150.0          # ISSUE 19 acceptance floor
+WINDOW_SHARE_TOL = 0.05           # per-proxy windows vs cluster window
+REDISTRIBUTE_S_CEIL = 10.0        # liveness TTL 3s + refresh + slack
+PREFIX_HIT_RATE_FLOOR = 0.5
+PREFIX_WARM_OVER_COLD_CEIL = 0.5  # hit TTFT p50 <= 0.5x cold
+DISAGG_OCCUPANCY_SLACK = 0.02     # "not dipping" tolerance vs fused
+
+
+def test_multi_proxy_fanout_floors_and_chaos():
+    """Sharded ingress: N proxies split one admission window, sustain
+    the QPS floor with zero admitted failures, and survive a mid-burst
+    proxy kill — the dead member's share redistributes to the
+    survivors within one liveness TTL."""
+    signal.alarm(600)
+    from serve_bench import run_multi_proxy_fanout
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=4)
+    try:
+        res = run_multi_proxy_fanout()
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+
+    # throughput + zero admitted failures (shed 503s are backpressure,
+    # not failures; conn errors are failover against the killed member)
+    assert res["admitted_qps"] >= FANOUT_QPS_FLOOR, res
+    assert res["admitted_timeouts"] == 0, res
+    assert res["errors_5xx"] == 0, res
+
+    # per-proxy windows shard the one cluster window
+    before = res["window_shares_before"]
+    assert before["live_proxies"] == 3, before
+    assert len(before["windows"]) == 3, before
+    assert before["share_error"] is not None
+    assert before["share_error"] <= WINDOW_SHARE_TOL, before
+
+    # chaos: survivors pick up the dead member's share
+    after = res["window_shares_after_chaos"]
+    assert after["live_proxies"] == 2, after
+    assert after["share_error"] <= WINDOW_SHARE_TOL, after
+    assert res["chaos_redistributed_s"] is not None, res
+    assert res["chaos_redistributed_s"] <= REDISTRIBUTE_S_CEIL, res
+
+
+def test_prefix_reuse_floors():
+    """Prefix KV-reuse: repeated-prefix prompts must actually hit the
+    engine's prefix store and a hit must prefill only the tail — TTFT
+    at or under half of a cold prefill."""
+    signal.alarm(600)
+    from serve_bench import run_prefix_reuse
+
+    res = run_prefix_reuse()
+    assert res["hit_rate"] >= PREFIX_HIT_RATE_FLOOR, res
+    assert res["prefix_hit_tokens"] > 0, res
+    assert res["warm_over_cold_ttft"] <= PREFIX_WARM_OVER_COLD_CEIL, res
+
+
+def test_disagg_occupancy_and_edge_floors():
+    """Disaggregated prefill/decode: with prefill in a separate pool
+    and KV handed over the shm device edge as one packed tick, the
+    decode pool's occupancy must not dip vs the fused baseline, the
+    handoff must not touch the DCN edge, and every tick must frame its
+    k/v leaves as raw shard bytes (zero pickle fallbacks)."""
+    signal.alarm(600)
+    from serve_bench import run_disagg
+
+    res = run_disagg()
+    assert res["fused_occupancy_mean"] is not None, res
+    assert res["disagg_occupancy_mean"] is not None, res
+    assert res["disagg_occupancy_mean"] >= (
+        res["fused_occupancy_mean"] - DISAGG_OCCUPANCY_SLACK), res
+    assert res["kv_handoffs"] > 0, res
+    assert res["kv_handoff_bytes_total"] > 0, res
+    assert "dcn" not in res["edge_kinds"], res
+    assert res["pickle_fallbacks"] == 0, res

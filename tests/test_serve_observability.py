@@ -128,6 +128,63 @@ def test_manager_purge_on_app_delete():
     assert m.get("a1") is None and m.get("k1") is not None
     assert m.num_requests() == 1
     assert "gone" not in m.dropped_counts()
+
+
+def test_manager_derives_data_plane_families():
+    """Tentpole (PR 19): every finalized record feeds the data-plane
+    counters — prefix-cache routing outcome, per-proxy admission
+    attribution (sheds never held a slot), and KV handoff bytes tagged
+    by edge kind, counted once per coalesced record."""
+    m = _mgr()
+    m.ingest(_proxy_final("d1", proxy="http-1", prefix_cache="hit"))
+    m.ingest(_replica_partial(
+        "d1", engine={"kv_handoff_bytes": 4096, "kv_handoff_edge": "shm"}))
+    m.ingest(_proxy_final("d2", proxy="http-0", prefix_cache="spill"))
+    m.ingest(_proxy_final("d3", proxy="http-0", outcome="shed"))
+    recs = m.drain_metric_records()
+    prefix = [r for r in recs
+              if r["name"] == "rayt_serve_prefix_cache_total"]
+    assert sorted(r["tags"]["outcome"] for r in prefix) == \
+        ["hit", "spill"]
+    assert all(r["tags"]["app"] == "app" for r in prefix)
+    admitted = [r for r in recs
+                if r["name"] == "rayt_serve_proxy_admitted_total"]
+    # the shed record ("d3") must NOT count as admitted
+    assert sorted(r["tags"]["proxy"] for r in admitted) == \
+        ["http-0", "http-1"]
+    kv = [r for r in recs
+          if r["name"] == "rayt_serve_kv_handoff_bytes_total"]
+    assert len(kv) == 1 and kv[0]["value"] == 4096.0
+    assert kv[0]["tags"] == {"edge_kind": "shm"}
+
+
+def test_manager_coalesces_disagg_pools_into_one_waterfall():
+    """Satellite: a disaggregated request's two replica partials
+    (prefill pool: prefill phases; decode pool: decode phases) coalesce
+    into ONE engine waterfall under the proxy-minted request id,
+    whichever flush cadence lands first — neither half's structural
+    gaps may clobber the other's real values."""
+    prefill = _replica_partial(
+        "w1", deployment="PrefillWorker",
+        engine={"queue_s": 0.001, "prefill_s": 0.02, "prefill_chunks": 2,
+                "prefix_cache": "hit", "prefix_hit_tokens": 16,
+                "kv_handoff_bytes": 4096, "kv_handoff_edge": "shm"})
+    decode = _replica_partial(
+        "w1", deployment="DecodeLlamaService",
+        engine={"queue_s": 0.002, "tokens": 6, "decode_steps": 6,
+                "ttft_s": 0.01, "decode_s": 0.05, "tpot_s": 0.01,
+                "occupancy_mean": 0.5})
+    for order in ((prefill, decode), (decode, prefill)):
+        m = _mgr()
+        for part in order:
+            m.ingest(dict(part, engine=dict(part["engine"])))
+        m.ingest(_proxy_final("w1", proxy="http-0"))
+        eng = m.get("w1")["engine"]
+        assert eng["prefill_s"] == 0.02 and eng["prefill_chunks"] == 2
+        assert eng["decode_steps"] == 6 and eng["tokens"] == 6
+        assert eng["prefix_cache"] == "hit"
+        assert eng["kv_handoff_bytes"] == 4096
+        assert eng["kv_handoff_edge"] == "shm"
     # the pending partial went too: a late final can't finalize it with
     # the deleted app's stale fields... (it just starts a fresh record)
     out = m.list(app="gone")
@@ -257,6 +314,7 @@ def test_cli_renders_request_waterfall(serve_cluster, capsys):
     text = capsys.readouterr().out
     assert "admission" in text and "dispatch" in text, text
     assert "replica[" in text, text  # the replica nest rendered
+    assert "proxy=" in text, text   # admitting fleet member rendered
     assert "matched" in text
 
     _print_serve_waterfall(state_api.summarize_serve_requests())
@@ -379,6 +437,11 @@ def test_admission_endpoint_snapshot(serve_cluster):
     assert e["admitted_total"] >= 3 and e["window"] >= 1, e
     assert e["admitted"] == 0  # nothing in flight now
     assert e["shed_total"] == 0
+    # sharded-ingress fleet keys: which member answered, how many are
+    # live, and this member's share of the cluster window
+    assert snap["proxy_id"] == "http-0", snap
+    assert snap["live_proxies"] >= 1, snap
+    assert e["window"] <= e["cluster_window"], e
 
 
 def test_grpc_proxy_request_id_and_record_parity(serve_cluster):
@@ -411,6 +474,9 @@ def test_grpc_proxy_request_id_and_record_parity(serve_cluster):
     md = {k: v for k, v in call.initial_metadata()}
     rid = md.get("x-rayt-request-id")
     assert rid and len(rid) == 32, md
+    # the gRPC ingress names its fleet member like the HTTP proxy's
+    # X-Rayt-Proxy-Id response header
+    assert md.get("x-rayt-proxy-id") == "grpc-0", md
 
     # streaming leg too
     stream = chan.unary_stream(
@@ -427,12 +493,15 @@ def test_grpc_proxy_request_id_and_record_parity(serve_cluster):
                                  data=json.dumps("hi").encode())
     with urllib.request.urlopen(req, timeout=30) as r:
         hrid = r.headers["X-Rayt-Request-Id"]
+        assert r.headers["X-Rayt-Proxy-Id"] == "http-0"
         r.read()
 
     grec = _wait_record(rid)
     hrec = _wait_record(hrid)
     assert grec["proto"] == "grpc" and hrec["proto"] == "http"
     assert grec["outcome"] == "ok"
+    # both records attribute the serving fleet member
+    assert grec["proxy"] == "grpc-0" and hrec["proxy"] == "http-0"
     # same record shape: the gRPC record carries every key the HTTP one
     # does (both tiled by the shared _finish_record path)
     missing = set(hrec) - set(grec) - {"proto"}

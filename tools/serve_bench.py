@@ -32,9 +32,20 @@ server-side per-request waterfall records in the GCS serve-state store
 replica queue/service nest) so the two clocks can be compared in one
 artifact.
 
+Leg ``multi_proxy`` (ISSUE 19) covers the sharded data plane in three
+sub-legs: ``fanout`` — open-loop arrivals round-robined across N HTTP
+proxy replicas sharing one admission window (per-proxy shares checked
+against the cluster window), with one proxy KILLED mid-burst — zero
+admitted failures allowed and the dead member's share must
+redistribute within one heartbeat TTL; ``prefix`` — repeated-prefix
+TTFT vs cold through the engine's prefix KV store; ``disagg`` —
+decode-pool occupancy with long prompts prefilled in a SEPARATE engine
+and handed over the shm device edge as one packed raw-shard tick,
+vs the fused baseline that prefills inside the decode engine.
+
 Writes SERVE_BENCH.json at the repo root ({"engine": ..,
-"sustained_load": .., "request_latency": ..}; --leg selects, existing
-legs are preserved on a partial refresh). Platform: runs on whatever
+"sustained_load": .., "request_latency": .., "multi_proxy": ..};
+--leg selects, existing legs are preserved on a partial refresh). Platform: runs on whatever
 backend jax resolves (the tunneled TPU when up, else host CPU with
 "platform" recorded so the judge can tell the legs apart).
 """
@@ -445,6 +456,411 @@ def run_latency(*, rate_qps: float = 8.0, duration_s: float = 15.0,
             pass
 
 
+# -------------------------------------------------------- multi-proxy leg
+def run_multi_proxy_fanout(*, num_proxies: int = 3, replicas: int = 4,
+                           max_ongoing: int = 8,
+                           service_time_s: float = 0.01,
+                           rate_qps: float = 250.0,
+                           duration_s: float = 10.0,
+                           chaos_at_s: float = 3.0,
+                           request_timeout_s: float = 10.0,
+                           app_name: str = "fan") -> dict:
+    """Sharded-ingress fan-out leg (call inside a started cluster):
+    open-loop arrivals round-robined across N HTTP proxies against a
+    fixed-replica echo app, per-proxy admission-window shares checked
+    against the cluster window, and one proxy killed mid-burst (the
+    chaos drill) — surviving members must pick up the dead member's
+    share within one heartbeat TTL, with zero admitted-request
+    timeouts or 500s end to end."""
+    import asyncio as aio
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    serve.start(http_port=0, request_timeout_s=request_timeout_s,
+                num_proxies=num_proxies)
+    ports = serve.proxy_ports()
+
+    @serve.deployment(num_replicas=replicas,
+                      max_ongoing_requests=max_ongoing)
+    class Echo:
+        async def __call__(self, payload):
+            import asyncio
+
+            await asyncio.sleep(service_time_s)
+            return "ok"
+
+    serve.run(Echo.bind(), name=app_name)
+
+    def _admission(port: int) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/-/admission", timeout=10) as r:
+            return json.loads(r.read())
+
+    def _window_share(ports_: list) -> dict:
+        """Per-proxy windows for the app + the cluster window they
+        shard (every live member must agree on the denominator)."""
+        snaps = []
+        for p in ports_:
+            try:
+                snaps.append(_admission(p))
+            except Exception:
+                continue
+        wins = [s[app_name]["window"] for s in snaps
+                if app_name in s]
+        cluster = max((s[app_name]["cluster_window"] for s in snaps
+                      if app_name in s), default=0)
+        return {"windows": wins, "window_sum": sum(wins),
+                "cluster_window": cluster,
+                "live_proxies": max((s.get("live_proxies", 1)
+                                     for s in snaps), default=0),
+                "share_error": (abs(sum(wins) - cluster) / cluster
+                                if cluster else None)}
+
+    # prime every proxy's capacity cache so the share math is live
+    import urllib.request
+    for p in ports:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{p}/{app_name}", data=b"{}"),
+            timeout=30).read()
+    time.sleep(1.5)  # one heartbeat so live_proxies covers the fleet
+    shares_before = _window_share(ports)
+
+    results: list = []          # (status, latency_s, reason)
+    conn_errors = [0]
+    live_ports = list(ports)
+
+    async def _drive() -> dict:
+        import aiohttp
+
+        loop = aio.get_running_loop()
+        killed = {"t": None, "redistributed_s": None}
+
+        async def one(session, port):
+            t0 = time.perf_counter()
+            url = f"http://127.0.0.1:{port}/{app_name}"
+            try:
+                async with session.post(url, json={}) as resp:
+                    await resp.read()
+                    results.append(
+                        (resp.status, time.perf_counter() - t0,
+                         resp.headers.get("X-Rayt-Reason", "")))
+            except Exception:
+                # a client aimed at the killed member: fail over —
+                # never counted as an admitted failure (it never held
+                # a window slot)
+                conn_errors[0] += 1
+                if port in live_ports and len(live_ports) > 1:
+                    live_ports.remove(port)
+
+        async def chaos():
+            await aio.sleep(chaos_at_s)
+            victim = serve.proxy_name(1)
+            rt.kill(rt.get_actor(victim))
+            killed["t"] = time.perf_counter()
+            # watch a survivor's admission view: redistribution lands
+            # when it sees the shrunken fleet (heartbeat TTL)
+            deadline = time.perf_counter() + 20.0
+            while time.perf_counter() < deadline:
+                try:
+                    snap = await loop.run_in_executor(
+                        None, _admission, live_ports[0])
+                    if snap.get("live_proxies", 99) <= num_proxies - 1:
+                        killed["redistributed_s"] = round(
+                            time.perf_counter() - killed["t"], 2)
+                        return
+                except Exception:
+                    pass
+                await aio.sleep(0.25)
+
+        conn = aiohttp.TCPConnector(limit=0)
+        # client-side cap: a request in flight on the killed proxy
+        # would otherwise wait forever (counts as a failover error)
+        tmo = aiohttp.ClientTimeout(total=request_timeout_s + 5.0)
+        async with aiohttp.ClientSession(connector=conn,
+                                         timeout=tmo) as session:
+            chaos_task = aio.ensure_future(chaos())
+            interval = 1.0 / rate_qps
+            t_end = loop.time() + duration_s
+            next_t = loop.time()
+            tasks = []
+            i = 0
+            while loop.time() < t_end:
+                port = live_ports[i % len(live_ports)]
+                i += 1
+                tasks.append(aio.ensure_future(one(session, port)))
+                next_t += interval
+                delay = next_t - loop.time()
+                if delay > 0:
+                    await aio.sleep(delay)
+            await aio.gather(*tasks)
+            await chaos_task
+        return killed
+
+    try:
+        killed = asyncio.run(_drive())
+        shares_after = _window_share(live_ports)
+        admitted = [r for r in results if r[0] == 200]
+        shed = [r for r in results if r[0] == 503 and r[2] != "timeout"]
+        timeouts = [r for r in results
+                    if r[0] == 503 and r[2] == "timeout"]
+        errors = [r for r in results if r[0] not in (200, 503)]
+        lats = sorted(r[1] for r in admitted)
+        return {
+            "metric": "serve_multi_proxy_fanout",
+            "config": {"num_proxies": num_proxies,
+                       "replicas": replicas,
+                       "max_ongoing_requests": max_ongoing,
+                       "service_time_s": service_time_s,
+                       "rate_qps": rate_qps, "duration_s": duration_s,
+                       "chaos_at_s": chaos_at_s},
+            "offered": len(results) + conn_errors[0],
+            "admitted": len(admitted),
+            "admitted_qps": round(len(admitted) / duration_s, 1),
+            "shed": len(shed),
+            "admitted_timeouts": len(timeouts),
+            "errors_5xx": len(errors),
+            "conn_errors_failover": conn_errors[0],
+            "latency_p50_ms": (round(1e3 * _pct(lats, 50), 1)
+                               if lats else None),
+            "latency_p99_ms": (round(1e3 * _pct(lats, 99), 1)
+                               if lats else None),
+            "window_shares_before": shares_before,
+            "window_shares_after_chaos": shares_after,
+            "chaos_redistributed_s": killed.get("redistributed_s"),
+        }
+    finally:
+        try:
+            serve.delete(app_name)
+        except Exception:
+            pass
+
+
+def run_prefix_reuse(*, prompt_len: int = 120, warm_requests: int = 12,
+                     cold_requests: int = 6, max_new: int = 4) -> dict:
+    """Prefix KV-reuse leg (in-process engine): TTFT of repeated-prefix
+    prompts (engine grafts the cached leading blocks and prefills only
+    the tail) vs distinct cold prompts, plus the engine's hit-rate
+    counters. One request at a time — TTFT here is pure prefill cost."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import LLMEngine
+
+    # prefill_chunk MUST be on: the hit path skips the grafted chunks
+    # (a hit prefills only the tail past the cached blocks), while cold
+    # walks every chunk — chunk=0 would prefill the full bucket either
+    # way and the graft would only add copy cost
+    eng = LLMEngine("debug", tp=2, max_batch=2, prompt_buckets=(32, 128),
+                    max_seq_len=512, prefill_chunk=16)
+    rng = np.random.default_rng(7)
+
+    async def _ttft(prompt) -> float:
+        t0 = time.perf_counter()
+        first = None
+        async for _tok in eng.generate(prompt, max_new_tokens=max_new):
+            if first is None:
+                first = time.perf_counter() - t0
+        return first
+
+    async def _run():
+        # warmup: trace prefill + insert + decode once
+        await _ttft(list(rng.integers(1, 200, prompt_len)))
+        cold = [await _ttft(list(rng.integers(1, 200, prompt_len)))
+                for _ in range(cold_requests)]
+        warm_prompt = list(rng.integers(1, 200, prompt_len))
+        await _ttft(warm_prompt)          # seeds the prefix store
+        warm = [await _ttft(list(warm_prompt))
+                for _ in range(warm_requests)]
+        return cold, warm
+
+    cold, warm = asyncio.run(_run())
+    stats = eng.stats()
+    hits = stats["prefix_hits"]
+    misses = stats["prefix_misses"]
+    cold_p50 = _pct(cold, 50)
+    warm_p50 = _pct(warm, 50)
+    return {
+        "metric": "serve_prefix_reuse",
+        "config": {"prompt_len": prompt_len,
+                   "prefix_block": eng._prefix_block,
+                   "warm_requests": warm_requests,
+                   "cold_requests": cold_requests},
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "hit_rate": round(hits / max(1, hits + misses), 3),
+        "prefix_hit_tokens": stats["prefix_hit_tokens"],
+        "cold_ttft_p50_ms": round(1e3 * cold_p50, 2),
+        "warm_ttft_p50_ms": round(1e3 * warm_p50, 2),
+        "warm_over_cold_ttft": round(warm_p50 / cold_p50, 3),
+    }
+
+
+def run_disagg(*, streams: int = 4, stream_new_tokens: int = 100,
+               long_prompts: int = 6, long_prompt_len: int = 120) -> dict:
+    """Disaggregated prefill/decode leg (in-process engines): a full
+    batch of short decode streams with long prompts injected mid-run.
+    Fused baseline: the long prompts prefill INSIDE the decode engine
+    (chunked), holding slots that emit nothing — the streams' decode
+    occupancy dips. Disagg: every prompt prefills in a separate engine
+    and hands its KV rows over the shm device edge as one packed tick
+    (raw shard bytes, zero pickle fallbacks), so the decode pool's
+    occupancy holds. Reports per-mode occupancy plus handoff bytes /
+    edge kind / packed-leaf counts."""
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmChannel
+    from ray_tpu.dag.dcn_channel import attach_channel
+    from ray_tpu.dag.device_channel import (DeviceChannelSpec,
+                                            DeviceTransportChannel,
+                                            tree_nbytes)
+    from ray_tpu.serve.llm import _edge_kind, LLMEngine
+    from ray_tpu.serve.request_context import (_reset_request_obs,
+                                               _set_request_obs)
+
+    kw = dict(tp=2, max_batch=streams, prompt_buckets=(32, 128),
+              max_seq_len=512, prefill_chunk=16)
+    rng = np.random.default_rng(3)
+    short = [list(rng.integers(1, 200, 8)) for _ in range(streams)]
+    longs = [list(rng.integers(1, 200, long_prompt_len))
+             for _ in range(long_prompts)]
+
+    def _spawn_with_obs(coro_fn):
+        """ensure_future in a context carrying a fresh obs dict (the
+        engine stamps per-step occupancy into it)."""
+        obs = {}
+        token = _set_request_obs(obs)
+        try:
+            task = asyncio.ensure_future(coro_fn())
+        finally:
+            _reset_request_obs(token)
+        return obs, task
+
+    async def _fused() -> list:
+        eng = LLMEngine("debug", **kw)
+        for p in (longs[0], short[0]):  # warm both prefill buckets
+            async for _ in eng.generate(p, max_new_tokens=2):
+                pass
+
+        async def stream(p):
+            async for _ in eng.generate(p,
+                                        max_new_tokens=stream_new_tokens):
+                pass
+
+        async def inject():
+            for p in longs:
+                async for _ in eng.generate(p, max_new_tokens=2):
+                    pass
+
+        pairs = [_spawn_with_obs(lambda p=p: stream(p)) for p in short]
+        inj = asyncio.ensure_future(inject())
+        await asyncio.gather(inj, *[t for _, t in pairs])
+        return [o for o, _ in pairs]
+
+    handoffs: list = []
+
+    async def _disagg() -> list:
+        pre = LLMEngine("debug", **kw)
+        dec = LLMEngine("debug", **kw)
+        for p in (longs[0], short[0]):  # warm both buckets, both engines
+            h0 = await pre.prefill_only(p)
+            async for _ in dec.generate_prefilled(p, h0,
+                                                  max_new_tokens=2):
+                pass
+        loop = asyncio.get_running_loop()
+        kv = 2 * dec.cfg.n_layers * 128 * dec.cfg.n_kv_heads * \
+            dec.cfg.head_dim * 4
+        slot = kv + kv // 4 + (1 << 16)
+
+        async def handoff(tokens) -> dict:
+            """prefill_only -> ONE packed tick over the shm device edge
+            -> decode-side read (the serve path, minus the actors)."""
+            h = await pre.prefill_only(tokens)
+            shm = ShmChannel.create(slot_size=slot, n_slots=2)
+            spec = DeviceChannelSpec(name=shm.spec.name,
+                                     inner=shm.spec)
+            ch = DeviceTransportChannel(shm, spec)
+            prod = attach_channel(spec)
+            try:
+                await loop.run_in_executor(
+                    None, lambda: prod.write(dict(h), timeout=30.0))
+                tick = await loop.run_in_executor(
+                    None, lambda: ch.read(timeout=30.0))
+                handoffs.append(
+                    {"bytes": int(tree_nbytes({"k": h["k"],
+                                               "v": h["v"]})),
+                     "edge_kind": _edge_kind(prod, spec),
+                     "n_arrays": int(prod.device_arrays)})
+                return tick
+            finally:
+                prod.close()
+                ch.close()
+
+        async def stream(p, tick):
+            async for _ in dec.generate_prefilled(
+                    p, tick, max_new_tokens=stream_new_tokens):
+                pass
+
+        async def inject():
+            for p in longs:
+                tick = await handoff(p)
+                async for _ in dec.generate_prefilled(p, tick,
+                                                      max_new_tokens=2):
+                    pass
+
+        # prefill pool runs AHEAD of decode: every stream's KV lands
+        # before its decode slot is claimed, so the pool starts full —
+        # that head start is the disagg contract under test
+        ticks = await asyncio.gather(*[handoff(p) for p in short])
+        pairs = [_spawn_with_obs(lambda p=p, t=t: stream(p, t))
+                 for p, t in zip(short, ticks)]
+        inj = asyncio.ensure_future(inject())
+        await asyncio.gather(inj, *[t for _, t in pairs])
+        return [o for o, _ in pairs]
+
+    def _occ(obs_list: list):
+        vals = [o["occupancy_sum"] / o["decode_steps"]
+                for o in obs_list if o.get("decode_steps")]
+        return round(sum(vals) / len(vals), 3) if vals else None
+
+    fused_obs = asyncio.run(_fused())
+    disagg_obs = asyncio.run(_disagg())
+    return {
+        "metric": "serve_disagg_prefill_decode",
+        "config": {"streams": streams,
+                   "stream_new_tokens": stream_new_tokens,
+                   "long_prompts": long_prompts,
+                   "long_prompt_len": long_prompt_len,
+                   "prefill_chunk": kw["prefill_chunk"]},
+        "fused_occupancy_mean": _occ(fused_obs),
+        "disagg_occupancy_mean": _occ(disagg_obs),
+        "kv_handoffs": len(handoffs),
+        "kv_handoff_bytes_total": sum(h["bytes"] for h in handoffs),
+        "edge_kinds": sorted({h["edge_kind"] for h in handoffs}),
+        "pickle_fallbacks": sum(1 for h in handoffs
+                                if h["n_arrays"] < 2),
+    }
+
+
+def run_multi_proxy() -> dict:
+    """The full PR-19 data-plane leg: sharded-ingress fan-out (with the
+    chaos drill) inside a cluster, then the in-process prefix-reuse and
+    disagg comparisons."""
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=4)
+    try:
+        fanout = run_multi_proxy_fanout()
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+    return {"fanout": fanout,
+            "prefix": run_prefix_reuse(),
+            "disagg": run_disagg()}
+
+
 def _serve_metric_totals() -> dict:
     """Cluster-wide serve counters from the GCS metrics store (proves
     the Prometheus family is emitting: rayt_serve_{shed,admitted}_total
@@ -484,7 +900,8 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--leg",
-                    choices=("engine", "sustained", "latency", "all"),
+                    choices=("engine", "sustained", "latency",
+                             "multi_proxy", "all"),
                     default="all")
     ap.add_argument("--preset", default="debug")
     ap.add_argument("--concurrency", type=int, default=8)
@@ -523,6 +940,8 @@ def main():
         finally:
             serve.shutdown()
             rt.shutdown()
+    if args.leg in ("multi_proxy", "all"):
+        out["multi_proxy"] = run_multi_proxy()
     out["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                        time.gmtime())
     print(json.dumps(out, indent=1))
